@@ -1,0 +1,30 @@
+"""Test harness: emulate an 8-device TPU mesh on CPU.
+
+Reference test strategy (SURVEY.md §4): the reference needs real CUDA
+devices for every XLA test.  Here multi-device behaviour is tested on CPU
+via ``--xla_force_host_platform_device_count`` — collectives, shardings
+and pipeline schedules execute for real across 8 virtual devices.
+"""
+
+import os
+
+# Force CPU: the dev box exposes one real TPU chip, but tests exercise
+# multi-device sharding on 8 emulated CPU devices.  The TPU site hook
+# overrides JAX_PLATFORMS via jax.config, so set the config directly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 emulated devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,79 @@
+"""Config validation + derivation tests (reference analogue: the implicit
+contract of torchacc/config.py — validate(), dp-size inference
+config.py:320-324, uniform buckets core/async_loader.py:14-17)."""
+
+import pytest
+
+from torchacc_tpu.config import (
+    Config,
+    ConfigError,
+    DataConfig,
+    DistConfig,
+    DPConfig,
+    FSDPConfig,
+    PPConfig,
+    SPConfig,
+    TPConfig,
+)
+
+
+def test_default_config_validates():
+    cfg = Config()
+    cfg.validate()
+
+
+def test_dp_inference():
+    dist = DistConfig(dp=DPConfig(size=-1), fsdp=FSDPConfig(size=4))
+    sizes = dist.axis_sizes(world_size=8)
+    assert sizes["dp"] == 2 and sizes["fsdp"] == 4
+
+
+def test_axis_product_mismatch_raises():
+    dist = DistConfig(dp=DPConfig(size=2), fsdp=FSDPConfig(size=2))
+    with pytest.raises(ConfigError):
+        dist.axis_sizes(world_size=8)  # 2*2 != 8
+
+
+def test_invalid_topology_raises():
+    cfg = Config(dist=DistConfig(topology=("dp", "fsdp")))
+    with pytest.raises(ConfigError):
+        cfg.validate()
+
+
+def test_pp_microbatch_divisibility():
+    cfg = Config(dist=DistConfig(pp=PPConfig(size=4, num_micro_batches=6)))
+    with pytest.raises(ConfigError):
+        cfg.validate()
+    Config(dist=DistConfig(pp=PPConfig(size=4, num_micro_batches=8))).validate()
+
+
+def test_sp_2d_requires_intra():
+    cfg = Config(dist=DistConfig(sp=SPConfig(size=4, mode="2d")))
+    with pytest.raises(ConfigError):
+        cfg.validate()
+    Config(dist=DistConfig(sp=SPConfig(size=4, mode="2d", intra_size=2))).validate()
+
+
+def test_uniform_buckets():
+    # reference `_uniform_buckets` (async_loader.py:14-17)
+    d = DataConfig(max_length=512, num_buckets=4)
+    assert d.bucket_sizes() == [128, 256, 384, 512]
+    d2 = DataConfig(buckets=[64, 128])
+    assert d2.bucket_sizes() == [64, 128]
+    assert DataConfig().bucket_sizes() is None
+
+
+def test_from_dict_unknown_key_raises():
+    with pytest.raises(ConfigError):
+        Config.from_dict({"dist": {"fsdb": {"size": 4}}})
+    with pytest.raises(ConfigError):
+        Config.from_dict({"bogus": 1})
+
+
+def test_roundtrip_dict():
+    cfg = Config(dist=DistConfig(tp=TPConfig(size=2), fsdp=FSDPConfig(size=2)))
+    d = cfg.to_dict()
+    cfg2 = Config.from_dict(d)
+    assert cfg2.dist.tp.size == 2
+    assert cfg2.dist.fsdp.size == 2
+    assert tuple(cfg2.dist.topology) == tuple(cfg.dist.topology)

@@ -1,0 +1,46 @@
+"""Mesh construction tests (reference analogue: ProcessTopology/Mesh
+coord<->rank tests implied by torchacc/dist/mesh.py:13-418)."""
+
+import numpy as np
+import pytest
+
+from torchacc_tpu.config import (
+    Config,
+    DistConfig,
+    DPConfig,
+    FSDPConfig,
+    PPConfig,
+    SPConfig,
+    TPConfig,
+)
+from torchacc_tpu.parallel.mesh import build_mesh, describe_mesh
+
+
+def test_build_mesh_all_axes(devices):
+    dist = DistConfig(dp=DPConfig(size=2), fsdp=FSDPConfig(size=2), tp=TPConfig(size=2))
+    mesh = build_mesh(dist, devices=devices)
+    assert describe_mesh(mesh) == {"dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2}
+    assert mesh.devices.size == 8
+
+
+def test_topology_orders_axes(devices):
+    # tp last => tp neighbours are adjacent device ids (ICI-adjacent)
+    dist = DistConfig(dp=DPConfig(size=4), tp=TPConfig(size=2))
+    mesh = build_mesh(dist, devices=devices)
+    dev_ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    tp_axis = mesh.axis_names.index("tp")
+    ids = np.moveaxis(dev_ids, tp_axis, -1).reshape(-1, 2)
+    assert all(abs(int(a) - int(b)) == 1 for a, b in ids)
+
+
+def test_config_get_mesh_cached(devices):
+    cfg = Config(dist=DistConfig(fsdp=FSDPConfig(size=8)))
+    m1 = cfg.get_mesh(devices)
+    m2 = cfg.get_mesh()
+    assert m1 is m2
+
+
+def test_bad_world_size(devices):
+    dist = DistConfig(dp=DPConfig(size=3))
+    with pytest.raises(Exception):
+        build_mesh(dist, devices=devices)

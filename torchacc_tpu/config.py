@@ -1,0 +1,381 @@
+"""Typed configuration tree for the TPU-native acceleration framework.
+
+This mirrors the *semantics* of the reference config system
+(``torchacc/config.py:26-444`` — nested dataclasses with per-class
+``validate()`` and a lazily constructed device mesh) while being designed
+around JAX/XLA: parallelism axes are names on a :class:`jax.sharding.Mesh`
+rather than rank process-groups, mixed precision is a dtype policy rather
+than an autocast patch, and graph boundaries are jitted step functions so
+there is no ``sync``/``mark_step`` knob.
+
+Axis inventory (reference: ``DistConfig`` torchacc/config.py:282-336, plus
+context-parallel groups ops/context_parallel/init_group.py:42-91):
+
+==========  =========================================================
+axis        meaning
+==========  =========================================================
+``dp``      pure data parallel (replicated params, sharded batch)
+``fsdp``    ZeRO-3 style: params/opt-state sharded, batch sharded too
+``sp``      sequence/context parallel (Ulysses / Ring / 2D)
+``tp``      tensor parallel (megatron column/row sharding)
+``ep``      expert parallel (MoE all-to-all; not in the reference)
+``pp``      pipeline parallel (stage-per-mesh-slice, ppermute xfer)
+==========  =========================================================
+
+``DistConfig.topology`` orders the axes from the *slowest* network to the
+fastest (DCN -> ICI), mirroring the reference's intra-/inter-node axis
+ordering (torchacc/config.py:291-303): axes later in the tuple land on
+adjacent devices (ICI neighbours), axes earlier span slices/hosts (DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+MESH_AXES: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "ep", "tp")
+
+# Axes along which the *batch* is split.  ``fsdp`` shards data as well as
+# params (ZeRO data parallelism); ``ep`` ranks also consume distinct data
+# when experts are laid out across otherwise-data-parallel workers.
+DATA_AXES: Tuple[str, ...] = ("dp", "fsdp")
+
+
+class ConfigError(ValueError):
+    """Raised when a configuration fails validation."""
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ConfigError(msg)
+
+
+@dataclass
+class ComputeConfig:
+    """Numerics & kernel selection.
+
+    Reference: ``ComputeConfig`` torchacc/config.py:26-54 (fp16/bf16 flags,
+    ``acc_scaled_dot_attn`` SDPA swap, ``disable_kernel_patches``).  On TPU
+    the analogue is a dtype policy plus explicit kernel choices.
+    """
+
+    dtype: str = "bfloat16"          # activation/compute dtype
+    param_dtype: str = "float32"     # master parameter dtype
+    accum_dtype: str = "float32"     # matmul/softmax accumulation dtype
+    flash_attention: bool = True     # use the Pallas flash-attention kernel
+    # 'auto': pallas on TPU, interpreter elsewhere; 'xla': plain jnp reference
+    attention_impl: str = "auto"     # 'auto' | 'pallas' | 'xla'
+    fused_kernels: bool = True       # fused RMSNorm/SwiGLU/CE Pallas kernels
+    deterministic: bool = False      # bit-deterministic kernels (no dropout rng reorder)
+    matmul_precision: str = "default"  # jax.lax precision for non-kernel matmuls
+
+    def validate(self) -> None:
+        _check(self.dtype in ("bfloat16", "float16", "float32"),
+               f"compute.dtype must be bfloat16|float16|float32, got {self.dtype}")
+        _check(self.param_dtype in ("bfloat16", "float32"),
+               f"compute.param_dtype must be bfloat16|float32, got {self.param_dtype}")
+        _check(self.attention_impl in ("auto", "pallas", "xla"),
+               f"compute.attention_impl invalid: {self.attention_impl}")
+
+
+@dataclass
+class MemoryConfig:
+    """Rematerialisation + offload policy.
+
+    Reference: ``MemoryConfig`` torchacc/config.py:57-88 (``gc``, ``gc_cls``,
+    ``gc_cnt``) and the CPU activation offloader utils/cpu_offload.py.  Here
+    ``gc`` maps to :func:`jax.checkpoint` on the transformer block with a
+    selectable save policy, and offload uses XLA host memory spaces.
+    """
+
+    gc: bool = False                  # gradient/activation checkpointing (remat)
+    gc_cls: Optional[List[str]] = None  # layer class names to remat (None = block)
+    gc_cnt: Optional[int] = None      # remat only the first N matching layers
+    gc_policy: str = "nothing"        # 'nothing' | 'dots' | 'dots_with_no_batch_dims' | 'offload_dots'
+    offload_activations: bool = False  # remat residuals to host memory space
+
+    def validate(self) -> None:
+        _check(self.gc_policy in ("nothing", "dots", "dots_with_no_batch_dims", "offload_dots"),
+               f"memory.gc_policy invalid: {self.gc_policy}")
+        if self.gc_cnt is not None:
+            _check(self.gc_cnt >= 0, "memory.gc_cnt must be >= 0")
+
+
+@dataclass
+class DataConfig:
+    """Input pipeline: bucketing + async host->device feed.
+
+    Reference: ``DataLoaderConfig`` torchacc/config.py:91-127 and the
+    ``AsyncLoader``/``BucketingParallelLoader`` (core/async_loader.py:14-207).
+    Padding every batch to one of a small set of bucket lengths bounds the
+    number of distinct compiled programs (recompilation control).
+    """
+
+    buckets: Optional[List[int]] = None  # explicit bucket lengths (sorted)
+    max_length: Optional[int] = None     # with num_buckets -> uniform buckets
+    num_buckets: int = 1
+    pad_value_dict: Optional[Dict[str, Any]] = None  # per-feature pad value
+    prefetch: int = 2                    # device prefetch depth (double buffer)
+    drop_last: bool = True
+
+    def validate(self) -> None:
+        if self.buckets is not None:
+            _check(len(self.buckets) > 0, "data.buckets must be non-empty")
+            _check(list(self.buckets) == sorted(self.buckets),
+                   "data.buckets must be sorted ascending")
+        if self.max_length is not None:
+            _check(self.max_length > 0, "data.max_length must be positive")
+            _check(self.num_buckets >= 1, "data.num_buckets must be >= 1")
+        _check(self.prefetch >= 1, "data.prefetch must be >= 1")
+
+    def bucket_sizes(self) -> Optional[List[int]]:
+        """Uniform bucket lengths (reference `_uniform_buckets`
+        core/async_loader.py:14-17)."""
+        if self.buckets is not None:
+            return list(self.buckets)
+        if self.max_length is None:
+            return None
+        step = self.max_length / self.num_buckets
+        return [int(math.ceil(step * (i + 1))) for i in range(self.num_buckets)]
+
+
+@dataclass
+class DPConfig:
+    """Reference: torchacc/config.py:130-146. ``size=-1`` = infer from devices."""
+    size: int = 1
+
+    def validate(self) -> None:
+        _check(self.size >= -1 and self.size != 0, "dp.size must be -1 or >= 1")
+
+
+@dataclass
+class TPConfig:
+    """Reference: torchacc/config.py:149-161 (GSPMD mark_sharding TP)."""
+    size: int = 1
+
+    def validate(self) -> None:
+        _check(self.size >= 1, "tp.size must be >= 1")
+
+
+@dataclass
+class FSDPConfig:
+    """Reference: ``FSDPConfig`` torchacc/config.py:224-270.
+
+    ``wrap_layer_cls`` / ``flatten_parameters`` are torch-FSDP mechanics that
+    do not exist under GSPMD — parameter sharding is a NamedSharding rule set
+    (see parallel/sharding.py); ``min_weight_size`` keeps small params
+    replicated the way torch-FSDP leaves small modules unwrapped.
+    """
+    size: int = 1
+    min_weight_size: int = 2 ** 12   # params smaller than this stay replicated
+    shard_axis_rules: Optional[List[Tuple[str, Any]]] = None  # extra rule overrides
+
+    def validate(self) -> None:
+        _check(self.size >= 1, "fsdp.size must be >= 1")
+
+
+@dataclass
+class PPConfig:
+    """Reference: ``PPConfig`` torchacc/config.py:164-221 (split points,
+    micro-batches, 1F1B PipeDreamFlush schedule pp/schedule.py:156-227).
+
+    On TPU the pipeline is a single SPMD program: layers are stacked on a
+    stage axis and micro-batches circulate via ``ppermute`` (see
+    parallel/pp.py), so ``split_points`` become a balanced layer partition.
+    """
+    size: int = 1
+    num_micro_batches: int = 1
+    schedule: str = "1f1b"            # '1f1b' | 'gpipe' | 'interleaved'
+    circular_repeats: int = 1         # >1 => circular/looping pipeline
+    broadcast_loss: bool = True
+
+    def validate(self) -> None:
+        _check(self.size >= 1, "pp.size must be >= 1")
+        _check(self.num_micro_batches >= 1, "pp.num_micro_batches must be >= 1")
+        _check(self.schedule in ("1f1b", "gpipe", "interleaved"),
+               f"pp.schedule invalid: {self.schedule}")
+        _check(self.circular_repeats >= 1, "pp.circular_repeats must be >= 1")
+        if self.size > 1:
+            _check(self.num_micro_batches % self.size == 0,
+                   "pp.num_micro_batches must be a multiple of pp.size "
+                   "(steady-state 1F1B with ppermute circulation)")
+
+
+@dataclass
+class SPConfig:
+    """Sequence/context parallelism.
+
+    Reference: ``SPConfig`` torchacc/config.py:273-279 +
+    ``initialize_context_parallel(cp_size, intra_size)``
+    ops/context_parallel/init_group.py:42-91.  ``mode`` selects Ulysses
+    (all-to-all heads), Ring (ppermute kv), or the 2D composition whose
+    intra (Ulysses) group rides ICI and inter (Ring) group rides DCN.
+    """
+    size: int = 1
+    mode: str = "ulysses"             # 'ulysses' | 'ring' | '2d'
+    intra_size: Optional[int] = None  # 2D: Ulysses degree (ICI); ring = size/intra
+
+    def validate(self) -> None:
+        _check(self.size >= 1, "sp.size must be >= 1")
+        _check(self.mode in ("ulysses", "ring", "2d"), f"sp.mode invalid: {self.mode}")
+        if self.mode == "2d":
+            _check(self.intra_size is not None and self.intra_size >= 1,
+                   "sp.intra_size required for 2d mode")
+            _check(self.size % self.intra_size == 0,
+                   "sp.size must be divisible by sp.intra_size")
+
+
+@dataclass
+class EPConfig:
+    """Expert parallelism for MoE (beyond the reference — SURVEY.md §2.3 notes
+    the reference has no EP; the all-to-all primitive cp/utils.py:262-299 is
+    the building block it would use)."""
+    size: int = 1
+    capacity_factor: float = 1.25
+
+    def validate(self) -> None:
+        _check(self.size >= 1, "ep.size must be >= 1")
+        _check(self.capacity_factor > 0, "ep.capacity_factor must be > 0")
+
+
+@dataclass
+class DistConfig:
+    """Parallelism composition + topology ordering.
+
+    Reference: ``DistConfig`` torchacc/config.py:282-336.  ``topology``
+    orders mesh axes slowest-network-first (DCN -> ICI): the reference's
+    intra-node axes map to ICI-adjacent axes here.  ``dp.size = -1`` is
+    inferred as world/(pp*fsdp*sp*ep*tp) (reference config.py:320-324).
+    """
+    dp: DPConfig = field(default_factory=DPConfig)
+    tp: TPConfig = field(default_factory=TPConfig)
+    fsdp: FSDPConfig = field(default_factory=FSDPConfig)
+    pp: PPConfig = field(default_factory=PPConfig)
+    sp: SPConfig = field(default_factory=SPConfig)
+    ep: EPConfig = field(default_factory=EPConfig)
+    # Slowest -> fastest network. Must be a permutation of MESH_AXES.
+    topology: Tuple[str, ...] = MESH_AXES
+    # Number of DCN-connected slices (multi-pod); axes whose extent exceeds
+    # a slice ride DCN. 1 = single slice, everything on ICI.
+    num_slices: int = 1
+
+    def validate(self) -> None:
+        for sub in (self.dp, self.tp, self.fsdp, self.pp, self.sp, self.ep):
+            sub.validate()
+        _check(tuple(sorted(self.topology)) == tuple(sorted(MESH_AXES)),
+               f"dist.topology must be a permutation of {MESH_AXES}, got {self.topology}")
+        _check(self.num_slices >= 1, "dist.num_slices must be >= 1")
+
+    def axis_sizes(self, world_size: int) -> Dict[str, int]:
+        """Resolve every axis size, inferring dp when dp.size == -1."""
+        sizes = {
+            "tp": self.tp.size,
+            "fsdp": self.fsdp.size,
+            "pp": self.pp.size,
+            "sp": self.sp.size,
+            "ep": self.ep.size,
+        }
+        fixed = math.prod(sizes.values())
+        if self.dp.size == -1:
+            _check(world_size % fixed == 0,
+                   f"world size {world_size} not divisible by pp*fsdp*sp*ep*tp={fixed}")
+            sizes["dp"] = world_size // fixed
+        else:
+            sizes["dp"] = self.dp.size
+        total = math.prod(sizes.values())
+        _check(total == world_size,
+               f"product of parallel sizes {total} != device count {world_size} "
+               f"(sizes={sizes})")
+        return sizes
+
+
+@dataclass
+class Config:
+    """Top-level config (reference: ``Config`` torchacc/config.py:340-444).
+
+    The reference's ``backend='lazy'|'eager'`` switch collapses away: JAX has
+    exactly one execution model (trace once under jit, run compiled).
+    """
+    compute: ComputeConfig = field(default_factory=ComputeConfig)
+    memory: MemoryConfig = field(default_factory=MemoryConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    dist: DistConfig = field(default_factory=DistConfig)
+    seed: int = 0
+
+    _mesh: Any = field(default=None, repr=False, compare=False)
+
+    def validate(self) -> None:
+        self.compute.validate()
+        self.memory.validate()
+        self.data.validate()
+        self.dist.validate()
+
+    # -- mesh ---------------------------------------------------------------
+    def get_mesh(self, devices: Optional[Sequence[Any]] = None):
+        """Lazily build the device mesh (reference: ``Config.get_mesh``
+        torchacc/config.py:389-413 lazily initialises process groups + Mesh).
+        """
+        if self._mesh is None:
+            from torchacc_tpu.parallel.mesh import build_mesh
+            self._mesh = build_mesh(self.dist, devices=devices)
+        return self._mesh
+
+    # -- (de)serialisation --------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        def _clean(obj):
+            if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+                return {
+                    f.name: _clean(getattr(obj, f.name))
+                    for f in dataclasses.fields(obj)
+                    if not f.name.startswith("_")
+                }
+            if isinstance(obj, (list, tuple)):
+                return [_clean(v) for v in obj]
+            if isinstance(obj, dict):
+                return {k: _clean(v) for k, v in obj.items()}
+            return obj
+        return _clean(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        def _build(tp, val, path):
+            if dataclasses.is_dataclass(tp) and isinstance(val, dict):
+                names = {f.name for f in dataclasses.fields(tp)
+                         if not f.name.startswith("_")}
+                unknown = set(val) - names
+                _check(not unknown,
+                       f"unknown config key(s) {sorted(unknown)} at {path or '<root>'}; "
+                       f"valid keys: {sorted(names)}")
+                kwargs = {}
+                for f in dataclasses.fields(tp):
+                    if f.name.startswith("_") or f.name not in val:
+                        continue
+                    sub = _TYPE_MAP.get(f.name)
+                    if sub is not None and isinstance(val[f.name], dict):
+                        kwargs[f.name] = _build(sub, val[f.name], f"{path}{f.name}.")
+                    else:
+                        v = val[f.name]
+                        if f.name == "topology" and isinstance(v, list):
+                            v = tuple(v)
+                        kwargs[f.name] = v
+                return tp(**kwargs)
+            return val
+        cfg = _build(cls, d, "")
+        cfg.validate()
+        return cfg
+
+
+_TYPE_MAP = {
+    "compute": ComputeConfig,
+    "memory": MemoryConfig,
+    "data": DataConfig,
+    "dist": DistConfig,
+    "dp": DPConfig,
+    "tp": TPConfig,
+    "fsdp": FSDPConfig,
+    "pp": PPConfig,
+    "sp": SPConfig,
+    "ep": EPConfig,
+}

@@ -1,0 +1,4 @@
+"""Ops: attention kernels and context-parallel attention algorithms.
+
+Reference layer: torchacc/ops/* (SURVEY.md §2 #24-31).
+"""

@@ -1,0 +1,28 @@
+"""Parallelism: mesh construction, sharding rules, and the strategy layers.
+
+Reference layer: torchacc/dist/* (SURVEY.md §2 #9-21).  Under JAX the
+"strategies" are mostly sharding-rule rows (see sharding.py); pipeline and
+context parallelism have real algorithmic modules (pp.py, ops/context_parallel).
+"""
+
+from torchacc_tpu.parallel.mesh import build_mesh, describe_mesh, mesh_axis_size
+from torchacc_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    batch_spec,
+    constraint,
+    make_rules,
+    spec_for,
+    tree_shardings,
+)
+
+__all__ = [
+    "build_mesh",
+    "describe_mesh",
+    "mesh_axis_size",
+    "DEFAULT_RULES",
+    "batch_spec",
+    "constraint",
+    "make_rules",
+    "spec_for",
+    "tree_shardings",
+]

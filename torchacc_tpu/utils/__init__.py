@@ -1,0 +1,3 @@
+from torchacc_tpu.utils.logger import logger
+
+__all__ = ["logger"]
