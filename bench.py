@@ -1,0 +1,111 @@
+"""Headline benchmark: decoder-LM training throughput + MFU on real TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: model flops utilisation (MFU) of a bf16 Llama-style causal-LM
+train step on the available TPU chip(s).  vs_baseline is measured MFU
+against the driver's north star of 50% MFU (BASELINE.md: Llama-3-8B FSDP
+>= 50% MFU target; the reference's own headline is 4044.8 tokens/s/GPU
+on 8xA100 == ~62% MFU equivalent).
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOPs/s per chip by TPU generation
+_PEAK = {
+    "v5 lite": 197e12,  # v5e
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, get_preset
+    from torchacc_tpu.train import accelerate
+
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+
+    # ~350M-param Llama-architecture model: big enough for meaningful MXU
+    # utilisation, small enough for one v5e chip with Adam in f32.
+    seq = 2048
+    batch = 4
+    mc = get_preset(
+        "llama-tiny",
+        hidden_size=1024, num_layers=24, num_heads=16, num_kv_heads=16,
+        intermediate_size=4096, vocab_size=32000, max_seq_len=seq,
+    )
+    cfg = ta.Config()
+    cfg.memory.gc = True
+    cfg.memory.gc_policy = "dots_with_no_batch_dims"
+
+    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
+    trainer.init()
+
+    rng = np.random.default_rng(0)
+    batch_data = {
+        "input_ids": jnp.asarray(
+            rng.integers(0, mc.vocab_size, size=(batch, seq)), jnp.int32)
+    }
+
+    # warmup (compile); float() forces a full device sync — more reliable
+    # than block_until_ready over remote-execution transports
+    for _ in range(3):
+        m = trainer.step(batch_data)
+    float(m["loss"])
+
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = trainer.step(batch_data)
+    float(m["loss"])
+    dt = (time.perf_counter() - t0) / iters
+
+    n_params = mc.num_params()
+    tokens = batch * seq
+    tokens_per_sec = tokens / dt
+    # PaLM-style MFU flops: 6N per token + causal attention 6*L*hidden*seq
+    # (12*L*hidden*seq halved for causality), fwd+bwd included in the 6x.
+    flops_per_token = 6.0 * n_params + 6.0 * mc.num_layers * mc.hidden_size * seq
+    mfu = flops_per_token * tokens / dt / (peak_flops(dev) * n_chips)
+
+    result = {
+        "metric": "llama350m_train_mfu",
+        "value": round(float(mfu), 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(float(mfu) / 0.50, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec / n_chips, 1),
+            "step_time_s": round(dt, 4),
+            "params_m": round(n_params / 1e6, 1),
+            "seq": seq,
+            "batch": batch,
+            "chip": getattr(dev, "device_kind", str(dev)),
+            "n_chips": n_chips,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,7 @@ optimizers run inside the compiled program (no syncfree variants needed).
 
 __version__ = "0.1.0"
 
-from torchacc_tpu import ops, parallel
+from torchacc_tpu import data, models, ops, parallel
 from torchacc_tpu.config import (
     ComputeConfig,
     Config,

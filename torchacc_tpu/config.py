@@ -143,8 +143,9 @@ class DataConfig:
 
 @dataclass
 class DPConfig:
-    """Reference: torchacc/config.py:130-146. ``size=-1`` = infer from devices."""
-    size: int = 1
+    """Reference: torchacc/config.py:130-146.  ``size=-1`` (default) infers
+    dp as world/(pp*fsdp*sp*ep*tp), mirroring config.py:320-324."""
+    size: int = -1
 
     def validate(self) -> None:
         _check(self.size >= -1 and self.size != 0, "dp.size must be -1 or >= 1")
@@ -302,6 +303,9 @@ class Config:
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     data: DataConfig = field(default_factory=DataConfig)
     dist: DistConfig = field(default_factory=DistConfig)
+    # Gradient accumulation micro-steps per optimizer step (non-PP path;
+    # under PP the pipeline's num_micro_batches plays this role).
+    grad_accum: int = 1
     seed: int = 0
 
     _mesh: Any = field(default=None, repr=False, compare=False)
@@ -311,6 +315,7 @@ class Config:
         self.memory.validate()
         self.data.validate()
         self.dist.validate()
+        _check(self.grad_accum >= 1, "grad_accum must be >= 1")
 
     # -- mesh ---------------------------------------------------------------
     def get_mesh(self, devices: Optional[Sequence[Any]] = None):

@@ -1,0 +1,4 @@
+from torchacc_tpu.data.async_loader import AsyncLoader
+from torchacc_tpu.data.bucketing import closest_bucket, pad_batch
+
+__all__ = ["AsyncLoader", "closest_bucket", "pad_batch"]

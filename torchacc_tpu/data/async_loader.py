@@ -1,0 +1,101 @@
+"""Async host->device input feed with bucketing.
+
+Reference: ``AsyncLoader`` (core/async_loader.py:159-207) wraps any
+DataLoader in background worker threads that bucket, pad, and upload
+batches ahead of compute.  TPU-native version: a producer thread buckets
+and pads on host, then ``jax.device_put`` with the batch NamedSharding
+starts the (async) transfer; a bounded queue of in-flight device batches
+gives double buffering so step N+1's upload overlaps step N's compute.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, Iterable, Iterator, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from torchacc_tpu.config import Config
+from torchacc_tpu.data.bucketing import pad_batch
+from torchacc_tpu.parallel.sharding import batch_spec
+from torchacc_tpu.utils.logger import logger
+
+_SENTINEL = object()
+
+
+class AsyncLoader:
+    """Wrap an iterable of dict-of-arrays into an async sharded device feed.
+
+    Iterating yields pytrees of committed jax.Arrays laid out with the
+    batch sharding (batch dim over data axes, seq dim over 'sp').
+    """
+
+    def __init__(
+        self,
+        loader: Iterable[Dict[str, Any]],
+        config: Config,
+        mesh: Optional[Mesh] = None,
+        sharding: Optional[NamedSharding] = None,
+    ):
+        self._loader = loader
+        self._config = config
+        mesh = mesh if mesh is not None else config.get_mesh()
+        if sharding is None:
+            sharding = NamedSharding(mesh, batch_spec(config))
+        self._sharding = sharding
+        self._buckets = config.data.bucket_sizes()
+        self._pad_values = config.data.pad_value_dict
+        self._prefetch = max(1, config.data.prefetch)
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        q: queue.Queue = queue.Queue(maxsize=self._prefetch)
+        err: list = []
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            # Bounded put that gives up when the consumer is gone, so an
+            # early `break` in the training loop can't leak a thread
+            # pinning device batches forever.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self._loader:
+                    if stop.is_set():
+                        return
+                    host = pad_batch(batch, self._buckets, self._pad_values)
+                    # device_put is async: the DMA overlaps compute, and the
+                    # bounded queue caps in-flight batches (double buffer).
+                    dev = {k: jax.device_put(v, self._sharding)
+                           for k, v in host.items()}
+                    if not _put(dev):
+                        return
+            except Exception as e:  # surface in the consumer thread
+                err.append(e)
+                logger.error(f"AsyncLoader producer failed: {e}")
+            finally:
+                _put(_SENTINEL)
+
+        t = threading.Thread(target=produce, daemon=True, name="async-loader")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    return
+                yield item
+        finally:
+            stop.set()
+
+    def __len__(self) -> int:
+        return len(self._loader)  # type: ignore[arg-type]

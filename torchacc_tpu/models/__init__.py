@@ -1,0 +1,13 @@
+from torchacc_tpu.models.axes import TRANSFORMER_AXES, param_axes
+from torchacc_tpu.models.presets import PRESETS, get_preset
+from torchacc_tpu.models.transformer import ModelConfig, TransformerLM, loss_fn
+
+__all__ = [
+    "ModelConfig",
+    "TransformerLM",
+    "loss_fn",
+    "param_axes",
+    "TRANSFORMER_AXES",
+    "PRESETS",
+    "get_preset",
+]

@@ -1,0 +1,69 @@
+"""Parameter-path -> logical-axes resolution.
+
+The reference marks shardings imperatively on live torch tensors
+(``xs.mark_sharding`` tp.py:1-5, FSDP auto-wrap by layer-class name
+fsdp.py:218-230).  Here sharding metadata is data: a regex table from
+flax parameter paths to logical axis tuples, resolved once against the
+abstract parameter tree.  This works uniformly for our model zoo and for
+HF-ingested checkpoints, with no monkeypatching.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+AxesRule = Tuple[str, Tuple[Optional[str], ...]]
+
+# First match wins.  Paths are '/'-joined flax param paths; scan-stacked
+# layer params carry a leading 'layers' dim.
+TRANSFORMER_AXES: Tuple[AxesRule, ...] = (
+    (r"embed_tokens/embedding$", ("vocab", "embed")),
+    (r"pos_embed$", (None, "embed")),
+    (r"(q_proj|k_proj|v_proj)/kernel$", ("embed", "heads", "kv")),
+    (r"(q_proj|k_proj|v_proj)/bias$", ("heads", "kv")),
+    (r"o_proj/kernel$", ("heads", "kv", "embed")),
+    (r"(gate_proj|up_proj)/kernel$", ("embed", "mlp")),
+    (r"down_proj/kernel$", ("mlp", "embed")),
+    (r"router/kernel$", ("embed", "expert")),
+    (r"experts/(gate|up)$", ("expert", "embed", "expert_mlp")),
+    (r"experts/down$", ("expert", "expert_mlp", "embed")),
+    (r"(ln1|ln2|final_norm)/(scale|bias)$", ("norm",)),
+    (r"lm_head/kernel$", ("embed", "vocab")),
+)
+
+
+def param_axes(
+    params: Any,
+    rules: Sequence[AxesRule] = TRANSFORMER_AXES,
+    extra_leading: Tuple[str, ...] = ("layers",),
+) -> Any:
+    """Resolve a logical-axes pytree matching ``params``.
+
+    A leaf whose ndim exceeds its rule's length by k gets the first k
+    names of ``extra_leading`` prepended (scan-over-layers stacking).
+    Unmatched paths raise — silent replication of a large tensor is a
+    memory bug, not a default.
+    """
+    compiled = [(re.compile(pat), axes) for pat, axes in rules]
+
+    def one(path, leaf):
+        if leaf is None:
+            return None
+        pstr = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in path)
+        for pat, axes in compiled:
+            if pat.search(pstr):
+                missing = leaf.ndim - len(axes)
+                if missing < 0 or missing > len(extra_leading):
+                    raise ValueError(
+                        f"axes rule {axes} does not fit param {pstr} with "
+                        f"shape {leaf.shape}")
+                return tuple(extra_leading[:missing]) + tuple(axes)
+        raise ValueError(
+            f"no logical-axes rule matches param {pstr!r} (shape "
+            f"{getattr(leaf, 'shape', '?')}); extend the rules table")
+
+    return jax.tree_util.tree_map_with_path(one, params)

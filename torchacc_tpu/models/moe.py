@@ -1,0 +1,70 @@
+"""Mixture-of-experts MLP with expert parallelism.
+
+Beyond the reference: TorchAcc has no MoE/EP implementation (SURVEY.md
+§2.3 — its differentiable all-to-all cp/utils.py:262-299 is the building
+block it would need).  Here experts live on an 'expert' logical axis
+(sharded over the 'ep' mesh axis); token routing uses a dense
+dispatch/combine einsum formulation, which GSPMD lowers to all-to-alls
+across 'ep' automatically — the idiomatic TPU MoE (switch-transformer
+style) rather than a hand-written NCCL a2a.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMlp(nn.Module):
+    """Top-k token-choice MoE with capacity-free dense dispatch.
+
+    For modest expert counts the dense formulation (every token scored
+    against every expert, weighted-combined with a top-k mask) is both
+    exactly correct (no token dropping) and MXU-friendly.  A capacity-
+    based sparse path can replace it without changing the interface.
+    """
+    cfg: object  # ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e = cfg.num_experts
+        k = cfg.num_experts_per_tok
+        h = cfg.hidden_size
+        f = cfg.ffn_size
+        b, s, _ = x.shape
+
+        router = nn.Dense(e, use_bias=False, name="router",
+                          dtype=jnp.float32, param_dtype=cfg.param_dtype,
+                          kernel_init=nn.initializers.normal(0.02))
+        logits = router(x.astype(jnp.float32))            # [b, s, e]
+        weights, sel = jax.lax.top_k(logits, k)           # [b, s, k]
+        weights = jax.nn.softmax(weights, axis=-1)
+        # [b, s, e] combine weights (zero for unselected experts)
+        combine = jnp.sum(
+            jax.nn.one_hot(sel, e, dtype=jnp.float32) * weights[..., None],
+            axis=-2)
+
+        init = nn.initializers.normal(0.02)
+        w_gate = self.param("experts/gate", init, (e, h, f), cfg.param_dtype)
+        w_up = self.param("experts/up", init, (e, h, f), cfg.param_dtype)
+        w_down = self.param("experts/down", init, (e, f, h), cfg.param_dtype)
+
+        xd = x.astype(cfg.dtype)
+        # Dense per-expert compute; GSPMD shards the 'e' dim over the ep
+        # mesh axis, turning these einsums into expert-parallel work.
+        gate = jnp.einsum("bsh,ehf->ebsf", xd, w_gate.astype(cfg.dtype))
+        up = jnp.einsum("bsh,ehf->ebsf", xd, w_up.astype(cfg.dtype))
+        act = nn.silu(gate) * up
+        out = jnp.einsum("ebsf,efh->ebsh", act, w_down.astype(cfg.dtype))
+        y = jnp.einsum("ebsh,bse->bsh", out.astype(jnp.float32), combine)
+
+        # Load-balancing auxiliary loss (switch-style) exposed via sow.
+        probs = jax.nn.softmax(logits, axis=-1)
+        frac_tokens = jnp.mean(
+            jax.nn.one_hot(sel[..., 0], e, dtype=jnp.float32), axis=(0, 1))
+        frac_probs = jnp.mean(probs, axis=(0, 1))
+        self.sow("intermediates", "moe_aux_loss",
+                 e * jnp.sum(frac_tokens * frac_probs))
+        return y.astype(cfg.dtype)

@@ -1,0 +1,83 @@
+"""Model-zoo presets covering the reference's benchmark targets
+(BASELINE.json configs: tiny GPT, Llama-3-8B, Llama-3-70B, Qwen-7B,
+Mixtral-8x7B)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from torchacc_tpu.models.transformer import ModelConfig
+
+
+def gpt2_tiny(**kw) -> ModelConfig:
+    """The reference's tiny-GPT benchmark model (benchmarks/transformer.py
+    --nlayer etc.)."""
+    defaults = dict(vocab_size=50257, hidden_size=256, num_layers=4, num_heads=8,
+        max_seq_len=512, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True, rope_theta=10000.0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def gpt2(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+        max_seq_len=1024, pos_emb="learned", norm="layernorm",
+        activation="gelu", tie_embeddings=True, rope_theta=10000.0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def llama_tiny(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=32000, hidden_size=256, num_layers=4, num_heads=8,
+        num_kv_heads=4, intermediate_size=688, max_seq_len=2048)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def llama3_8b(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, max_seq_len=8192,
+        rope_theta=500000.0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def llama3_70b(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=128256, hidden_size=8192, num_layers=80, num_heads=64,
+        num_kv_heads=8, intermediate_size=28672, max_seq_len=8192,
+        rope_theta=500000.0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def qwen2_7b(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28,
+        num_kv_heads=4, intermediate_size=18944, max_seq_len=32768,
+        qkv_bias=True, rope_theta=1000000.0)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def mixtral_8x7b(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+        num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
+        rope_theta=1000000.0, num_experts=8, num_experts_per_tok=2)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+PRESETS = {
+    "gpt2-tiny": gpt2_tiny,
+    "gpt2": gpt2,
+    "llama-tiny": llama_tiny,
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+    "qwen2-7b": qwen2_7b,
+    "mixtral-8x7b": mixtral_8x7b,
+}
+
+
+def get_preset(name: str, **kw) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name](**kw)

@@ -1,0 +1,285 @@
+"""Decoder-only transformer LM family (flax.linen).
+
+The reference accelerates existing torch models (GPT-2 via HF CLM
+benchmarks/transformer.py:33-220, Llama/Qwen via transformers patches
+utils/patch.py:224-301, qwen_patch.py).  The TPU-native framework ships
+its own model zoo instead of monkeypatching: one configurable module
+covers the GPT-2 class (learned positions, LayerNorm, GELU) and the
+Llama/Qwen class (RoPE, RMSNorm, SwiGLU, GQA, optional qkv bias).
+HF-trained weights are ingested by the converter in models/hf.py.
+
+Layers are stacked with ``nn.scan`` (single compiled block, layer dim on
+every param) — this keeps compile time O(1) in depth and gives pipeline
+parallelism a natural stage-stacked layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torchacc_tpu.ops.attn import attention
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None      # None = MHA; < num_heads = GQA
+    head_dim: Optional[int] = None          # None = hidden/heads
+    intermediate_size: Optional[int] = None  # None = 4x hidden (gelu) / llama rule
+    max_seq_len: int = 2048
+    pos_emb: str = "rope"                   # 'rope' | 'learned'
+    norm: str = "rmsnorm"                   # 'rmsnorm' | 'layernorm'
+    activation: str = "swiglu"              # 'swiglu' | 'gelu'
+    qkv_bias: bool = False                  # Qwen2 style
+    tie_embeddings: bool = False
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16               # activation dtype
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False                     # remat each block (memory.gc)
+    remat_policy: str = "nothing"           # see utils/remat.py
+    attention_impl: str = "auto"
+    window: Tuple[int, int] = (-1, -1)      # sliding-window attention
+    # MoE (0 = dense). See models/moe.py.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    router_aux_weight: float = 0.01   # switch-style load-balance loss weight
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_size(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        return 4 * self.hidden_size
+
+    def num_params(self) -> int:
+        """Analytic parameter count (for MFU math)."""
+        h, v = self.hidden_size, self.vocab_size
+        d = self.head_size
+        emb = v * h + (self.max_seq_len * h if self.pos_emb == "learned" else 0)
+        attn = h * (self.num_heads * d) + h * (2 * self.kv_heads * d) \
+            + (self.num_heads * d) * h
+        if self.qkv_bias:
+            attn += (self.num_heads + 2 * self.kv_heads) * d
+        if self.activation == "swiglu":
+            mlp = 3 * h * self.ffn_size
+        else:
+            mlp = 2 * h * self.ffn_size
+        if self.num_experts > 0:
+            mlp = mlp * self.num_experts + h * self.num_experts
+        norm_size = 2 * h if self.norm == "layernorm" else h
+        norms = (2 * self.num_layers + 1) * norm_size
+        out = 0 if self.tie_embeddings else v * h
+        return emb + self.num_layers * (attn + mlp) + norms + out
+
+
+def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
+          theta: float) -> Tuple[jax.Array, jax.Array]:
+    """Rotary embeddings, llama convention (half-split, not interleaved —
+    matches HF transformers so converted weights agree)."""
+    d = q.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+class Norm(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        xf = x.astype(jnp.float32)
+        if cfg.norm == "rmsnorm":
+            scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                               cfg.param_dtype)
+            y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
+                                   + cfg.norm_eps)
+            return (y * scale.astype(jnp.float32)).astype(cfg.dtype)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           cfg.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],),
+                          cfg.param_dtype)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+        return (y * scale.astype(jnp.float32)
+                + bias.astype(jnp.float32)).astype(cfg.dtype)
+
+
+class Attention(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        d = cfg.head_size
+        dense = lambda name, heads: nn.DenseGeneral(
+            features=(heads, d), use_bias=cfg.qkv_bias, name=name,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02))
+        q = dense("q_proj", cfg.num_heads)(x)
+        k = dense("k_proj", cfg.kv_heads)(x)
+        v = dense("v_proj", cfg.kv_heads)(x)
+        if cfg.pos_emb == "rope":
+            q, k = _rope(q, k, positions, cfg.rope_theta)
+        out = attention(q, k, v, causal=True, window=cfg.window,
+                        q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+                        impl=cfg.attention_impl)
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+            name="o_proj", dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02))(out)
+        return out
+
+
+class Mlp(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = lambda name, feat: nn.Dense(
+            feat, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02))
+        if cfg.activation == "swiglu":
+            gate = dense("gate_proj", cfg.ffn_size)(x)
+            up = dense("up_proj", cfg.ffn_size)(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.gelu(dense("up_proj", cfg.ffn_size)(x))
+        return dense("down_proj", cfg.hidden_size)(h)
+
+
+class Block(nn.Module):
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            Norm(cfg, name="ln1")(x), positions, segment_ids)
+        if cfg.num_experts > 0:
+            from torchacc_tpu.models.moe import MoEMlp
+            mlp_out = MoEMlp(cfg, name="moe")(Norm(cfg, name="ln2")(h))
+        else:
+            mlp_out = Mlp(cfg, name="mlp")(Norm(cfg, name="ln2")(h))
+        return h + mlp_out
+
+
+class ScanBlock(nn.Module):
+    """Block adapted to nn.scan's (carry, _) -> (carry, out) signature."""
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions, segment_ids = carry
+        x = Block(self.cfg, name="block")(x, positions, segment_ids)
+        return (x, positions, segment_ids), None
+
+
+class TransformerLM(nn.Module):
+    """The LM.  ``__call__(input_ids, positions?, segment_ids?) -> logits``.
+
+    positions default to arange; segment_ids enable packed sequences
+    (reference varlen-by-position-ids path ops/flash_attn.py:173-216).
+    """
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        cfg = self.cfg
+        b, s = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       embedding_init=nn.initializers.normal(0.02))
+        x = emb(input_ids)
+        if cfg.pos_emb == "learned":
+            pos_table = self.param(
+                "pos_embed", nn.initializers.normal(0.02),
+                (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+            x = x + pos_table.astype(cfg.dtype)[positions]
+
+        block_cls = ScanBlock
+        if cfg.remat:
+            from torchacc_tpu.utils.remat import remat_policy
+            block_cls = nn.remat(
+                ScanBlock, policy=remat_policy(cfg.remat_policy),
+                prevent_cse=False)
+        if cfg.scan_layers:
+            (x, _, _), _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True},
+                length=cfg.num_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")((x, positions, segment_ids), None)
+        else:
+            for i in range(cfg.num_layers):
+                (x, positions, segment_ids), _ = block_cls(
+                    cfg, name=f"layers_{i}")((x, positions, segment_ids), None)
+
+        x = Norm(cfg, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = emb.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+                              dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                              kernel_init=nn.initializers.normal(0.02))(x)
+        return logits.astype(jnp.float32)
+
+
+def loss_sum_count(logits: jax.Array, labels: jax.Array,
+                   loss_mask: Optional[jax.Array] = None
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Next-token cross entropy: (sum over valid tokens, valid count).
+
+    -100 labels are ignored (HF convention the reference benchmarks rely
+    on).  Returning sum+count separately lets gradient accumulation
+    weight micro-batches by token count — exact big-batch equivalence
+    even when padding makes counts uneven.
+    """
+    valid = labels != -100
+    if loss_mask is not None:
+        valid = valid & (loss_mask != 0)
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    total = jnp.sum(jnp.where(valid, -token_ll, 0.0))
+    count = jnp.sum(valid).astype(jnp.float32)
+    return total, count
+
+
+def loss_fn(logits: jax.Array, labels: jax.Array,
+            loss_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean next-token cross entropy (see loss_sum_count)."""
+    total, count = loss_sum_count(logits, labels, loss_mask)
+    return total / jnp.maximum(count, 1.0)

@@ -1,0 +1,124 @@
+"""Reference (pure-XLA) scaled-dot-product attention with LSE output.
+
+This is the numerically trusted baseline that the Pallas flash-attention
+kernel (ops/flash_attention.py) is tested against, mirroring how the
+reference tests its XLA flash ops against upstream ``flash_attn`` CUDA
+outputs (tests/ops/test_flash_attn.py:41-100).  It is also the fallback
+``attention_impl='xla'`` path and the building block the context-parallel
+algorithms reuse for their per-step partial attentions: every entry point
+here can return the log-sum-exp over keys, which is what Ring attention
+needs to merge partial results (reference `_update_out_and_lse`
+ops/context_parallel/utils.py:302-343).
+
+Conventions: q/k/v are [batch, seq, heads, head_dim] ("BSHD", matching the
+reference flash-attn layout ops/flash_attn.py:386-432). GQA/MQA supported
+via num_q_heads % num_kv_heads == 0. Segment ids implement varlen packing
+(the TPU-native equivalent of cu_seqlens/position_ids varlen).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Broadcast kv heads to q heads for GQA/MQA (reference documents
+    GQA/MQA support at ops/flash_attn.py:395-399)."""
+    num_kv = k.shape[2]
+    if num_kv == num_q_heads:
+        return k
+    assert num_q_heads % num_kv == 0, (num_q_heads, num_kv)
+    return jnp.repeat(k, num_q_heads // num_kv, axis=2)
+
+
+def make_attention_mask(
+    q_len: int,
+    kv_len: int,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    q_offset: int = 0,
+    dtype=jnp.bool_,
+) -> jax.Array:
+    """Boolean [.., q_len, kv_len] mask: True = attend.
+
+    ``window=(left, right)`` is the reference's sliding-window
+    ``window_size`` argument (ops/flash_attn.py:406-409): -1 = unbounded.
+    ``q_offset`` shifts query positions (used by ring attention, where the
+    local q block sits at a global offset).
+    """
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    mask = jnp.ones((q_len, kv_len), dtype=jnp.bool_)
+    if causal:
+        mask &= q_pos >= kv_pos
+    left, right = window
+    if left >= 0:
+        mask &= kv_pos >= q_pos - left
+    if right >= 0:
+        mask &= kv_pos <= q_pos + right
+    if q_segment_ids is not None:
+        seg = q_segment_ids[..., :, None] == kv_segment_ids[..., None, :]
+        mask = mask & seg
+    return mask.astype(dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "window", "scale", "return_lse", "q_offset"),
+)
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    q_offset: int = 0,
+    return_lse: bool = False,
+):
+    """Plain-XLA attention.  Returns ``out`` or ``(out, lse)``.
+
+    ``lse`` is [batch, heads, q_len] in float32, natural log base — the
+    same contract as the reference kernels' softmax_lse output
+    (ops/flash_attn.py:60-63), enabling CP merging.
+    """
+    orig_dtype = q.dtype
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    k = _repeat_kv(k, hq)
+    v = _repeat_kv(v, hq)
+    # [b, h, sq, sk] scores in f32 for a stable softmax
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    mask = make_attention_mask(
+        sq, sk, causal=causal, window=window,
+        q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+        q_offset=q_offset)
+    if mask.ndim == 3:  # [b, q, k] from segment ids
+        mask = mask[:, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.nn.logsumexp(scores, axis=-1)  # [b, h, q]
+    probs = jnp.exp(scores - lse[..., None])
+    # Fully-masked rows (padding queries): output zeros, lse = -inf-ish.
+    probs = jnp.where(mask, probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    out = out.astype(orig_dtype)
+    if return_lse:
+        return out, lse
+    return out

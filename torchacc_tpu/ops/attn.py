@@ -1,0 +1,65 @@
+"""Attention front door: impl dispatch (reference analogue: the SDPA swap
+ops/scaled_dot_product_attention.py:7-20 + `flash_attention` dual-backend
+dispatch in ops/context_parallel/utils.py:60-137).
+
+``impl``:
+  - 'auto'   : Pallas kernel on TPU, reference XLA attention elsewhere
+  - 'pallas' : force the Pallas flash kernel (interpret mode off-TPU)
+  - 'xla'    : force the plain-XLA reference attention
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+
+from torchacc_tpu.ops.attention import attention_reference
+
+_warned_fallback = False
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    impl: str = "auto",
+    return_lse: bool = False,
+):
+    """[b, s, h, d] attention with optional LSE output."""
+    forced = impl == "pallas"
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "pallas":
+        try:
+            from torchacc_tpu.ops.flash_attention import flash_attention
+            return flash_attention(
+                q, k, v, causal=causal, window=window, scale=scale,
+                q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+                return_lse=return_lse)
+        except ImportError:
+            if forced:
+                raise
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                from torchacc_tpu.utils.logger import logger
+                logger.warning("Pallas flash-attention kernel unavailable; "
+                               "falling back to plain-XLA attention")
+    return attention_reference(
+        q, k, v, causal=causal, window=window, scale=scale,
+        q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
+        return_lse=return_lse)

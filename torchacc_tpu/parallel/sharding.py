@@ -55,6 +55,8 @@ DEFAULT_RULES: LogicalRules = (
     ("expert_mlp", "tp"),
     ("stage", "pp"),
     ("norm", None),
+    # scan-over-layers stacking dim; pp.py re-maps it to 'pp' for pipelining
+    ("layers", None),
 )
 
 

@@ -1,0 +1,65 @@
+"""`accelerate()` — the one-call entry point.
+
+Reference: ``torchacc.accelerate(model, dataloader, config)``
+(accelerate.py:49-149) validates config, initialises the distributed
+backend, wraps the dataloader in an AsyncLoader, applies kernel patches,
+and composes the parallel strategies.  TPU-native: validate → build mesh
+→ build Trainer (sharded init + jitted step; the shardings *are* the
+strategy composition) → wrap the loader.  No patches: kernel selection
+is the model's ``attention_impl`` and the ops dispatch layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+import optax
+
+from torchacc_tpu.config import Config
+from torchacc_tpu.data.async_loader import AsyncLoader
+from torchacc_tpu.models.transformer import ModelConfig, TransformerLM
+from torchacc_tpu.train.trainer import Trainer
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+           "float32": jnp.float32}
+
+
+def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
+    """Fold framework-level compute/memory settings into the model config
+    (the reference does this via patches + wrapper kwargs; here it is a
+    dataclass transform)."""
+    updates = dict(
+        dtype=_DTYPES[config.compute.dtype],
+        param_dtype=_DTYPES[config.compute.param_dtype],
+        attention_impl=(config.compute.attention_impl
+                        if config.compute.flash_attention else "xla"),
+        remat=config.memory.gc,
+        remat_policy=config.memory.gc_policy,
+    )
+    return dataclasses.replace(mc, **updates)
+
+
+def accelerate(
+    model: Any,
+    dataloader: Optional[Iterable] = None,
+    config: Optional[Config] = None,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    **trainer_kwargs,
+) -> Tuple[Trainer, Optional[AsyncLoader]]:
+    """Returns ``(trainer, async_loader)``.
+
+    ``model`` may be a :class:`ModelConfig` (zoo model is built for you)
+    or any flax Module following the ``(input_ids, positions, segment_ids)``
+    call convention.
+    """
+    config = config or Config()
+    config.validate()
+    if isinstance(model, ModelConfig):
+        model = TransformerLM(apply_config_to_model(model, config))
+    trainer = Trainer(model, config, optimizer=optimizer, **trainer_kwargs)
+    loader = None
+    if dataloader is not None:
+        loader = AsyncLoader(dataloader, config, mesh=trainer.mesh)
+    return trainer, loader

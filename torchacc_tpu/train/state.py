@@ -1,0 +1,74 @@
+"""Train state + sharding resolution for params AND optimizer state.
+
+Reference analogue: FSDP shards optimizer state alongside flattened
+params and reconstructs it through shard_metadata bookkeeping
+(fsdp.py:243-424, state_dict_utils.py).  Under GSPMD the same outcome is
+a sharding rule applied uniformly: optimizer-state leaves inherit the
+logical axes of the parameter they track, found by matching the trailing
+key path (optax state trees embed the params tree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from jax.tree_util import tree_flatten_with_path, tree_map_with_path
+
+
+class TrainState(flax.struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+
+
+def state_logical_axes(abstract_state: TrainState, params_axes: Any) -> TrainState:
+    """Logical-axes tree matching a TrainState.
+
+    Params take ``params_axes`` verbatim.  Each opt_state leaf is matched
+    to a parameter by the longest trailing segment of its key path that
+    equals a parameter's full path; scalars and unmatched leaves are
+    replicated (None axes).
+    """
+    flat_params, _ = tree_flatten_with_path(params_axes,
+                                            is_leaf=lambda x: isinstance(x, tuple))
+    by_path = {_path_str(p): axes for p, axes in flat_params}
+
+    def match(path, leaf):
+        if leaf is None:
+            return None
+        if getattr(leaf, "ndim", 0) == 0:
+            return ()
+        pstr = _path_str(path)
+        parts = pstr.split("/")
+        for i in range(len(parts)):
+            cand = "/".join(parts[i:])
+            axes = by_path.get(cand)
+            if axes is not None and len(axes) == leaf.ndim:
+                return axes
+        return (None,) * leaf.ndim
+
+    opt_axes = tree_map_with_path(match, abstract_state.opt_state)
+    return TrainState(step=(), params=params_axes, opt_state=opt_axes)
+
+
+def init_train_state(
+    rng: jax.Array,
+    model,
+    optimizer,
+    sample_input: Optional[jax.Array] = None,
+) -> TrainState:
+    """Host-side (unsharded) init — used under jit with out_shardings so
+    parameters materialise directly into their shards."""
+    if sample_input is None:
+        sample_input = jnp.zeros((1, 8), dtype=jnp.int32)
+    params = model.init(rng, sample_input)["params"]
+    opt_state = optimizer.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=opt_state)

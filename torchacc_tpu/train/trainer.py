@@ -1,0 +1,210 @@
+"""The Trainer: sharded init + the jitted train step (the hot loop).
+
+Reference hot loop (SURVEY.md §3.3): LazyTensor records IR, DP hooks queue
+all-reduces, `mark_step` cuts and compiles the graph.  TPU-native: ONE
+jitted, donated train-step function whose shardings make XLA insert every
+collective (psum for DP, all-gather/reduce-scatter for FSDP, all-to-all
+for EP) — there is nothing to hook and no graph to cut.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from torchacc_tpu.config import Config
+from torchacc_tpu.models.axes import param_axes as resolve_param_axes
+from torchacc_tpu.models.transformer import loss_sum_count
+from torchacc_tpu.parallel.sharding import (
+    batch_spec,
+    make_rules,
+    tree_shardings,
+)
+from torchacc_tpu.train.state import TrainState, init_train_state, state_logical_axes
+from torchacc_tpu.utils.logger import logger
+
+
+def _flatten_with_names(tree):
+    from jax.tree_util import tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(tree)
+    return [("/".join(str(getattr(k, "key", k)) for k in path), v)
+            for path, v in flat]
+
+
+def shift_labels(input_ids: jax.Array) -> jax.Array:
+    """Next-token labels from input_ids (last position ignored)."""
+    return jnp.concatenate(
+        [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
+
+
+class Trainer:
+    """Builds sharded state and a donated jitted train step.
+
+    Parameters
+    ----------
+    model: a flax Module with ``__call__(input_ids, positions, segment_ids)``
+    optimizer: an optax GradientTransformation (default: adamw(1e-4))
+    config: the framework Config
+    axes_rules: param-path regex rules (models/axes.py) for sharding
+    loss: callable(logits, labels) -> scalar; defaults to CE with -100 skip
+    """
+
+    def __init__(
+        self,
+        model,
+        config: Config,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        axes_rules=None,
+        loss: Optional[Callable] = None,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.model = model
+        self.config = config
+        self.optimizer = optimizer or optax.adamw(1e-4)
+        self.mesh = mesh if mesh is not None else config.get_mesh()
+        self.rules = make_rules(config)
+        self._axes_rules = axes_rules
+        # loss(logits, batch) -> scalar mean OR (sum, valid_count); the
+        # sum/count form gives exact big-batch equivalence under grad accum.
+        self.loss = loss or (lambda logits, batch: loss_sum_count(
+            logits, batch.get("labels", shift_labels(batch["input_ids"]))))
+        self._aux_weight = getattr(getattr(model, "cfg", None),
+                                   "router_aux_weight", 0.0)
+        self.state: Optional[TrainState] = None
+        self.state_shardings = None
+        self.batch_sharding = NamedSharding(self.mesh, batch_spec(config))
+        self._train_step = None
+        self._metrics_sharding = NamedSharding(self.mesh, PartitionSpec())
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng: Optional[jax.Array] = None,
+             sample_input: Optional[jax.Array] = None) -> TrainState:
+        if rng is None:
+            rng = jax.random.PRNGKey(self.config.seed)
+        init_fn = lambda r: init_train_state(
+            r, self.model, self.optimizer, sample_input)
+        abstract = jax.eval_shape(init_fn, rng)
+        p_axes = (resolve_param_axes(abstract.params)
+                  if self._axes_rules is None
+                  else resolve_param_axes(abstract.params, self._axes_rules))
+        st_axes = state_logical_axes(abstract, p_axes)
+        min_sz = self.config.dist.fsdp.min_weight_size
+        self.state_shardings = TrainState(
+            step=NamedSharding(self.mesh, PartitionSpec()),
+            params=tree_shardings(self.mesh, abstract.params, st_axes.params,
+                                  self.rules, min_sz),
+            opt_state=tree_shardings(self.mesh, abstract.opt_state,
+                                     st_axes.opt_state, self.rules, min_sz),
+        )
+        with self.mesh:
+            self.state = jax.jit(
+                init_fn, out_shardings=self.state_shardings)(rng)
+        n_params = sum(x.size for x in jax.tree.leaves(self.state.params))
+        logger.info(f"initialised {n_params/1e6:.1f}M params on mesh "
+                    f"{dict(self.mesh.shape)}")
+        return self.state
+
+    # -- train step ---------------------------------------------------------
+    def _forward_sum_count(self, params, batch):
+        """(loss_sum, token_count) incl. sown auxiliary losses (MoE router
+        load-balance — models/moe.py) weighted per token."""
+        out = self.model.apply(
+            {"params": params}, batch["input_ids"],
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+            mutable=["intermediates"])
+        logits, mutated = out
+        res = self.loss(logits, batch)
+        if isinstance(res, tuple):
+            l_sum, count = res
+        else:
+            l_sum, count = res, jnp.asarray(1.0, jnp.float32)
+        if self._aux_weight:
+            aux = sum(jnp.sum(jnp.asarray(v)) for path, v in
+                      _flatten_with_names(mutated.get("intermediates", {}))
+                      if "aux_loss" in path)
+            l_sum = l_sum + self._aux_weight * aux * count
+        return l_sum, count
+
+    def _build_train_step(self):
+        accum = self.config.grad_accum
+        optimizer = self.optimizer
+        fsc = self._forward_sum_count
+
+        def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+            if accum > 1:
+                bsz = batch["input_ids"].shape[0]
+                if bsz % accum != 0:
+                    raise ValueError(
+                        f"batch size {bsz} not divisible by grad_accum {accum}")
+
+                grad_sum = jax.value_and_grad(fsc, has_aux=True)
+
+                def micro(carry, mb):
+                    g_acc, l_acc, c_acc = carry
+                    (l, c), g = grad_sum(state.params, mb)
+                    return (jax.tree.map(jnp.add, g_acc, g),
+                            l_acc + l, c_acc + c), None
+                mbs = jax.tree.map(
+                    lambda x: x.reshape((accum, x.shape[0] // accum)
+                                        + x.shape[1:]), batch)
+                zeros = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                (grads, loss_sum, count), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.float32)), mbs)
+                denom = jnp.maximum(count, 1.0)
+                grads = jax.tree.map(lambda g: g / denom, grads)
+                loss_val = loss_sum / denom
+            else:
+                def scalar(p):
+                    l, c = fsc(p, batch)
+                    return l / jnp.maximum(c, 1.0)
+                loss_val, grads = jax.value_and_grad(scalar)(state.params)
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            metrics = {
+                "loss": loss_val,
+                "grad_norm": optax.global_norm(grads),
+            }
+            return TrainState(step=state.step + 1, params=new_params,
+                              opt_state=new_opt), metrics
+
+        return jax.jit(
+            train_step,
+            in_shardings=(self.state_shardings, self.batch_sharding),
+            out_shardings=(self.state_shardings, self._metrics_sharding),
+            donate_argnums=(0,),
+        )
+
+    def step(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """One optimizer step; returns (async) metrics."""
+        if self.state is None:
+            self.init()
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        with self.mesh:
+            self.state, metrics = self._train_step(self.state, batch)
+        return metrics
+
+    # -- eval ---------------------------------------------------------------
+    def eval_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
+        if self.state is None:
+            self.init()
+        if not hasattr(self, "_eval_step") or self._eval_step is None:
+            fsc = self._forward_sum_count
+
+            def ev(state, batch):
+                l, c = fsc(state.params, batch)
+                return l / jnp.maximum(c, 1.0)
+            self._eval_step = jax.jit(
+                ev, in_shardings=(self.state_shardings, self.batch_sharding),
+                out_shardings=self._metrics_sharding)
+        with self.mesh:
+            return self._eval_step(self.state, batch)

@@ -1,0 +1,40 @@
+"""Rematerialisation (gradient-checkpointing) policies.
+
+Reference: ``MemoryConfig`` gc/gc_cls/gc_cnt (torchacc/config.py:57-88)
+driving ``checkpoint_module`` wraps (utils/checkpoint.py:67-81) plus the
+CUDA-stream CPU offloader (utils/cpu_offload.py).  On TPU both collapse
+into :func:`jax.checkpoint` policies — including host-offload policies
+that park residuals in pinned host memory, the XLA-native replacement
+for the reference's d2h/h2d stream machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def remat_policy(name: str = "nothing") -> Optional[object]:
+    """Map a policy name to a jax.checkpoint policy.
+
+    'nothing'                  save nothing (recompute all)   — max memory win
+    'dots'                     save matmul outputs            — cheap recompute
+    'dots_with_no_batch_dims'  save contraction-only matmuls  — maxtext default
+    'offload_dots'             offload matmul outputs to host — HBM relief with
+                               no recompute (reference cpu_offload.py analogue)
+    """
+    cp = jax.checkpoint_policies
+    if name == "nothing":
+        return cp.nothing_saveable
+    if name == "dots":
+        return cp.checkpoint_dots
+    if name == "dots_with_no_batch_dims":
+        return cp.checkpoint_dots_with_no_batch_dims
+    if name == "offload_dots":
+        return cp.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["out_proj", "mlp_out", "block_out"],
+            offload_src="device", offload_dst="pinned_host",
+        )
+    raise ValueError(f"unknown remat policy {name!r}")
