@@ -1,0 +1,20 @@
+"""Shared op-layer helpers: platform detection and constants."""
+
+from __future__ import annotations
+
+import jax
+
+NEG_INF = -1e30
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a TPU (incl. remote 'axon' chips)."""
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def interpret_mode() -> bool:
+    """Pallas kernels run in interpreter mode off-TPU (CPU tests)."""
+    return not on_tpu()

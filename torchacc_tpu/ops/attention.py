@@ -24,7 +24,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-NEG_INF = -1e30
+from torchacc_tpu.ops._common import NEG_INF
 
 
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:

@@ -14,16 +14,10 @@ from typing import Optional, Tuple
 
 import jax
 
+from torchacc_tpu.ops._common import on_tpu as _on_tpu
 from torchacc_tpu.ops.attention import attention_reference
 
 _warned_fallback = False
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform in ("tpu", "axon")
-    except Exception:
-        return False
 
 
 def attention(

@@ -1,0 +1,548 @@
+"""Pallas TPU flash attention: forward + backward, LSE, causal, GQA,
+sliding window, segment-id varlen.
+
+TPU-native replacement for the reference's CUDA flash-attention custom
+calls (`torch_xla._XLAC._flash_attention_{forward,backward}` and the
+position-ids variants — used at reference ops/flash_attn.py:36,56,185,206)
+covering the same feature matrix documented at ops/flash_attn.py:386-432:
+fixed-length + varlen (packed sequences via segment ids, the equivalent of
+cu_seqlens/position_ids), causal, sliding window, GQA/MQA.  Returns the
+per-row log-sum-exp exactly like the reference kernels' ``softmax_lse``
+so context-parallel ring merging can combine partial results
+(reference cp/utils.py:302-343).
+
+Kernel layout (TPU tiling: last two block dims must be (8k, 128k)):
+  q/k/v in BHSD; one program per (batch, q_head, q_block); kv blocks on
+  the innermost sequential grid dim with VMEM carry (online softmax).
+  LSE travels as [b, h, sq, 128] lane-broadcast and is sliced to
+  [b, h, sq] at the wrapper.  Segment ids broadcast to (b, sq, 128) for
+  q and (b, 8, sk) for kv (sublane-broadcast), the standard trick.
+Backward = two kernels (flash-attn standard): dq over q blocks looping
+kv; dk/dv over kv blocks looping q; both recompute P from the saved LSE.
+Public API stays BSHD to match the model layer ([b, s, h, d]).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from torchacc_tpu.ops._common import NEG_INF, interpret_mode as _interpret
+
+_LANES = 128
+_SUBLANES = 8
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _block_sizes(sq: int, sk: int) -> Tuple[int, int]:
+    """TPU-legal defaults: block_q lands in sublane positions (multiple of
+    8), block_k lands in lane positions of the kv-segment block (multiple
+    of 128); the wrapper pads sequences up to a block multiple."""
+    return min(512, _round_up(sq, 8)), min(512, _round_up(sk, _LANES))
+
+
+def _band_mask(q_start, k_start, block_q, block_k, causal, window):
+    """Positional (causal + sliding window) mask for one tile, or None."""
+    left, right = window
+    if not causal and left < 0 and right < 0:
+        return None
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = jnp.ones((block_q, block_k), jnp.bool_)
+    if causal:
+        mask &= q_pos >= k_pos
+    if left >= 0:
+        mask &= k_pos >= q_pos - left
+    if right >= 0:
+        mask &= k_pos <= q_pos + right
+    return mask
+
+
+def _block_should_run(q_start, k_start, block_q, block_k, causal, window):
+    left, right = window
+    run = True
+    if causal:
+        run = jnp.logical_and(run, k_start <= q_start + block_q - 1)
+    if left >= 0:
+        run = jnp.logical_and(run, k_start + block_k - 1 >= q_start - left)
+    if right >= 0:
+        run = jnp.logical_and(run, k_start <= q_start + block_q - 1 + right)
+    return run
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+                o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, window, block_q, block_k, num_kv_blocks):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
+                               causal, window))
+    def _compute():
+        q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # [bq, bk]
+
+        mask = _band_mask(q_start, k_start, block_q, block_k, causal, window)
+        if qseg_ref is not None:
+            qs = qseg_ref[0, :, 0]                          # [bq]
+            ks = kseg_ref[0, 0, :]                          # [bk]
+            seg = qs[:, None] == ks[None, :]
+            mask = seg if mask is None else mask & seg
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:, 0]                                # [bq]
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, alpha)
+        l_new = alpha * l_scr[:, 0] + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        m = m_scr[:, 0]
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
+
+
+def _fwd_kernel_noseg(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, None, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, **kw)
+
+
+def _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal, window,
+         block_q, block_k):
+    """q,k,v in BHSD.  Returns (o BHSD, lse [b,h,sq] f32)."""
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = hq // hk
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    has_seg = q_segment_ids is not None
+
+    kernel = functools.partial(
+        _fwd_kernel if has_seg else _fwd_kernel_noseg,
+        scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_kv_blocks=nk)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h, qi, ki: (b_, h // group, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h, qi, ki: (b_, h // group, ki, 0)),
+    ]
+    args = [q, k, v]
+    if has_seg:
+        qseg = jax.lax.broadcast_in_dim(
+            q_segment_ids, (b, sq, _LANES), (0, 1))
+        kseg = jax.lax.broadcast_in_dim(
+            kv_segment_ids, (b, _SUBLANES, sk), (0, 2))
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b_, h, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda b_, h, qi, ki: (b_, 0, ki)),
+        ]
+        args += [qseg, kseg]
+
+    o, lse4 = pl.pallas_call(
+        kernel,
+        grid=(b, hq, nq, nk),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h, qi, ki: (b_, h, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES),
+                         lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq, _LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return o, lse4[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
+                 q_start, k_start, *, scale, causal, window,
+                 block_q, block_k):
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    mask = _band_mask(q_start, k_start, block_q, block_k, causal, window)
+    if qseg_ref is not None:
+        seg = qseg_ref[0, :, 0][:, None] == kseg_ref[0, 0, :][None, :]
+        mask = seg if mask is None else mask & seg
+    p = jnp.exp(s - lse[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    return p, q, k
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   qseg_ref, kseg_ref, dq_ref, dq_scr,
+                   *, scale, causal, window, block_q, block_k,
+                   num_kv_blocks):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
+                               causal, window))
+    def _compute():
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
+                               q_start, k_start, scale=scale, causal=causal,
+                               window=window, block_q=block_q,
+                               block_k=block_k)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0, :, :] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dq_kernel_noseg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, **kw):
+    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   None, None, dq_ref, dq_scr, **kw)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, window, block_q, block_k,
+                    num_q_blocks, group):
+    # grid (b, hk, nk, group, nq): the scratch accumulates over the whole
+    # (group, q-block) inner sweep, so GQA/MQA grads never materialise
+    # per-q-head dk/dv in HBM.
+    ki = pl.program_id(2)
+    g = pl.program_id(3)
+    qi = pl.program_id(4)
+
+    @pl.when(jnp.logical_and(g == 0, qi == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
+                               causal, window))
+    def _compute():
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
+        do = do_ref[0, 0, :, :].astype(jnp.float32)
+        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
+                               q_start, k_start, scale=scale, causal=causal,
+                               window=window, block_q=block_q,
+                               block_k=block_k)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bk, d]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale                  # [bq, bk]
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [bk, d]
+
+    @pl.when(jnp.logical_and(g == group - 1, qi == num_q_blocks - 1))
+    def _finalize():
+        dk_ref[0, 0, :, :] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_dkv_kernel_noseg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, **kw):
+    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    None, None, dk_ref, dv_ref, dk_scr, dv_scr, **kw)
+
+
+def _bwd(res, do, *, scale, causal, window, block_q, block_k):
+    q, k, v, o, lse, q_segment_ids, kv_segment_ids = res
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = hq // hk
+    nq = pl.cdiv(sq, block_q)
+    nk = pl.cdiv(sk, block_k)
+    has_seg = q_segment_ids is not None
+
+    # delta = rowsum(do * o); lane-broadcast alongside lse for the kernels
+    delta = jnp.einsum("bhqd,bhqd->bhq", do.astype(jnp.float32),
+                       o.astype(jnp.float32))
+    lse4 = jnp.broadcast_to(lse[..., None], (b, hq, sq, _LANES))
+    delta4 = jnp.broadcast_to(delta[..., None], (b, hq, sq, _LANES))
+
+    common = dict(scale=scale, causal=causal, window=window,
+                  block_q=block_q, block_k=block_k)
+
+    if has_seg:
+        qseg = jax.lax.broadcast_in_dim(
+            q_segment_ids, (b, sq, _LANES), (0, 1))
+        kseg = jax.lax.broadcast_in_dim(
+            kv_segment_ids, (b, _SUBLANES, sk), (0, 2))
+
+    # ---- dq: grid (b, hq, nq, nk) ----
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h, qi, ki: (b_, h // group, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, h, qi, ki: (b_, h // group, ki, 0)),
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, h, qi, ki: (b_, h, qi, 0)),
+    ]
+    args = [q, k, v, do, lse4, delta4]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b_, h, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda b_, h, qi, ki: (b_, 0, ki)),
+        ]
+        args += [qseg, kseg]
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel if has_seg else _bwd_dq_kernel_noseg,
+            num_kv_blocks=nk, **common),
+        grid=(b, hq, nq, nk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+
+    # ---- dk/dv: grid (b, hk, nk, group, nq) — the (group, q-block) inner
+    # sweep accumulates in VMEM scratch, writing dk/dv once per kv head ----
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv, ki, 0)),
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+    ]
+    args = [q, k, v, do, lse4, delta4]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda b_, hkv, ki, g, qi: (b_, qi, 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda b_, hkv, ki, g, qi: (b_, 0, ki)),
+        ]
+        args += [qseg, kseg]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel if has_seg else _bwd_dkv_kernel_noseg,
+            num_q_blocks=nq, group=group, **common),
+        grid=(b, hk, nk, group, nq),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, hkv, ki, g, qi: (b_, hkv, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, hkv, ki, g, qi: (b_, hkv, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(*args)
+    return (dq, dk, dv, None, None)
+
+
+# ---------------------------------------------------------------------------
+# public API (BSHD, matching the model layer / reference flash-attn layout)
+# ---------------------------------------------------------------------------
+
+def _pad_seq(x, block, axis, value=0):
+    s = x.shape[axis]
+    rem = s % block
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, block - rem)
+    return jnp.pad(x, pad, constant_values=value)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, q_segment_ids, kv_segment_ids,
+           scale, causal, window, block_q, block_k):
+    o, _ = _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal,
+                window, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids,
+               scale, causal, window, block_q, block_k):
+    o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal,
+                  window, block_q, block_k)
+    return o, (q, k, v, o, lse, q_segment_ids, kv_segment_ids)
+
+
+def _flash_bwd(scale, causal, window, block_q, block_k, res, g):
+    return _bwd(res, g, scale=scale, causal=causal, window=window,
+                block_q=block_q, block_k=block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    return_lse: bool = False,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+):
+    """[b, s, h, d] flash attention (see module docstring).
+
+    With ``return_lse`` returns (out, lse[b, h, s]); that path is
+    forward-only (used by the context-parallel ring, which defines its
+    own VJP around the merged result).
+    """
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hq % hk != 0:
+        raise ValueError(
+            f"num q heads ({hq}) must be a multiple of kv heads ({hk})")
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError(
+            "q_segment_ids and kv_segment_ids must be provided together")
+    if scale is None:
+        scale = d ** -0.5
+    bq0, bk0 = _block_sizes(sq, sk)
+    block_q = block_q or bq0
+    block_k = block_k or bk0
+    if not _interpret() and (block_q % 8 or block_k % _LANES):
+        raise ValueError(
+            f"on TPU block_q must be a multiple of 8 and block_k a multiple "
+            f"of 128; got ({block_q}, {block_k})")
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q or pad_k or q_segment_ids is not None:
+        # Padded positions get distinct negative segment ids so they match
+        # nothing (padding-safe); real rows keep user segment ids.
+        if q_segment_ids is None:
+            q_segment_ids = jnp.zeros((b, sq), jnp.int32)
+            kv_segment_ids = jnp.zeros((b, sk), jnp.int32)
+        q_segment_ids = _pad_seq(q_segment_ids, block_q, 1, value=-1)
+        kv_segment_ids = _pad_seq(kv_segment_ids, block_k, 1, value=-2)
+    q = _pad_seq(q, block_q, 1).swapaxes(1, 2)   # -> BHSD
+    k = _pad_seq(k, block_k, 1).swapaxes(1, 2)
+    v = _pad_seq(v, block_k, 1).swapaxes(1, 2)
+
+    if return_lse:
+        o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale,
+                      causal, window, block_q, block_k)
+        return o.swapaxes(1, 2)[:, :sq], lse[:, :, :sq]
+    o = _flash(q, k, v, q_segment_ids, kv_segment_ids, scale, causal,
+               window, block_q, block_k)
+    return o.swapaxes(1, 2)[:, :sq]
+
+
+def segment_ids_from_positions(positions: jax.Array) -> jax.Array:
+    """Packed-sequence segment ids from position_ids (reference
+    ``FlashAttnVarlenPositionIdsXla`` ops/flash_attn.py:173-216 derives
+    cu_seqlens from positions resetting to 0)."""
+    starts = (positions == 0).astype(jnp.int32)
+    return jnp.cumsum(starts, axis=-1) - 1
