@@ -19,7 +19,8 @@ from torchacc_tpu.parallel.mesh import build_mesh, describe_mesh
 def test_build_mesh_all_axes(devices):
     dist = DistConfig(dp=DPConfig(size=2), fsdp=FSDPConfig(size=2), tp=TPConfig(size=2))
     mesh = build_mesh(dist, devices=devices)
-    assert describe_mesh(mesh) == {"dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "ep": 1, "tp": 2}
+    assert describe_mesh(mesh) == {"dp": 2, "pp": 1, "fsdp": 2, "sp": 1,
+                                   "spu": 1, "ep": 1, "tp": 2}
     assert mesh.devices.size == 8
 
 

@@ -18,7 +18,8 @@ from torchacc_tpu.parallel.sharding import (
 def test_spec_for_basic():
     rules = make_rules()
     assert spec_for(("embed", "mlp"), rules) == P("fsdp", "tp")
-    assert spec_for(("batch", "seq", None), rules) == P(("dp", "fsdp"), "sp", None)
+    assert spec_for(("batch", "seq", None), rules) == P(
+        ("dp", "fsdp"), ("sp", "spu"), None)
     assert spec_for(("kv",), rules) == P(None)
 
 
@@ -30,7 +31,7 @@ def test_spec_no_duplicate_mesh_axes():
 
 
 def test_batch_spec():
-    assert batch_spec() == P(("dp", "fsdp"), "sp")
+    assert batch_spec() == P(("dp", "fsdp"), ("sp", "spu"))
 
 
 def test_tree_shardings_divisibility_and_min_size(devices):

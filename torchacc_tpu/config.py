@@ -35,7 +35,10 @@ import math
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-MESH_AXES: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "ep", "tp")
+# 'sp' is the outer (ring / DCN-friendly) sequence axis, 'spu' the inner
+# (Ulysses all-to-all / ICI) sequence axis — together they realise the
+# reference's inter/intra context-parallel 2D grid (init_group.py:42-91).
+MESH_AXES: Tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "spu", "ep", "tp")
 
 # Axes along which the *batch* is split.  ``fsdp`` shards data as well as
 # params (ZeRO data parallelism); ``ep`` ranks also consume distinct data
@@ -227,6 +230,20 @@ class SPConfig:
             _check(self.size % self.intra_size == 0,
                    "sp.size must be divisible by sp.intra_size")
 
+    @property
+    def ulysses_degree(self) -> int:
+        """Extent of the 'spu' (all-to-all) mesh axis."""
+        if self.mode == "ulysses":
+            return self.size
+        if self.mode == "2d":
+            return self.intra_size or 1
+        return 1
+
+    @property
+    def ring_degree(self) -> int:
+        """Extent of the 'sp' (ppermute ring) mesh axis."""
+        return self.size // self.ulysses_degree
+
 
 @dataclass
 class EPConfig:
@@ -275,7 +292,8 @@ class DistConfig:
             "tp": self.tp.size,
             "fsdp": self.fsdp.size,
             "pp": self.pp.size,
-            "sp": self.sp.size,
+            "sp": self.sp.ring_degree,
+            "spu": self.sp.ulysses_degree,
             "ep": self.ep.size,
         }
         fixed = math.prod(sizes.values())

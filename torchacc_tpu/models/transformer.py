@@ -50,6 +50,9 @@ class ModelConfig:
     remat_policy: str = "nothing"           # see utils/remat.py
     attention_impl: str = "auto"
     window: Tuple[int, int] = (-1, -1)      # sliding-window attention
+    # context parallelism: attention runs in a shard_map region with the
+    # sequence dim sharded over ('sp', 'spu') — see ops/context_parallel
+    context_parallel: bool = False
     # MoE (0 = dense). See models/moe.py.
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -148,9 +151,17 @@ class Attention(nn.Module):
         v = dense("v_proj", cfg.kv_heads)(x)
         if cfg.pos_emb == "rope":
             q, k = _rope(q, k, positions, cfg.rope_theta)
-        out = attention(q, k, v, causal=True, window=cfg.window,
-                        q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
-                        impl=cfg.attention_impl)
+        if cfg.context_parallel:
+            from torchacc_tpu.ops.context_parallel import cp_attention
+            out = cp_attention(q, k, v, causal=True, window=cfg.window,
+                               q_segment_ids=segment_ids,
+                               kv_segment_ids=segment_ids,
+                               impl=cfg.attention_impl)
+        else:
+            out = attention(q, k, v, causal=True, window=cfg.window,
+                            q_segment_ids=segment_ids,
+                            kv_segment_ids=segment_ids,
+                            impl=cfg.attention_impl)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
             name="o_proj", dtype=cfg.dtype, param_dtype=cfg.param_dtype,

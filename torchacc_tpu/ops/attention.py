@@ -122,3 +122,55 @@ def attention_reference(
     if return_lse:
         return out, lse
     return out
+
+
+def attention_reference_bwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    o: jax.Array,
+    lse: jax.Array,
+    do: jax.Array,
+    *,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Plain-XLA flash-style backward from saved (o, lse): (dq, dk, dv).
+
+    Same contract as flash_attention_bwd — used by the context-parallel
+    ring when the Pallas kernel is disabled (impl='xla').  GQA grads are
+    group-reduced.
+    """
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    group = hq // hk
+    kr = _repeat_kv(k, hq).astype(jnp.float32)
+    vr = _repeat_kv(v, hq).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr) * scale
+    mask = make_attention_mask(sq, sk, causal=causal, window=window,
+                               q_segment_ids=q_segment_ids,
+                               kv_segment_ids=kv_segment_ids)
+    if mask.ndim == 3:
+        mask = mask[:, None, :, :]
+    p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+    delta = jnp.einsum("bqhd,bqhd->bhq", dof, of)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vr)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kr)
+    dk_full = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    dv_full = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    if group > 1:
+        dk = dk_full.reshape(b, sk, hk, group, d).sum(axis=3)
+        dv = dv_full.reshape(b, sk, hk, group, d).sum(axis=3)
+    else:
+        dk, dv = dk_full, dv_full
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)

@@ -1,0 +1,16 @@
+"""Context parallelism: Ulysses, Ring attention, and their 2D composition.
+
+Reference layer: torchacc/ops/context_parallel/* (SURVEY.md §2 #26-30).
+"""
+
+from torchacc_tpu.ops.context_parallel.dispatch import cp_attention
+from torchacc_tpu.ops.context_parallel.merge import merge_attention
+from torchacc_tpu.ops.context_parallel.ring import ring_attention
+from torchacc_tpu.ops.context_parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "cp_attention",
+    "merge_attention",
+    "ring_attention",
+    "ulysses_attention",
+]

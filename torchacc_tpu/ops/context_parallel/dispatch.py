@@ -1,0 +1,110 @@
+"""cp_attention: the context-parallel attention front door.
+
+Composes Ulysses (inner, 'spu' axis — ICI all-to-all) with Ring (outer,
+'sp' axis — ppermute ring) inside one shard_map region, the TPU-native
+equivalent of the reference's 2D FlashSequence (context_parallel_2d.py:
+75-126) with its intra/inter process groups (init_group.py:42-91).
+Degenerates automatically: spu=1 -> pure ring, sp=1 -> pure ulysses,
+both 1 -> plain (local) flash attention.
+
+Called from the model's attention layer when context parallelism is on;
+the surrounding train step is an ordinary jit and the region's in/out
+specs splice into the global sharding (dp/fsdp on batch, tp on heads).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchacc_tpu.ops.attention import attention_reference
+from torchacc_tpu.ops.attn import attention
+from torchacc_tpu.ops.context_parallel.ring import ring_attention
+from torchacc_tpu.ops.context_parallel.ulysses import ulysses_attention
+from torchacc_tpu.ops.flash_attention import flash_attention
+
+
+def _ambient_mesh() -> Optional[Mesh]:
+    try:
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and m.shape:
+            return m
+    except Exception:
+        pass
+    return None
+
+
+def cp_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    mesh: Optional[Mesh] = None,
+    ring_axis: str = "sp",
+    a2a_axis: str = "spu",
+    data_axes: Tuple[str, ...] = ("dp", "fsdp"),
+    tp_axis: str = "tp",
+    impl: str = "auto",
+):
+    """[b, s, h, d] attention with the sequence dim context-parallel over
+    (ring_axis, a2a_axis).  Falls back to plain attention when both axes
+    have extent 1 (or no mesh is active)."""
+    mesh = mesh or _ambient_mesh()
+    ring_n = int(mesh.shape.get(ring_axis, 1)) if mesh is not None else 1
+    ul_n = int(mesh.shape.get(a2a_axis, 1)) if mesh is not None else 1
+    if ring_n * ul_n == 1:
+        return attention(q, k, v, causal=causal, window=window,
+                         q_segment_ids=q_segment_ids,
+                         kv_segment_ids=kv_segment_ids, impl=impl)
+    if window != (-1, -1):
+        raise NotImplementedError(
+            "sliding-window attention is not supported under context "
+            "parallelism (the reference ring implementation has the same "
+            "limitation); disable the window or set sp.size = 1")
+    # 'auto' resolves to the Pallas kernel (interpret mode off-TPU);
+    # an explicit 'xla' request is honoured down the whole CP stack.
+    inner_impl = "pallas" if impl == "auto" else impl
+
+    d = q.shape[-1]
+    has_seg = q_segment_ids is not None
+    seq_axes = (ring_axis, a2a_axis)
+    qkv_spec = P(data_axes, seq_axes, tp_axis, None)
+    seg_spec = P(data_axes, seq_axes)
+
+    def region(q, k, v, qseg=None, kseg=None):
+        scale = d ** -0.5
+
+        def local_attn(q_, k_, v_, qs_, ks_):
+            if ring_n > 1:
+                return ring_attention(q_, k_, v_, qs_, ks_,
+                                      ring_axis, ring_n, causal, inner_impl)
+            if inner_impl == "xla":
+                return attention_reference(
+                    q_, k_, v_, causal=causal, scale=scale,
+                    q_segment_ids=qs_, kv_segment_ids=ks_)
+            return flash_attention(q_, k_, v_, causal=causal, scale=scale,
+                                   q_segment_ids=qs_, kv_segment_ids=ks_)
+
+        return ulysses_attention(q, k, v, qseg, kseg, a2a_axis, ul_n,
+                                 inner=local_attn)
+
+    if has_seg:
+        return jax.shard_map(
+            region, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(q, k, v, q_segment_ids, kv_segment_ids)
+    return jax.shard_map(
+        region, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )(q, k, v)

@@ -1,0 +1,41 @@
+"""Numerically stable merge of partial attention results via LSE.
+
+TPU-native port of the math in the reference's `_update_out_and_lse`
+(ops/context_parallel/utils.py:302-343): two attention partials computed
+over disjoint key sets combine exactly through their log-sum-exps.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchacc_tpu.ops._common import NEG_INF
+
+
+def merge_attention(
+    out_a: jax.Array, lse_a: jax.Array,
+    out_b: jax.Array, lse_b: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Combine partials (out [b,s,h,d] f32, lse [b,h,s] f32) -> merged.
+
+    Rows that saw no keys carry lse == NEG_INF and contribute nothing.
+    """
+    lse_max = jnp.maximum(lse_a, lse_b)
+    # guard: both -inf (row attended to nothing anywhere)
+    lse_max_safe = jnp.where(lse_max <= NEG_INF, 0.0, lse_max)
+    wa = jnp.exp(lse_a - lse_max_safe)
+    wb = jnp.exp(lse_b - lse_max_safe)
+    wa = jnp.where(lse_a <= NEG_INF, 0.0, wa)
+    wb = jnp.where(lse_b <= NEG_INF, 0.0, wb)
+    denom = wa + wb
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    # weights are [b,h,s] -> broadcast to [b,s,h,1]
+    wa_ = (wa / denom_safe).swapaxes(1, 2)[..., None]
+    wb_ = (wb / denom_safe).swapaxes(1, 2)[..., None]
+    out = out_a * wa_ + out_b * wb_
+    lse = jnp.where(denom == 0.0, NEG_INF,
+                    lse_max_safe + jnp.log(denom_safe))
+    return out, lse

@@ -1,0 +1,164 @@
+"""Ring attention over a named mesh axis (inside shard_map).
+
+TPU-native redesign of the reference's ring flash attention
+(ops/context_parallel/ring_attn.py:22-271): kv shards rotate around the
+ring via ``ppermute`` (the reference uses batched NCCL isend/irecv through
+``RingComm``, cp/utils.py:368-423), partial results merge through LSE
+(reference `_update_out_and_lse` cp/utils.py:302-343), and causality is
+handled by the block decomposition — a step is *full* (kv chunk strictly
+before my queries), *diagonal* (my own chunk, causal), or *skipped*
+(kv chunk after my queries; reference skips via `step > rank`
+ring_attn.py:55,174).
+
+The backward is a custom VJP that re-walks the ring in the same order,
+evaluating each step's flash backward against the GLOBAL (merged) lse and
+output — mathematically identical to differentiating the merged softmax —
+while dk/dv accumulators travel around the ring with their kv shard and
+arrive home after a full cycle (the reference's reverse-ring grad
+rotation, ring_attn.py:130-271).
+
+All functions here run INSIDE shard_map: q/k/v are the local shards
+[b, s_local, h, d] and ``axis_name`` is the ring mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchacc_tpu.ops._common import NEG_INF
+from torchacc_tpu.ops.attention import attention_reference, attention_reference_bwd
+from torchacc_tpu.ops.context_parallel.merge import merge_attention
+from torchacc_tpu.ops.flash_attention import flash_attention, flash_attention_bwd
+
+
+def _fwd_fn(impl):
+    if impl == "xla":
+        return functools.partial(attention_reference, return_lse=True)
+    return functools.partial(flash_attention, return_lse=True)
+
+
+def _bwd_fn(impl):
+    return attention_reference_bwd if impl == "xla" else flash_attention_bwd
+
+
+def _rotate(x, axis_name: str, n: int):
+    """Send my shard to rank+1 (mod n)."""
+    return jax.lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
+
+
+def _step_mode(me, src, causal: bool):
+    """0 = skip, 1 = diagonal (causal within chunk), 2 = full."""
+    if not causal:
+        return jnp.full_like(me, 2)
+    return jnp.where(src > me, 0, jnp.where(src == me, 1, 2))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def ring_attention(q, k, v, q_segment_ids, kv_segment_ids,
+                   axis_name: str, n: int, causal: bool,
+                   impl: str = "pallas"):
+    out, _ = _ring_fwd_impl(q, k, v, q_segment_ids, kv_segment_ids,
+                            axis_name, n, causal, impl)
+    return out
+
+
+def _ring_fwd_impl(q, k, v, qseg, kseg, axis_name, n, causal, impl):
+    b, sq, hq, d = q.shape
+    me = jax.lax.axis_index(axis_name)
+    scale = d ** -0.5
+
+    out0 = jnp.zeros((b, sq, hq, d), jnp.float32)
+    lse0 = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
+
+    def body(i, carry):
+        out, lse, k_cur, v_cur, kseg_cur = carry
+        src = (me - i) % n
+        mode = _step_mode(me, src, causal)
+
+        def _skip(_):
+            return (jnp.zeros((b, sq, hq, d), q.dtype),
+                    jnp.full((b, hq, sq), NEG_INF, jnp.float32))
+
+        fwd = _fwd_fn(impl)
+
+        def _diag(_):
+            return fwd(q, k_cur, v_cur, causal=True, scale=scale,
+                       q_segment_ids=qseg, kv_segment_ids=kseg_cur)
+
+        def _full(_):
+            return fwd(q, k_cur, v_cur, causal=False, scale=scale,
+                       q_segment_ids=qseg, kv_segment_ids=kseg_cur)
+
+        o_i, lse_i = jax.lax.switch(mode, [_skip, _diag, _full], None)
+        out, lse = merge_attention(out, lse, o_i.astype(jnp.float32), lse_i)
+        # rotate kv onward (last rotation returns shards home)
+        k_cur = _rotate(k_cur, axis_name, n)
+        v_cur = _rotate(v_cur, axis_name, n)
+        if kseg_cur is not None:
+            kseg_cur = _rotate(kseg_cur, axis_name, n)
+        return out, lse, k_cur, v_cur, kseg_cur
+
+    out, lse, _, _, _ = jax.lax.fori_loop(
+        0, n, body, (out0, lse0, k, v, kseg))
+    return out.astype(q.dtype), lse
+
+
+def _ring_fwd(q, k, v, qseg, kseg, axis_name, n, causal, impl):
+    out, lse = _ring_fwd_impl(q, k, v, qseg, kseg, axis_name, n, causal, impl)
+    return out, (q, k, v, qseg, kseg, out, lse)
+
+
+def _ring_bwd(axis_name, n, causal, impl, res, do):
+    q, k, v, qseg, kseg, o, lse = res
+    b, sq, hq, d = q.shape
+    me = jax.lax.axis_index(axis_name)
+    scale = d ** -0.5
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+
+    def body(i, carry):
+        dq, dk, dv, k_cur, v_cur, kseg_cur = carry
+        src = (me - i) % n
+        mode = _step_mode(me, src, causal)
+
+        def _skip(_):
+            return (jnp.zeros(q.shape, q.dtype), jnp.zeros(k.shape, k.dtype),
+                    jnp.zeros(v.shape, v.dtype))
+
+        bwd = _bwd_fn(impl)
+
+        def _mk(is_causal):
+            def f(_):
+                return bwd(q, k_cur, v_cur, o, lse, do, causal=is_causal,
+                           scale=scale, q_segment_ids=qseg,
+                           kv_segment_ids=kseg_cur)
+            return f
+
+        dq_i, dk_i, dv_i = jax.lax.switch(
+            mode, [_skip, _mk(True), _mk(False)], None)
+        dq = dq + dq_i.astype(jnp.float32)
+        dk = dk + dk_i.astype(jnp.float32)
+        dv = dv + dv_i.astype(jnp.float32)
+        # dk/dv ride the ring with their kv shard; after n steps they are
+        # home with the full sum of contributions from every q shard.
+        k_cur = _rotate(k_cur, axis_name, n)
+        v_cur = _rotate(v_cur, axis_name, n)
+        if kseg_cur is not None:
+            kseg_cur = _rotate(kseg_cur, axis_name, n)
+        dk = _rotate(dk, axis_name, n)
+        dv = _rotate(dv, axis_name, n)
+        return dq, dk, dv, k_cur, v_cur, kseg_cur
+
+    dq, dk, dv, _, _, _ = jax.lax.fori_loop(
+        0, n, body, (dq0, dk0, dv0, k, v, kseg))
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)

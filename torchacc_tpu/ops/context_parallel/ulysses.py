@@ -1,0 +1,49 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all on heads.
+
+TPU-native redesign of the reference's ulysses (ops/context_parallel/
+ulysses.py:51-77): before attention, an all-to-all scatters heads and
+gathers sequence (so each device sees the full sequence for a subset of
+heads); after attention the inverse all-to-all restores sequence sharding.
+The reference's differentiable a2a wrapper (cp/utils.py:262-299) is
+unnecessary — ``jax.lax.all_to_all`` inside shard_map is differentiable.
+
+Runs INSIDE shard_map; ``inner`` is the attention over the gathered
+sequence (plain flash attention, or ring attention for 2D composition —
+the reference's FlashSequence context_parallel_2d.py:75-98).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def ulysses_attention(q, k, v, q_segment_ids, kv_segment_ids,
+                      axis_name: str, n: int,
+                      inner: Callable):
+    """q/k/v local [b, s_loc, h, d]; returns [b, s_loc, h, d].
+
+    GQA note: the all-to-all splits the head dim n ways, so kv heads must
+    also be divisible by n (the reference has the same constraint).
+    """
+    if n == 1:
+        return inner(q, k, v, q_segment_ids, kv_segment_ids)
+    hq, hk = q.shape[2], k.shape[2]
+    if hq % n or hk % n:
+        raise ValueError(
+            f"ulysses degree {n} must divide both q heads ({hq}) and "
+            f"kv heads ({hk})")
+    # scatter heads (axis 2), gather sequence (axis 1)
+    a2a = lambda x: jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                       concat_axis=1, tiled=True)
+    q_, k_, v_ = a2a(q), a2a(k), a2a(v)
+    qseg = kseg = None
+    if q_segment_ids is not None:
+        qseg = jax.lax.all_gather(q_segment_ids, axis_name, axis=1, tiled=True)
+        kseg = jax.lax.all_gather(kv_segment_ids, axis_name, axis=1, tiled=True)
+    out = inner(q_, k_, v_, qseg, kseg)
+    # inverse: scatter sequence, gather heads
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)

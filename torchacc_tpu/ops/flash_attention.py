@@ -540,6 +540,59 @@ def flash_attention(
     return o.swapaxes(1, 2)[:, :sq]
 
 
+def flash_attention_bwd(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    o: jax.Array,
+    lse: jax.Array,
+    do: jax.Array,
+    *,
+    causal: bool = True,
+    window: Tuple[int, int] = (-1, -1),
+    scale: Optional[float] = None,
+    q_segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Standalone flash backward: (dq, dk, dv) from saved (o, lse).
+
+    BSHD in/out; lse is [b, h, sq].  Exposed for context-parallel ring
+    attention, whose custom VJP evaluates each ring step's backward with
+    the GLOBAL lse/o (the exact decomposition the reference implements at
+    ring_attn.py:130-271 with reverse kv rotation).
+    """
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if scale is None:
+        scale = d ** -0.5
+    bq0, bk0 = _block_sizes(sq, sk)
+    block_q = block_q or bq0
+    block_k = block_k or bk0
+
+    pad_q = (-sq) % block_q
+    pad_k = (-sk) % block_k
+    if pad_q or pad_k or q_segment_ids is not None:
+        if q_segment_ids is None:
+            q_segment_ids = jnp.zeros((b, sq), jnp.int32)
+            kv_segment_ids = jnp.zeros((b, sk), jnp.int32)
+        q_segment_ids = _pad_seq(q_segment_ids, block_q, 1, value=-1)
+        kv_segment_ids = _pad_seq(kv_segment_ids, block_k, 1, value=-2)
+    qT = _pad_seq(q, block_q, 1).swapaxes(1, 2)
+    kT = _pad_seq(k, block_k, 1).swapaxes(1, 2)
+    vT = _pad_seq(v, block_k, 1).swapaxes(1, 2)
+    oT = _pad_seq(o, block_q, 1).swapaxes(1, 2)
+    doT = _pad_seq(do, block_q, 1).swapaxes(1, 2)
+    lseP = _pad_seq(lse, block_q, 2)
+
+    res = (qT, kT, vT, oT, lseP, q_segment_ids, kv_segment_ids)
+    dq, dk, dv, _, _ = _bwd(res, doT, scale=scale, causal=causal,
+                            window=window, block_q=block_q, block_k=block_k)
+    return (dq.swapaxes(1, 2)[:, :sq], dk.swapaxes(1, 2)[:, :sk],
+            dv.swapaxes(1, 2)[:, :sk])
+
+
 def segment_ids_from_positions(positions: jax.Array) -> jax.Array:
     """Packed-sequence segment ids from position_ids (reference
     ``FlashAttnVarlenPositionIdsXla`` ops/flash_attn.py:173-216 derives

@@ -45,7 +45,7 @@ LogicalRules = Sequence[Tuple[str, Union[str, Tuple[str, ...], None]]]
 
 DEFAULT_RULES: LogicalRules = (
     ("batch", ("dp", "fsdp")),
-    ("seq", "sp"),
+    ("seq", ("sp", "spu")),
     ("embed", "fsdp"),
     ("mlp", "tp"),
     ("heads", "tp"),

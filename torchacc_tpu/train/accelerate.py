@@ -37,6 +37,7 @@ def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
                         if config.compute.flash_attention else "xla"),
         remat=config.memory.gc,
         remat_policy=config.memory.gc_policy,
+        context_parallel=config.dist.sp.size > 1,
     )
     return dataclasses.replace(mc, **updates)
 

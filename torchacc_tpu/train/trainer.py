@@ -86,6 +86,13 @@ class Trainer:
              sample_input: Optional[jax.Array] = None) -> TrainState:
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed)
+        if sample_input is None:
+            # dummy input sized so every sharded dim divides the mesh
+            # (params do not depend on batch/seq; this only drives tracing)
+            m = self.mesh.shape
+            bs = m.get("dp", 1) * m.get("fsdp", 1)
+            sq = 8 * m.get("sp", 1) * m.get("spu", 1)
+            sample_input = jnp.zeros((bs, sq), jnp.int32)
         init_fn = lambda r: init_train_state(
             r, self.model, self.optimizer, sample_input)
         abstract = jax.eval_shape(init_fn, rng)
@@ -101,7 +108,7 @@ class Trainer:
             opt_state=tree_shardings(self.mesh, abstract.opt_state,
                                      st_axes.opt_state, self.rules, min_sz),
         )
-        with self.mesh:
+        with jax.sharding.set_mesh(self.mesh):
             self.state = jax.jit(
                 init_fn, out_shardings=self.state_shardings)(rng)
         n_params = sum(x.size for x in jax.tree.leaves(self.state.params))
@@ -189,7 +196,7 @@ class Trainer:
             self.init()
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        with self.mesh:
+        with jax.sharding.set_mesh(self.mesh):
             self.state, metrics = self._train_step(self.state, batch)
         return metrics
 
@@ -206,5 +213,5 @@ class Trainer:
             self._eval_step = jax.jit(
                 ev, in_shardings=(self.state_shardings, self.batch_sharding),
                 out_shardings=self._metrics_sharding)
-        with self.mesh:
+        with jax.sharding.set_mesh(self.mesh):
             return self._eval_step(self.state, batch)
