@@ -53,6 +53,13 @@ class ModelConfig:
     # context parallelism: attention runs in a shard_map region with the
     # sequence dim sharded over ('sp', 'spu') — see ops/context_parallel
     context_parallel: bool = False
+    # pipeline parallelism: the layer stack runs as a circulating-micro-
+    # batch pipeline over the 'pp' mesh axis — see parallel/pp.py
+    pp_size: int = 1
+    pp_num_micro: int = 1
+    # logical-axis rule table for activation sharding constraints; None =
+    # parallel.sharding.DEFAULT_RULES (accelerate() injects make_rules(cfg))
+    logical_axis_rules: Optional[Tuple] = None
     # MoE (0 = dense). See models/moe.py.
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -146,9 +153,18 @@ class Attention(nn.Module):
             features=(heads, d), use_bias=cfg.qkv_bias, name=name,
             dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02))
+        from torchacc_tpu.parallel.sharding import (
+            DEFAULT_RULES,
+            activation_constraint,
+        )
+        rules = cfg.logical_axis_rules or DEFAULT_RULES
         q = dense("q_proj", cfg.num_heads)(x)
         k = dense("k_proj", cfg.kv_heads)(x)
         v = dense("v_proj", cfg.kv_heads)(x)
+        # megatron TP activation layout: heads sharded on 'tp'
+        q = activation_constraint(q, ("batch", "seq", "heads", None), rules)
+        k = activation_constraint(k, ("batch", "seq", "heads", None), rules)
+        v = activation_constraint(v, ("batch", "seq", "heads", None), rules)
         if cfg.pos_emb == "rope":
             q, k = _rope(q, k, positions, cfg.rope_theta)
         if cfg.context_parallel:
@@ -179,12 +195,19 @@ class Mlp(nn.Module):
             feat, use_bias=False, name=name, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02))
+        from torchacc_tpu.parallel.sharding import (
+            DEFAULT_RULES,
+            activation_constraint,
+        )
         if cfg.activation == "swiglu":
             gate = dense("gate_proj", cfg.ffn_size)(x)
             up = dense("up_proj", cfg.ffn_size)(x)
             h = nn.silu(gate) * up
         else:
             h = nn.gelu(dense("up_proj", cfg.ffn_size)(x))
+        # megatron TP: ffn hidden sharded on 'tp' (column-parallel out)
+        h = activation_constraint(h, ("batch", "seq", "mlp"),
+                                  cfg.logical_axis_rules or DEFAULT_RULES)
         return dense("down_proj", cfg.hidden_size)(h)
 
 
@@ -226,6 +249,10 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None):
         cfg = self.cfg
+        if cfg.pp_size > 1 and not cfg.scan_layers:
+            raise ValueError(
+                "pipeline parallelism (pp_size > 1) requires scan_layers="
+                "True — the pipeline operates on the stacked layer params")
         b, s = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -246,13 +273,34 @@ class TransformerLM(nn.Module):
                 ScanBlock, policy=remat_policy(cfg.remat_policy),
                 prevent_cse=False)
         if cfg.scan_layers:
-            (x, _, _), _ = nn.scan(
+            scan_mod = nn.scan(
                 block_cls,
                 variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")((x, positions, segment_ids), None)
+            )(cfg, name="layers")
+            if cfg.pp_size > 1 and not self.is_initializing():
+                # pipeline path: drive the stacked layer params through the
+                # pp-stage pipeline (init still traces scan_mod so params
+                # exist with the stacked layout)
+                from torchacc_tpu.parallel.pp import pipeline_blocks
+                layer_params = self.variables["params"]["layers"]
+
+                def apply_one(p, carry):
+                    new_carry, _ = ScanBlock(cfg).apply({"params": p},
+                                                        carry, None)
+                    return new_carry
+
+                from torchacc_tpu.utils.remat import remat_policy
+                x = pipeline_blocks(
+                    apply_one, layer_params, (x, positions, segment_ids),
+                    pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
+                    remat=cfg.remat,
+                    remat_policy=(remat_policy(cfg.remat_policy)
+                                  if cfg.remat else None))
+            else:
+                (x, _, _), _ = scan_mod((x, positions, segment_ids), None)
         else:
             for i in range(cfg.num_layers):
                 (x, positions, segment_ids), _ = block_cls(

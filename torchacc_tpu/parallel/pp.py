@@ -1,0 +1,147 @@
+"""Pipeline parallelism inside jit: SPMD circulating-microbatch pipeline.
+
+The reference implements PP as a per-process imperative interpreter —
+fx-split stages (pp/utils.py:242-274), a PipeDreamFlush 1F1B instruction
+schedule (pp/schedule.py:156-227), and NCCL send/recv between stage
+processes (pp/p2p.py, executor.py:475-667).  On TPU the idiomatic design
+is ONE SPMD program: layers are stacked (scan-over-layers) and sharded
+over the 'pp' mesh axis so each device holds a contiguous stage; micro-
+batches circulate stage-to-stage via ``ppermute`` inside a ``lax.scan``
+over schedule ticks (the reference's send/recv-as-masked-allreduce hack,
+backend.py:336-361, becomes a real collective-permute).  The schedule is
+GPipe-shaped: M micro-batches drain through P stages in M+P-1 ticks with
+the same bubble fraction as the reference's PipeDreamFlush; activation
+memory is bounded by rematerialising each stage body.
+
+Runs under ``jax.shard_map`` manual ONLY over 'pp' (``axis_names``), so
+dp/fsdp/tp/ep shardings inside the stage body remain GSPMD-automatic —
+PP composes with FSDP exactly like the reference's PP(FSDP(model))
+nesting (distributed_parallel.py:19-50).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _ambient_mesh():
+    try:
+        m = jax.sharding.get_abstract_mesh()
+        if m is not None and m.shape:
+            return m
+    except Exception:
+        pass
+    return None
+
+
+def pipeline_blocks(
+    apply_block: Callable[[Any, Tuple], Tuple],
+    stacked_params: Any,
+    carry_in: Tuple[jax.Array, ...],
+    *,
+    pp_size: int,
+    num_micro: int,
+    pp_axis: str = "pp",
+    mesh: Optional[Mesh] = None,
+    remat: bool = True,
+    remat_policy: Optional[Any] = None,
+) -> jax.Array:
+    """Run a stacked layer stack as a pp-stage pipeline.
+
+    apply_block(layer_params, carry) -> carry applies ONE layer; carry is
+    a tuple whose first element is the activation [B, S, H] and whose
+    remaining elements (positions, segment ids, ...) ride along unchanged.
+    stacked_params leaves have leading dim num_layers (sharded over 'pp').
+    Returns the final activation [B, S, H].
+    """
+    mesh = mesh or _ambient_mesh()
+    x = carry_in[0]
+    B = x.shape[0]
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if B % num_micro:
+        raise ValueError(f"batch {B} not divisible by num_micro_batches "
+                         f"{num_micro}")
+    if L % pp_size:
+        raise ValueError(f"num_layers {L} not divisible by pp size {pp_size}")
+    per_stage = L // pp_size
+    M, Pn = num_micro, pp_size
+    mb = B // M
+
+    # [L, ...] -> [P, L/P, ...]; leading factor sharded over 'pp'
+    staged = jax.tree.map(
+        lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), stacked_params)
+    # The activation crosses the shard_map boundary replicated over 'pp',
+    # so its cotangent is a psum over the manual axis — which XLA:CPU
+    # miscompiles for bf16 ("Invalid binary instruction opcode copy").
+    # Keep the boundary in f32 and restore the compute dtype inside.
+    compute_dtype = x.dtype
+    carry_in = (x.astype(jnp.float32),) + tuple(carry_in[1:])
+    # batch -> micro-batches [M, mb, ...] for every rider in the carry
+    micro = tuple(jax.tree.map(
+        lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in)
+
+    param_spec = jax.tree.map(lambda _: P(pp_axis), staged)
+    data_spec = tuple(P() for _ in micro)
+
+    def region(params_local, *micro_local):
+        params_me = jax.tree.map(lambda a: a[0], params_local)  # [L/P, ...]
+        me = jax.lax.axis_index(pp_axis)
+        T = M + Pn - 1
+
+        def stage(carry):
+            def one(c, p):
+                return apply_block(p, c), None
+            body = (jax.checkpoint(one, policy=remat_policy)
+                    if remat else one)
+            carry, _ = jax.lax.scan(body, carry, params_me)
+            return carry
+
+        # Feed micro-batches as scan xs (padded with P-1 dead ticks) and
+        # bank outputs as scan ys — no dynamic indexing inside the loop.
+        # Riders (positions/segment ids) travel the ring with their
+        # micro-batch via the same ppermute that moves the activation.
+        def _pad_ticks(c):
+            return jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.zeros((Pn - 1,) + a.shape[1:], a.dtype)], 0), c)
+
+        feed = tuple(_pad_ticks(c) for c in micro_local)
+        zeros_carry = tuple(jax.tree.map(lambda a: jnp.zeros(a.shape[1:],
+                                                             a.dtype), c)
+                            for c in micro_local)
+
+        def tick(cur, fed):
+            # stage 0 ingests the fresh micro-batch; others use what the
+            # previous stage handed over
+            inj = jax.tree.map(lambda f, c: jnp.where(me == 0, f, c),
+                               fed, cur)
+            inj = (inj[0].astype(compute_dtype),) + tuple(inj[1:])
+            out_carry = stage(inj)
+            handoff = (out_carry[0].astype(jnp.float32),) + tuple(inj[1:])
+            nxt = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    a, pp_axis, [(j, (j + 1) % Pn) for j in range(Pn)]),
+                handoff)
+            return nxt, out_carry[0]
+
+        _, ys = jax.lax.scan(tick, zeros_carry, feed, length=T)
+        # ticks P-1 .. T-1 on the last stage hold micro-batches 0..M-1
+        outs = ys[Pn - 1:]
+        outs = jax.lax.psum(
+            jnp.where(me == Pn - 1, outs.astype(jnp.float32),
+                      jnp.zeros_like(outs, jnp.float32)), pp_axis)
+        return outs.reshape((B,) + outs.shape[2:])
+
+    out = jax.shard_map(
+        region, mesh=mesh,
+        in_specs=(param_spec,) + data_spec,
+        out_specs=P(),
+        check_vma=False,
+        axis_names=frozenset({pp_axis}),
+    )(staged, *micro)
+    return out.astype(x.dtype)

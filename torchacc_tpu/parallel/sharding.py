@@ -67,6 +67,10 @@ def make_rules(config: Optional[Config] = None) -> LogicalRules:
     rules: List[Tuple[str, Any]] = []
     if config is not None and config.dist.fsdp.shard_axis_rules:
         rules.extend(config.dist.fsdp.shard_axis_rules)
+    if config is not None and config.dist.pp.size > 1:
+        # pipeline stages: the scan-over-layers stacking dim becomes the
+        # stage dim, sharded so each pp rank stores only its own layers
+        rules.append(("layers", "pp"))
     rules.extend(DEFAULT_RULES)
     return tuple(rules)
 
@@ -119,6 +123,7 @@ def _divisible(spec: PartitionSpec, shape: Tuple[int, ...], mesh: Mesh) -> Parti
             out.append(None)
             continue
         axes = tgt if isinstance(tgt, tuple) else (tgt,)
+        # mesh.shape may be an AbstractMesh mapping; .get works for both
         # Longest divisible prefix: batch=6 on ('dp','fsdp')=(2,2) still
         # shards over dp rather than falling all the way to replicated.
         while axes:
@@ -176,3 +181,34 @@ def constraint(x: jax.Array, logical_axes: Sequence[Optional[str]],
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def activation_constraint(x: jax.Array,
+                          logical_axes: Sequence[Optional[str]],
+                          rules: LogicalRules = DEFAULT_RULES) -> jax.Array:
+    """Best-effort activation sharding hint (megatron-style TP activation
+    layout — the reference's ``xs.mark_sharding`` on activations, tp.py:1-5).
+
+    No-op when no mesh is active (plain single-device apply), so model
+    code can call it unconditionally.
+    """
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or not mesh.shape:
+            return x
+    except Exception:
+        return x
+    spec = spec_for(logical_axes, rules)
+    # drop axes the mesh doesn't know, then longest-divisible-prefix
+    known = []
+    for tgt in tuple(spec) + (None,) * (x.ndim - len(spec)):
+        axes = tgt if isinstance(tgt, tuple) else ((tgt,) if tgt else ())
+        axes = tuple(a for a in axes if a in mesh.shape)
+        if not axes:
+            known.append(None)
+        elif isinstance(tgt, tuple):
+            known.append(axes)
+        else:
+            known.append(axes[0])
+    cleaned = _divisible(PartitionSpec(*known), x.shape, mesh)
+    return jax.lax.with_sharding_constraint(x, cleaned)

@@ -19,6 +19,7 @@ import optax
 
 from torchacc_tpu.config import Config
 from torchacc_tpu.data.async_loader import AsyncLoader
+from torchacc_tpu.parallel.sharding import make_rules
 from torchacc_tpu.models.transformer import ModelConfig, TransformerLM
 from torchacc_tpu.train.trainer import Trainer
 
@@ -38,6 +39,9 @@ def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
         remat=config.memory.gc,
         remat_policy=config.memory.gc_policy,
         context_parallel=config.dist.sp.size > 1,
+        pp_size=config.dist.pp.size,
+        pp_num_micro=config.dist.pp.num_micro_batches,
+        logical_axis_rules=tuple(make_rules(config)),
     )
     return dataclasses.replace(mc, **updates)
 
