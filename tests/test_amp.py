@@ -1,0 +1,128 @@
+"""Mixed precision + remat tests: fp16 dynamic loss scaling (in-jit
+GradScaler — reference core/amp.py), overflow step-skipping, remat
+policies incl. host offload names."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchacc_tpu as ta
+from torchacc_tpu.models import get_preset
+from torchacc_tpu.train import accelerate
+from torchacc_tpu.train.amp import (
+    all_finite,
+    scaler_init,
+    scaler_update,
+    select_tree,
+)
+
+
+def _model(**kw):
+    return get_preset("llama-tiny", vocab_size=128, hidden_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      intermediate_size=128, **kw)
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 128, size=(4, 32))
+    for _ in range(n):
+        yield {"input_ids": data[rng.integers(0, 4, size=8)].astype(np.int32)}
+
+
+def test_scaler_update_semantics():
+    s = scaler_init(1024.0)
+    # overflow -> halve, reset count
+    s2 = scaler_update(s, jnp.asarray(False))
+    assert float(s2["scale"]) == 512.0 and int(s2["growth_count"]) == 0
+    # good steps accumulate; growth at interval
+    s3 = scaler_update(s, jnp.asarray(True), growth_interval=2)
+    assert float(s3["scale"]) == 1024.0 and int(s3["growth_count"]) == 1
+    s4 = scaler_update(s3, jnp.asarray(True), growth_interval=2)
+    assert float(s4["scale"]) == 2048.0 and int(s4["growth_count"]) == 0
+
+
+def test_all_finite_and_select():
+    good = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+    bad = {"a": jnp.array([1.0, jnp.inf, 0.0]), "b": jnp.zeros(2)}
+    assert bool(all_finite(good))
+    assert not bool(all_finite(bad))
+    sel = select_tree(jnp.asarray(False), good, bad)
+    assert not bool(all_finite(sel))
+
+
+def test_fp16_training_decreases_loss(devices):
+    import optax
+    cfg = ta.Config(compute=ta.ComputeConfig(dtype="float16"))
+    trainer, loader = accelerate(_model(), _batches(15), cfg,
+                                 optimizer=optax.adam(1e-3))
+    metrics = [trainer.step(b) for b in loader]
+    losses = [float(m["loss"]) for m in metrics]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+    assert float(metrics[-1]["loss_scale"]) > 0
+    assert trainer.state.scaler is not None
+
+
+def test_fp16_overflow_skips_step(devices):
+    """A loss that overflows must leave params untouched and halve the
+    scale; training continues afterwards."""
+    import optax
+    from torchacc_tpu.models.transformer import loss_sum_count
+    from torchacc_tpu.train.trainer import shift_labels
+
+    def exploding_loss(logits, batch):
+        l, c = loss_sum_count(
+            logits, batch.get("labels", shift_labels(batch["input_ids"])))
+        bomb = jnp.where(batch["bomb"][0, 0] > 0, jnp.float32(3e38), 1.0)
+        return l * bomb * bomb, c
+
+    cfg = ta.Config(compute=ta.ComputeConfig(dtype="float16"))
+    trainer, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3),
+                            loss=exploding_loss)
+    trainer.init()
+    batches = list(_batches(2))
+    b0 = dict(batches[0], bomb=np.zeros((8, 32), np.int32))
+    trainer.step(b0)
+    params_before = jax.tree.map(np.asarray, jax.device_get(
+        trainer.state.params))
+    scale_before = float(trainer.state.scaler["scale"])
+
+    b_bomb = dict(batches[1], bomb=np.ones((8, 32), np.int32))
+    trainer.step(b_bomb)
+    params_after = jax.device_get(trainer.state.params)
+    for a, b in zip(jax.tree.leaves(params_before),
+                    jax.tree.leaves(params_after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(trainer.state.scaler["scale"]) == scale_before / 2
+
+    trainer.step(b0)  # recovers
+
+
+@pytest.mark.parametrize("policy", ["nothing", "dots",
+                                    "dots_with_no_batch_dims"])
+def test_remat_policies_train(devices, policy):
+    import optax
+    cfg = ta.Config(memory=ta.MemoryConfig(gc=True, gc_policy=policy))
+    trainer, loader = accelerate(_model(), _batches(3), cfg,
+                                 optimizer=optax.adam(1e-3))
+    for b in loader:
+        m = trainer.step(b)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_offload_policy_compiles(devices):
+    """'offload_dots' host-offload policy: on CPU (no memories-API custom
+    calls) it must fall back to 'dots' and still train; the true offload
+    path only exists on TPU."""
+    import optax
+
+    cfg = ta.Config(memory=ta.MemoryConfig(gc=True, gc_policy="offload_dots"))
+    trainer, loader = accelerate(_model(), _batches(2), cfg,
+                                 optimizer=optax.adam(1e-3))
+    for b in loader:
+        m = trainer.step(b)
+    assert np.isfinite(float(m["loss"]))

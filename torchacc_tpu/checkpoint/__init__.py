@@ -1,0 +1,17 @@
+from torchacc_tpu.checkpoint.io import (
+    CheckpointManager,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from torchacc_tpu.checkpoint.reshard import (
+    consolidate_checkpoint,
+    reshard_checkpoint,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "consolidate_checkpoint",
+    "reshard_checkpoint",
+]

@@ -1,0 +1,70 @@
+"""``consolidate-and-reshard-ckpts`` console tool.
+
+Mirrors the reference CLI surface (setup.py:36-40 console script ->
+utils/consolidate_and_reshard_ckpts.py argparse main): point it at a
+sharded checkpoint, get a consolidated copy or a copy resharded for a
+new parallel layout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="consolidate_and_reshard_ckpts",
+        description="Consolidate or reshard torchacc_tpu checkpoints.")
+    p.add_argument("--ckpt_dir", required=True, help="source checkpoint")
+    p.add_argument("--save_dir", required=True, help="destination")
+    p.add_argument("--reshard_num", type=int, default=1,
+                   help="target fsdp shard count (1 = consolidate only)")
+    p.add_argument("--mesh_axis", default="fsdp",
+                   help="mesh axis to reshard over (default fsdp)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    from torchacc_tpu.checkpoint.reshard import (
+        consolidate_checkpoint,
+        reshard_checkpoint,
+    )
+
+    if args.reshard_num <= 1:
+        consolidate_checkpoint(args.ckpt_dir, args.save_dir)
+        return 0
+
+    import numpy as np
+    import orbax.checkpoint as ocp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = jax.devices()
+    if len(devs) < args.reshard_num:
+        print(f"error: {args.reshard_num} shards requested but only "
+              f"{len(devs)} devices available (set "
+              f"XLA_FLAGS=--xla_force_host_platform_device_count=N "
+              "JAX_PLATFORMS=cpu to reshard offline)", file=sys.stderr)
+        return 2
+    mesh = Mesh(np.asarray(devs[:args.reshard_num]), (args.mesh_axis,))
+
+    # shapes/dtypes from checkpoint metadata — no full host read
+    import os
+    meta = ocp.StandardCheckpointer().metadata(
+        os.path.abspath(args.ckpt_dir)).item_metadata
+
+    def absify(x):
+        shape = tuple(x.shape)
+        spec = PartitionSpec()
+        if len(shape) >= 1 and shape[0] % args.reshard_num == 0 and shape[0]:
+            spec = PartitionSpec(args.mesh_axis)
+        return jax.ShapeDtypeStruct(shape, x.dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    abstract = jax.tree.map(absify, meta)
+    reshard_checkpoint(args.ckpt_dir, args.save_dir, abstract)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Sharded checkpoint save/restore.
+
+Reference: per-rank sharded checkpoints with ``shard_metadata``
+(``ta.save = xm.save`` core/__init__.py:12; FSDP optim-state machinery
+fsdp.py:243-578; threaded shard IO state_dict_utils.py:245-318).  The
+TPU-native story is simpler and stronger: checkpoints store GLOBAL
+arrays (orbax/tensorstore), every host writes only its own shards, and
+restoring under a *different* mesh or parallel layout reshards
+automatically — the reference's flatten/unpad/reshard bookkeeping
+(`_shard_size_multiple=128` invariants, state_dict_utils.py:357-429)
+has no equivalent because nothing is ever flattened.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from torchacc_tpu.train.state import TrainState
+from torchacc_tpu.utils.logger import logger
+
+
+def save_checkpoint(path: str, state: Any, *, force: bool = False) -> None:
+    """Save a pytree (e.g. TrainState) as a sharded global checkpoint."""
+    path = os.path.abspath(os.fspath(path))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, state, force=force)
+    ckptr.wait_until_finished()
+    logger.info(f"saved checkpoint to {path}")
+
+
+def restore_checkpoint(
+    path: str,
+    abstract_state: Optional[Any] = None,
+) -> Any:
+    """Restore a checkpoint.
+
+    ``abstract_state``: pytree of jax.ShapeDtypeStruct (with .sharding
+    set to the target NamedShardings) — restore reshards to it, whatever
+    layout the checkpoint was saved under.  None restores host-side
+    (replicated) arrays, useful for inspection/consolidation.
+    """
+    path = os.path.abspath(os.fspath(path))
+    ckptr = ocp.StandardCheckpointer()
+    if abstract_state is None:
+        return ckptr.restore(path)
+    return ckptr.restore(path, abstract_state)
+
+
+class CheckpointManager:
+    """Step-tracked checkpoint directory with retention.
+
+    Reference analogue: the training scripts' periodic ``ta.save`` +
+    offline consolidation; here rotation/retention is built in.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+            ),
+        )
+
+    def save(self, step: int, state: Any) -> bool:
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+        return saved
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError("no checkpoint found")
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return self._mgr.all_steps()
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

@@ -1,0 +1,45 @@
+"""Offline checkpoint consolidate / reshard.
+
+Reference: the ``consolidate_and_reshard_fsdp_ckpts`` console tool
+(setup.py:36-40, utils/consolidate_and_reshard_ckpts.py:12-157,
+state_dict_utils.py:552-738) that merges per-rank FSDP shard files and
+re-splits them for a different world size.  Because TPU-native
+checkpoints store global arrays (checkpoint/io.py), both operations are
+a restore + re-save:
+
+- consolidate: restore host-side -> save (a fully replicated layout any
+  single process can read).
+- reshard: restore under the TARGET mesh/shardings -> save.  Works
+  across arbitrary source/target parallel layouts (fsdp N -> M, adding
+  tp, ...), the generalisation of the reference's reshard_num.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from torchacc_tpu.checkpoint.io import restore_checkpoint, save_checkpoint
+from torchacc_tpu.utils.logger import logger
+
+
+def consolidate_checkpoint(src: str, dst: str) -> None:
+    """Merge a sharded checkpoint into a single consolidated one."""
+    state = restore_checkpoint(src)
+    state = jax.tree.map(np.asarray, state)
+    save_checkpoint(dst, state)
+    n = sum(x.size for x in jax.tree.leaves(state))
+    logger.info(f"consolidated {n/1e6:.1f}M elements: {src} -> {dst}")
+
+
+def reshard_checkpoint(
+    src: str,
+    dst: str,
+    abstract_state: Any,
+) -> None:
+    """Re-save ``src`` laid out per ``abstract_state``'s shardings."""
+    state = restore_checkpoint(src, abstract_state)
+    save_checkpoint(dst, state)
+    logger.info(f"resharded {src} -> {dst}")

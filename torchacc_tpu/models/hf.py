@@ -1,0 +1,142 @@
+"""HuggingFace model ingestion: torch state_dict -> torchacc_tpu params.
+
+The reference accelerates HF models in place via monkeypatching
+(utils/patch.py:61-301, qwen_patch.py, accelerate_hf_trainer.py) because
+it shares torch's module system.  The TPU-native framework instead
+*converts*: an HF checkpoint's weights are mapped onto the zoo's
+:class:`TransformerLM` layout (scan-stacked layers), after which every
+framework feature (FSDP/TP/PP/CP shardings, Pallas kernels, remat,
+checkpointing) applies with zero model-specific code.
+
+Supported families: Llama (1/2/3), Qwen2 (qkv bias), Mistral — the same
+set the reference patches.  GPT-2 uses the 'learned' position variant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchacc_tpu.models.transformer import ModelConfig
+
+
+def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
+    """ModelConfig from a transformers PretrainedConfig (llama/qwen2/
+    mistral family)."""
+    get = lambda n, d=None: getattr(hf_config, n, d)
+    kw = dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        num_layers=get("num_hidden_layers"),
+        num_heads=get("num_attention_heads"),
+        num_kv_heads=get("num_key_value_heads", get("num_attention_heads")),
+        intermediate_size=get("intermediate_size"),
+        max_seq_len=get("max_position_embeddings", 4096),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        qkv_bias=bool(get("attention_bias", False)
+                      or get("model_type") == "qwen2"),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+    if get("sliding_window") and get("use_sliding_window", True):
+        kw["window"] = (int(get("sliding_window")), -1)
+    kw.update(overrides)
+    return ModelConfig(**kw)
+
+
+def _t(x) -> np.ndarray:
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x)
+
+
+def params_from_hf_state_dict(
+    state_dict: Mapping[str, Any],
+    cfg: ModelConfig,
+    dtype=None,
+) -> Dict[str, Any]:
+    """Map an HF llama/qwen2-style state_dict to TransformerLM params.
+
+    HF linear weights are [out, in]; flax kernels are [in, out] (and
+    DenseGeneral splits heads), so weights are transposed/reshaped.
+    Layers are stacked on a leading dim for scan-over-layers.
+    """
+    dtype = dtype or cfg.param_dtype
+    L = cfg.num_layers
+    h = cfg.hidden_size
+    nh, nk, d = cfg.num_heads, cfg.kv_heads, cfg.head_size
+
+    def get(name):
+        for prefix in ("model.", ""):
+            key = prefix + name
+            if key in state_dict:
+                return _t(state_dict[key])
+        raise KeyError(f"missing weight {name!r} in state_dict")
+
+    def stack(fmt, transform):
+        return np.stack([transform(get(fmt.format(i=i))) for i in range(L)])
+
+    qkv = lambda w, heads: w.T.reshape(h, heads, d)
+
+    attn = {
+        "q_proj": {"kernel": stack("layers.{i}.self_attn.q_proj.weight",
+                                   lambda w: qkv(w, nh))},
+        "k_proj": {"kernel": stack("layers.{i}.self_attn.k_proj.weight",
+                                   lambda w: qkv(w, nk))},
+        "v_proj": {"kernel": stack("layers.{i}.self_attn.v_proj.weight",
+                                   lambda w: qkv(w, nk))},
+        "o_proj": {"kernel": stack("layers.{i}.self_attn.o_proj.weight",
+                                   lambda w: w.T.reshape(nh, d, h))},
+    }
+    if cfg.qkv_bias:
+        for name, heads in (("q_proj", nh), ("k_proj", nk), ("v_proj", nk)):
+            attn[name]["bias"] = stack(
+                f"layers.{{i}}.self_attn.{name}.bias",
+                lambda b, heads=heads: b.reshape(heads, d))
+
+    block = {
+        "attn": attn,
+        "mlp": {
+            "gate_proj": {"kernel": stack(
+                "layers.{i}.mlp.gate_proj.weight", lambda w: w.T)},
+            "up_proj": {"kernel": stack(
+                "layers.{i}.mlp.up_proj.weight", lambda w: w.T)},
+            "down_proj": {"kernel": stack(
+                "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
+        },
+        "ln1": {"scale": stack("layers.{i}.input_layernorm.weight",
+                               lambda w: w)},
+        "ln2": {"scale": stack(
+            "layers.{i}.post_attention_layernorm.weight", lambda w: w)},
+    }
+    params: Dict[str, Any] = {
+        "embed_tokens": {"embedding": get("embed_tokens.weight")},
+        "layers": {"block": block},
+        "final_norm": {"scale": get("norm.weight")},
+    }
+    if not cfg.tie_embeddings:
+        # lm_head lives at the top level in HF models
+        head = state_dict.get("lm_head.weight")
+        if head is None:
+            raise KeyError("lm_head.weight missing and tie_embeddings=False")
+        params["lm_head"] = {"kernel": _t(head).T}
+
+    import jax
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+
+def load_hf_model(model_or_path: Any, **config_overrides
+                  ) -> Tuple[ModelConfig, Dict[str, Any]]:
+    """(ModelConfig, params) from a transformers model instance or a
+    local checkpoint path."""
+    if isinstance(model_or_path, str):
+        import transformers
+        model = transformers.AutoModelForCausalLM.from_pretrained(
+            model_or_path)
+    else:
+        model = model_or_path
+    cfg = config_from_hf(model.config, **config_overrides)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    return cfg, params

@@ -216,15 +216,18 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
+        from jax.ad_checkpoint import checkpoint_name
         cfg = self.cfg
-        h = x + Attention(cfg, name="attn")(
+        attn_out = Attention(cfg, name="attn")(
             Norm(cfg, name="ln1")(x), positions, segment_ids)
+        # names referenced by the 'offload_dots' remat policy (utils/remat.py)
+        h = x + checkpoint_name(attn_out, "attn_out")
         if cfg.num_experts > 0:
             from torchacc_tpu.models.moe import MoEMlp
             mlp_out = MoEMlp(cfg, name="moe")(Norm(cfg, name="ln2")(h))
         else:
             mlp_out = Mlp(cfg, name="mlp")(Norm(cfg, name="ln2")(h))
-        return h + mlp_out
+        return h + checkpoint_name(mlp_out, "mlp_out")
 
 
 class ScanBlock(nn.Module):

@@ -1,0 +1,63 @@
+"""Mixed precision: fp16 dynamic loss scaling, fully inside jit.
+
+Reference: ``torchacc.amp.GradScaler`` (core/amp.py:9-42) subclasses the
+torch_xla scaler and all-reduces found_inf across groups; the *syncfree*
+CUDA optimizers (utils/patch.py:55-57) exist solely to avoid a host
+round-trip on the inf check.  On TPU the whole scaler lives inside the
+compiled step: the finite-check selects between updated and previous
+state with ``jnp.where`` — no host sync by construction, no syncfree
+optimizer variants needed.
+
+bf16 training needs none of this (the reference reaches the same
+conclusion — scaler only activates for fp16).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def scaler_init(init_scale: float = 2.0 ** 15) -> Dict[str, jax.Array]:
+    """Dynamic-loss-scale state (torch GradScaler semantics: growth 2x
+    every ``growth_interval`` good steps, 0.5x backoff on overflow)."""
+    return {
+        "scale": jnp.asarray(init_scale, jnp.float32),
+        "growth_count": jnp.zeros((), jnp.int32),
+    }
+
+
+def all_finite(tree: Any) -> jax.Array:
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree)]
+    return jnp.all(jnp.stack(leaves))
+
+
+def scaler_update(
+    scaler: Dict[str, jax.Array],
+    grads_finite: jax.Array,
+    *,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 2000,
+    max_scale: float = 2.0 ** 24,
+    min_scale: float = 1.0,
+) -> Dict[str, jax.Array]:
+    count = scaler["growth_count"] + 1
+    grow = jnp.logical_and(grads_finite, count >= growth_interval)
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(grow, jnp.minimum(scaler["scale"] * growth_factor,
+                                    max_scale),
+                  scaler["scale"]),
+        jnp.maximum(scaler["scale"] * backoff_factor, min_scale))
+    new_count = jnp.where(jnp.logical_or(grow, ~grads_finite), 0, count)
+    return {"scale": new_scale, "growth_count": new_count}
+
+
+def select_tree(pred: jax.Array, on_true: Any, on_false: Any) -> Any:
+    """Element-wise tree select (the no-host-sync conditional step)."""
+    return jax.tree.map(
+        lambda t, f: jnp.where(pred, t, f) if t is not None else None,
+        on_true, on_false, is_leaf=lambda x: x is None)

@@ -22,6 +22,8 @@ class TrainState(flax.struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # fp16 dynamic loss-scale state (train/amp.py); None for fp32/bf16
+    scaler: Any = None
 
 
 def _path_str(path) -> str:
@@ -55,7 +57,9 @@ def state_logical_axes(abstract_state: TrainState, params_axes: Any) -> TrainSta
         return (None,) * leaf.ndim
 
     opt_axes = tree_map_with_path(match, abstract_state.opt_state)
-    return TrainState(step=(), params=params_axes, opt_state=opt_axes)
+    scaler_axes = jax.tree.map(lambda _: (), abstract_state.scaler)
+    return TrainState(step=(), params=params_axes, opt_state=opt_axes,
+                      scaler=scaler_axes)
 
 
 def init_train_state(
@@ -63,6 +67,7 @@ def init_train_state(
     model,
     optimizer,
     sample_input: Optional[jax.Array] = None,
+    use_scaler: bool = False,
 ) -> TrainState:
     """Host-side (unsharded) init — used under jit with out_shardings so
     parameters materialise directly into their shards."""
@@ -70,5 +75,9 @@ def init_train_state(
         sample_input = jnp.zeros((1, 8), dtype=jnp.int32)
     params = model.init(rng, sample_input)["params"]
     opt_state = optimizer.init(params)
+    scaler = None
+    if use_scaler:
+        from torchacc_tpu.train.amp import scaler_init
+        scaler = scaler_init()
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      opt_state=opt_state)
+                      opt_state=opt_state, scaler=scaler)

@@ -77,13 +77,19 @@ class Trainer:
                                    "router_aux_weight", 0.0)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
+        self._abstract: Optional[TrainState] = None
         self.batch_sharding = NamedSharding(self.mesh, batch_spec(config))
         self._train_step = None
         self._metrics_sharding = NamedSharding(self.mesh, PartitionSpec())
 
     # -- init ---------------------------------------------------------------
-    def init(self, rng: Optional[jax.Array] = None,
-             sample_input: Optional[jax.Array] = None) -> TrainState:
+    def resolve_shardings(
+        self, rng: Optional[jax.Array] = None,
+        sample_input: Optional[jax.Array] = None,
+    ):
+        """Compute abstract state + NamedShardings WITHOUT materialising
+        anything on device (restore() uses this directly so a checkpoint
+        load never pays for a throwaway init)."""
         if rng is None:
             rng = jax.random.PRNGKey(self.config.seed)
         if sample_input is None:
@@ -93,8 +99,10 @@ class Trainer:
             bs = m.get("dp", 1) * m.get("fsdp", 1)
             sq = 8 * m.get("sp", 1) * m.get("spu", 1)
             sample_input = jnp.zeros((bs, sq), jnp.int32)
+        use_scaler = self.config.compute.dtype == "float16"
         init_fn = lambda r: init_train_state(
-            r, self.model, self.optimizer, sample_input)
+            r, self.model, self.optimizer, sample_input,
+            use_scaler=use_scaler)
         abstract = jax.eval_shape(init_fn, rng)
         p_axes = (resolve_param_axes(abstract.params)
                   if self._axes_rules is None
@@ -107,7 +115,15 @@ class Trainer:
                                   self.rules, min_sz),
             opt_state=tree_shardings(self.mesh, abstract.opt_state,
                                      st_axes.opt_state, self.rules, min_sz),
+            scaler=tree_shardings(self.mesh, abstract.scaler,
+                                  st_axes.scaler, self.rules),
         )
+        self._abstract = abstract
+        return init_fn, rng
+
+    def init(self, rng: Optional[jax.Array] = None,
+             sample_input: Optional[jax.Array] = None) -> TrainState:
+        init_fn, rng = self.resolve_shardings(rng, sample_input)
         with jax.sharding.set_mesh(self.mesh):
             self.state = jax.jit(
                 init_fn, out_shardings=self.state_shardings)(rng)
@@ -142,15 +158,24 @@ class Trainer:
         accum = self.config.grad_accum
         optimizer = self.optimizer
         fsc = self._forward_sum_count
+        use_scaler = self.config.compute.dtype == "float16"
 
         def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+            # fp16: scale the loss so small grads survive the fp16 range
+            # (reference GradScaler core/amp.py; here fully in-jit)
+            scale = (state.scaler["scale"] if use_scaler
+                     else jnp.asarray(1.0, jnp.float32))
             if accum > 1:
                 bsz = batch["input_ids"].shape[0]
                 if bsz % accum != 0:
                     raise ValueError(
                         f"batch size {bsz} not divisible by grad_accum {accum}")
 
-                grad_sum = jax.value_and_grad(fsc, has_aux=True)
+                def scaled_sum(p, mb):
+                    l, c = fsc(p, mb)
+                    return l * scale, c
+
+                grad_sum = jax.value_and_grad(scaled_sum, has_aux=True)
 
                 def micro(carry, mb):
                     g_acc, l_acc, c_acc = carry
@@ -165,23 +190,48 @@ class Trainer:
                 (grads, loss_sum, count), _ = jax.lax.scan(
                     micro, (zeros, jnp.zeros((), jnp.float32),
                             jnp.zeros((), jnp.float32)), mbs)
-                denom = jnp.maximum(count, 1.0)
+                denom = jnp.maximum(count, 1.0) * scale
                 grads = jax.tree.map(lambda g: g / denom, grads)
                 loss_val = loss_sum / denom
             else:
                 def scalar(p):
                     l, c = fsc(p, batch)
-                    return l / jnp.maximum(c, 1.0)
-                loss_val, grads = jax.value_and_grad(scalar)(state.params)
-            updates, new_opt = optimizer.update(
-                grads, state.opt_state, state.params)
-            new_params = optax.apply_updates(state.params, updates)
+                    return (l / jnp.maximum(c, 1.0)) * scale
+                loss_s, grads = jax.value_and_grad(scalar)(state.params)
+                grads = jax.tree.map(lambda g: g / scale, grads)
+                loss_val = loss_s / scale
+
+            new_scaler = state.scaler
+            if use_scaler:
+                from torchacc_tpu.train.amp import (
+                    all_finite,
+                    scaler_update,
+                    select_tree,
+                )
+                finite = all_finite(grads)
+                safe_grads = jax.tree.map(
+                    lambda g: jnp.where(jnp.isfinite(g), g, 0.0), grads)
+                updates, opt_candidate = optimizer.update(
+                    safe_grads, state.opt_state, state.params)
+                params_candidate = optax.apply_updates(state.params, updates)
+                # skip the step entirely on overflow — no host sync
+                new_params = select_tree(finite, params_candidate,
+                                         state.params)
+                new_opt = select_tree(finite, opt_candidate, state.opt_state)
+                new_scaler = scaler_update(state.scaler, finite)
+            else:
+                updates, new_opt = optimizer.update(
+                    grads, state.opt_state, state.params)
+                new_params = optax.apply_updates(state.params, updates)
+
             metrics = {
                 "loss": loss_val,
                 "grad_norm": optax.global_norm(grads),
             }
+            if use_scaler:
+                metrics["loss_scale"] = new_scaler["scale"]
             return TrainState(step=state.step + 1, params=new_params,
-                              opt_state=new_opt), metrics
+                              opt_state=new_opt, scaler=new_scaler), metrics
 
         return jax.jit(
             train_step,
@@ -199,6 +249,35 @@ class Trainer:
         with jax.sharding.set_mesh(self.mesh):
             self.state, metrics = self._train_step(self.state, batch)
         return metrics
+
+    # -- checkpointing ------------------------------------------------------
+    def abstract_state(self) -> TrainState:
+        """ShapeDtypeStructs with target shardings (for resharded restore).
+        Resolves shardings on demand; nothing is materialised."""
+        if self.state_shardings is None:
+            self.resolve_shardings()
+
+        def one(leaf, sh):
+            if leaf is None:
+                return None
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+        return jax.tree.map(one, self._abstract, self.state_shardings,
+                            is_leaf=lambda x: x is None)
+
+    def save(self, path: str) -> None:
+        """Sharded checkpoint of the full train state (reference:
+        per-rank ``ta.save`` + shard_metadata, docs/source/dist/fsdp.md)."""
+        if self.state is None:
+            raise RuntimeError("nothing to save — call init() (or step) first")
+        from torchacc_tpu.checkpoint import save_checkpoint
+        save_checkpoint(path, self.state)
+
+    def restore(self, path: str) -> TrainState:
+        """Restore (and reshard if the mesh/layout changed).  Does NOT
+        run init first — restored shards are the only allocation."""
+        from torchacc_tpu.checkpoint import restore_checkpoint
+        self.state = restore_checkpoint(path, self.abstract_state())
+        return self.state
 
     # -- eval ---------------------------------------------------------------
     def eval_step(self, batch: Dict[str, jax.Array]) -> jax.Array:

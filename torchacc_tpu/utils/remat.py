@@ -32,9 +32,18 @@ def remat_policy(name: str = "nothing") -> Optional[object]:
     if name == "dots_with_no_batch_dims":
         return cp.checkpoint_dots_with_no_batch_dims
     if name == "offload_dots":
+        from torchacc_tpu.ops._common import on_tpu
+        if not on_tpu():
+            # the memories-API custom calls (annotate_device_placement)
+            # are unimplemented on the CPU backend
+            from torchacc_tpu.utils.logger import logger
+            logger.warning("host offload ('offload_dots') requires a TPU "
+                           "backend; falling back to 'dots'")
+            return cp.checkpoint_dots
+        # names annotated in models/transformer.py Block via checkpoint_name
         return cp.save_and_offload_only_these_names(
             names_which_can_be_saved=[],
-            names_which_can_be_offloaded=["out_proj", "mlp_out", "block_out"],
+            names_which_can_be_offloaded=["attn_out", "mlp_out"],
             offload_src="device", offload_dst="pinned_host",
         )
     raise ValueError(f"unknown remat policy {name!r}")
