@@ -1,0 +1,24 @@
+"""Packaging (reference: setup.py console script + version gen,
+setup.py:10-47)."""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="torchacc_tpu",
+    version="0.1.0",
+    description="TPU-native training-acceleration framework "
+                "(JAX/XLA/Pallas)",
+    packages=find_packages(include=["torchacc_tpu", "torchacc_tpu.*"]),
+    package_data={"torchacc_tpu.data": ["_native/*.cc"]},
+    python_requires=">=3.10",
+    install_requires=[
+        "jax", "flax", "optax", "orbax-checkpoint", "numpy",
+    ],
+    entry_points={
+        "console_scripts": [
+            # reference: consolidate_and_reshard_fsdp_ckpts (setup.py:36-40)
+            "consolidate_and_reshard_ckpts="
+            "torchacc_tpu.checkpoint.cli:main",
+        ],
+    },
+)

@@ -1,0 +1,147 @@
+"""Sequence packing: documents -> fixed-length rows + segment ids.
+
+The native C++ core (_native/pack.cc) does first-fit-decreasing bin
+packing; this module compiles/loads it via ctypes (g++ is part of the
+toolchain) and falls back to a NumPy implementation when no compiler is
+available.  Packed rows feed the varlen flash-attention path
+(segment-id masking), replacing the reference's cu_seqlens plumbing
+(ops/flash_attn.py varlen variants) with static shapes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchacc_tpu.utils.logger import logger
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _load_native():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    src = os.path.join(os.path.dirname(__file__), "_native", "pack.cc")
+    # per-user 0700 cache dir (never a shared world-writable path) +
+    # compile-to-temp + atomic rename so concurrent processes can't load
+    # a half-written library
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), f"torchacc_tpu_native_{os.getuid()}")
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    os.chmod(cache_dir, 0o700)
+    lib_path = os.path.join(cache_dir, "libpack.so")
+    try:
+        if (not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                check=True, capture_output=True)
+            os.replace(tmp, lib_path)
+        lib = ctypes.CDLL(lib_path)
+        lib.pack_plan.restype = ctypes.c_int64
+        lib.pack_plan.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.pack_fill.restype = ctypes.c_int64
+        lib.pack_fill.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32)]
+        _LIB = lib
+        logger.info("native sequence packer loaded")
+    except Exception as e:
+        logger.warning(f"native packer unavailable ({e}); using NumPy "
+                       "fallback")
+        _LIB = None
+    return _LIB
+
+
+def _plan_numpy(lengths: np.ndarray, seq_len: int
+                ) -> Tuple[int, np.ndarray, np.ndarray]:
+    order = np.argsort(-lengths, kind="stable")
+    space: List[int] = []
+    row_of = np.zeros(len(lengths), np.int64)
+    off_of = np.zeros(len(lengths), np.int64)
+    for idx in order:
+        ln = int(min(lengths[idx], seq_len))
+        row = next((r for r, s in enumerate(space) if s >= ln), -1)
+        if row < 0:
+            row = len(space)
+            space.append(seq_len)
+        row_of[idx] = row
+        off_of[idx] = seq_len - space[row]
+        space[row] -= ln
+    return len(space), row_of, off_of
+
+
+def pack_sequences(
+    docs: Sequence[np.ndarray],
+    seq_len: int,
+    pad_id: int = 0,
+) -> Dict[str, np.ndarray]:
+    """Pack token documents into rows.
+
+    Returns {"input_ids", "segment_ids", "positions"} each [rows, seq_len].
+    Padding carries segment id -1 (matches nothing in the attention mask)
+    and position 0; labels derivation remains the caller's job.
+    """
+    docs = [np.asarray(d, np.int32).reshape(-1) for d in docs]
+    lengths = np.asarray([len(d) for d in docs], np.int64)
+    n = len(docs)
+    if n == 0:
+        raise ValueError("no documents to pack")
+    lib = _load_native()
+    row_of = np.zeros(n, np.int64)
+    off_of = np.zeros(n, np.int64)
+    if lib is not None:
+        rows = lib.pack_plan(
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, seq_len,
+            row_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            off_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rows < 0:
+            raise ValueError("pack_plan failed")
+    else:
+        rows, row_of, off_of = _plan_numpy(lengths, seq_len)
+
+    out_tokens = np.full((rows, seq_len), pad_id, np.int32)
+    out_segments = np.full((rows, seq_len), -1, np.int32)
+    out_positions = np.zeros((rows, seq_len), np.int32)
+
+    if lib is not None:
+        flat = (np.concatenate(docs) if docs else
+                np.zeros((0,), np.int32)).astype(np.int32)
+        starts = np.zeros(n + 1, np.int64)
+        np.cumsum(lengths, out=starts[1:])
+        rc = lib.pack_fill(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, seq_len,
+            row_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            off_of.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            out_tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_segments.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out_positions.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise ValueError("pack_fill failed")
+    else:
+        for d, doc in enumerate(docs):
+            ln = min(len(doc), seq_len)
+            r, o = int(row_of[d]), int(off_of[d])
+            out_tokens[r, o:o + ln] = doc[:ln]
+            out_segments[r, o:o + ln] = d
+            out_positions[r, o:o + ln] = np.arange(ln)
+    return {"input_ids": out_tokens, "segment_ids": out_segments,
+            "positions": out_positions}

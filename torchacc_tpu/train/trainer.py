@@ -36,10 +36,22 @@ def _flatten_with_names(tree):
             for path, v in flat]
 
 
-def shift_labels(input_ids: jax.Array) -> jax.Array:
-    """Next-token labels from input_ids (last position ignored)."""
-    return jnp.concatenate(
+def shift_labels(input_ids: jax.Array,
+                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Next-token labels from input_ids (last position ignored).
+
+    With packed sequences, positions whose next token belongs to a
+    different document (or to padding, segment -1) get label -100 so the
+    loss never trains across packing boundaries."""
+    labels = jnp.concatenate(
         [input_ids[:, 1:], jnp.full_like(input_ids[:, :1], -100)], axis=1)
+    if segment_ids is not None:
+        next_seg = jnp.concatenate(
+            [segment_ids[:, 1:], jnp.full_like(segment_ids[:, :1], -1)],
+            axis=1)
+        valid = (next_seg == segment_ids) & (segment_ids >= 0)
+        labels = jnp.where(valid, labels, -100)
+    return labels
 
 
 class Trainer:
@@ -72,7 +84,8 @@ class Trainer:
         # loss(logits, batch) -> scalar mean OR (sum, valid_count); the
         # sum/count form gives exact big-batch equivalence under grad accum.
         self.loss = loss or (lambda logits, batch: loss_sum_count(
-            logits, batch.get("labels", shift_labels(batch["input_ids"]))))
+            logits, batch.get("labels", shift_labels(
+                batch["input_ids"], batch.get("segment_ids")))))
         self._aux_weight = getattr(getattr(model, "cfg", None),
                                    "router_aux_weight", 0.0)
         self.state: Optional[TrainState] = None
