@@ -1,0 +1,21 @@
+# Reference: Makefile `test` target (Makefile:7-9 — two pytest passes
+# under PJRT_USE_TORCH_ALLOCATOR).  Here: one suite on an emulated
+# 8-device CPU mesh; kernels run in interpret mode.
+
+PYTEST ?= python -m pytest
+
+.PHONY: test bench lint dryrun
+
+test:
+	$(PYTEST) tests/ -q
+
+bench:
+	python bench.py
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -c "import jax; jax.config.update('jax_platforms','cpu'); \
+	import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+lint:
+	python -m compileall -q torchacc_tpu benchmarks bench.py __graft_entry__.py

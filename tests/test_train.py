@@ -151,6 +151,25 @@ def test_pad_batch_keeps_1d_features():
     assert out["weight"].shape == (4,)
 
 
+def test_fit_loop(devices, tmp_path):
+    import optax
+    cfg = ta.Config()
+    trainer, loader = accelerate(_tiny_model(), _toy_batches(12), cfg,
+                                 optimizer=optax.adam(3e-3))
+    history = trainer.fit(loader, max_steps=10, log_every=2,
+                          eval_loader=list(_toy_batches(2, seed=9)),
+                          eval_every=4,
+                          checkpoint_dir=str(tmp_path / "run"),
+                          checkpoint_every=5)
+    assert len(history) == 5
+    assert history[-1]["loss"] < history[0]["loss"]
+    assert any("eval_loss" in h for h in history)
+    from torchacc_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "run"))
+    assert mgr.latest_step() is not None
+    mgr.close()
+
+
 def test_async_loader_buckets_and_shards(devices):
     cfg = ta.Config(
         dist=ta.DistConfig(dp=ta.DPConfig(size=8)),

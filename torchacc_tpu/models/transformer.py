@@ -250,7 +250,8 @@ class TransformerLM(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, segment_ids=None):
+    def __call__(self, input_ids, positions=None, segment_ids=None,
+                 return_hidden=False):
         cfg = self.cfg
         if cfg.pp_size > 1 and not cfg.scan_layers:
             raise ValueError(
@@ -310,6 +311,10 @@ class TransformerLM(nn.Module):
                     cfg, name=f"layers_{i}")((x, positions, segment_ids), None)
 
         x = Norm(cfg, name="final_norm")(x)
+        if return_hidden:
+            # fused linear+CE path (ops/fused.py): the caller applies the
+            # head matmul chunk-by-chunk inside the loss
+            return x
         if cfg.tie_embeddings:
             logits = emb.attend(x)
         else:

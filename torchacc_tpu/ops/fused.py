@@ -1,0 +1,78 @@
+"""Fused / memory-lean ops the reference gets from Liger Triton kernels
+(ops/liger.py:32-130: RMSNorm, SwiGLU, RoPE, fused linear-cross-entropy).
+
+On TPU, XLA already fuses RMSNorm/SwiGLU/RoPE elementwise chains into
+their neighbouring matmuls, so those need no kernels (the reference
+itself notes Liger is an eager-backend fallback).  The one that matters
+is **fused linear + cross entropy**: computing ``hidden @ W_head`` and
+the CE loss per sequence chunk — with the backward recomputing each
+chunk's logits — keeps peak memory at O(chunk x vocab) instead of
+materialising the full [batch*seq, vocab] float32 logits (+ its
+softmax) that otherwise dominates HBM at large vocab.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_linear_cross_entropy(
+    hidden: jax.Array,
+    w_head: jax.Array,
+    labels: jax.Array,
+    *,
+    chunk_rows: int = 1024,
+) -> Tuple[jax.Array, jax.Array]:
+    """(loss_sum, valid_count) of next-token CE without full logits.
+
+    hidden: [batch, seq, H]; w_head: [H, V]; labels: [batch, seq] with
+    -100 ignored.  Equivalent to ``loss_sum_count(hidden @ w_head,
+    labels)`` but chunked over rows with rematerialised logits, so the
+    [rows, V] buffer exists only one chunk at a time in fwd AND bwd.
+    """
+    b, s, h = hidden.shape
+    v = w_head.shape[1]
+    n = b * s
+    x = hidden.reshape(n, h)
+    y = labels.reshape(n)
+
+    pad = (-n) % chunk_rows
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad, h), x.dtype)], axis=0)
+        y = jnp.concatenate(
+            [y, jnp.full((pad,), -100, y.dtype)], axis=0)
+    chunks = (n + pad) // chunk_rows
+    xc = x.reshape(chunks, chunk_rows, h)
+    yc = y.reshape(chunks, chunk_rows)
+
+    def one_chunk(xi, yi):
+        # operands stay in the model dtype (bf16 MXU throughput); the
+        # accumulation and all loss arithmetic are f32
+        logits = jnp.dot(xi, w_head.astype(xi.dtype),
+                         preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = yi != -100
+        safe = jnp.where(valid, yi, 0)
+        ll = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        loss = jnp.sum(jnp.where(valid, lse - ll, 0.0))
+        count = jnp.sum(valid).astype(jnp.float32)
+        return loss, count
+
+    # remat: backward recomputes each chunk's logits instead of saving them
+    one_chunk = jax.checkpoint(one_chunk,
+                               policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(carry, xy):
+        l_acc, c_acc = carry
+        l, c = one_chunk(*xy)
+        return (l_acc + l, c_acc + c), None
+
+    (loss_sum, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (xc, yc))
+    return loss_sum, count

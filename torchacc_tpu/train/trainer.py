@@ -88,6 +88,11 @@ class Trainer:
                 batch["input_ids"], batch.get("segment_ids")))))
         self._aux_weight = getattr(getattr(model, "cfg", None),
                                    "router_aux_weight", 0.0)
+        # fused linear+CE (ops/fused.py): default loss only, zoo model only
+        from torchacc_tpu.models.transformer import TransformerLM
+        self._use_fused_ce = (loss is None
+                              and config.compute.fused_kernels
+                              and isinstance(model, TransformerLM))
         self.state: Optional[TrainState] = None
         self.state_shardings = None
         self._abstract: Optional[TrainState] = None
@@ -149,17 +154,34 @@ class Trainer:
     def _forward_sum_count(self, params, batch):
         """(loss_sum, token_count) incl. sown auxiliary losses (MoE router
         load-balance — models/moe.py) weighted per token."""
-        out = self.model.apply(
-            {"params": params}, batch["input_ids"],
-            positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"),
-            mutable=["intermediates"])
-        logits, mutated = out
-        res = self.loss(logits, batch)
-        if isinstance(res, tuple):
-            l_sum, count = res
+        if self._use_fused_ce:
+            from torchacc_tpu.ops.fused import fused_linear_cross_entropy
+            hidden, mutated = self.model.apply(
+                {"params": params}, batch["input_ids"],
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"),
+                return_hidden=True,
+                mutable=["intermediates"])
+            if "lm_head" in params:
+                w_head = params["lm_head"]["kernel"]
+            else:  # tied embeddings
+                w_head = params["embed_tokens"]["embedding"].T
+            labels = batch.get("labels", shift_labels(
+                batch["input_ids"], batch.get("segment_ids")))
+            l_sum, count = fused_linear_cross_entropy(
+                hidden, w_head, labels)
         else:
-            l_sum, count = res, jnp.asarray(1.0, jnp.float32)
+            out = self.model.apply(
+                {"params": params}, batch["input_ids"],
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"),
+                mutable=["intermediates"])
+            logits, mutated = out
+            res = self.loss(logits, batch)
+            if isinstance(res, tuple):
+                l_sum, count = res
+            else:
+                l_sum, count = res, jnp.asarray(1.0, jnp.float32)
         if self._aux_weight:
             aux = sum(jnp.sum(jnp.asarray(v)) for path, v in
                       _flatten_with_names(mutated.get("intermediates", {}))
@@ -291,6 +313,56 @@ class Trainer:
         from torchacc_tpu.checkpoint import restore_checkpoint
         self.state = restore_checkpoint(path, self.abstract_state())
         return self.state
+
+    # -- high-level loop ----------------------------------------------------
+    def fit(
+        self,
+        loader,
+        *,
+        max_steps: Optional[int] = None,
+        eval_loader=None,
+        eval_every: Optional[int] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1000,
+        log_every: int = 50,
+    ):
+        """Run the training loop (reference analogue: the HF-Trainer
+        integration the reference enables via accelerate_hf_trainer.py —
+        here a native loop with logging/eval/checkpointing built in).
+
+        Returns a list of {step, loss, ...} log records."""
+        import time as _time
+        mgr = None
+        if checkpoint_dir is not None:
+            from torchacc_tpu.checkpoint import CheckpointManager
+            mgr = CheckpointManager(checkpoint_dir,
+                                    save_interval_steps=checkpoint_every)
+        history = []
+        t0 = _time.perf_counter()
+        import itertools
+        bounded = (itertools.islice(loader, max_steps)
+                   if max_steps is not None else loader)
+        for step_idx, batch in enumerate(bounded):
+            metrics = self.step(batch)
+            do_log = log_every and step_idx % log_every == 0
+            do_eval = (eval_loader is not None and eval_every
+                       and step_idx and step_idx % eval_every == 0)
+            if do_log or do_eval:
+                rec = {"step": step_idx,
+                       "loss": float(metrics["loss"]),
+                       "time_s": round(_time.perf_counter() - t0, 2)}
+                if do_eval:
+                    evs = [float(self.eval_step(eb)) for eb in eval_loader]
+                    rec["eval_loss"] = sum(evs) / max(len(evs), 1)
+                history.append(rec)
+                logger.info(f"step {step_idx}: loss {rec['loss']:.4f}")
+            if mgr is not None:
+                # label = completed-step count == state.step after this step
+                mgr.save(step_idx + 1, self.state)
+        if mgr is not None:
+            mgr.wait_until_finished()
+            mgr.close()
+        return history
 
     # -- eval ---------------------------------------------------------------
     def eval_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
