@@ -1,4 +1,7 @@
 from torchacc_tpu.data.async_loader import AsyncLoader
 from torchacc_tpu.data.bucketing import closest_bucket, pad_batch
+from torchacc_tpu.data.dataset import PackedDataset
+from torchacc_tpu.data.packing import pack_sequences
 
-__all__ = ["AsyncLoader", "closest_bucket", "pad_batch"]
+__all__ = ["AsyncLoader", "closest_bucket", "pad_batch", "PackedDataset",
+           "pack_sequences"]

@@ -1,13 +1,21 @@
 from torchacc_tpu.models.axes import TRANSFORMER_AXES, param_axes
+from torchacc_tpu.models.generate import generate
+from torchacc_tpu.models.hf import (
+    config_from_hf,
+    load_hf_model,
+    params_from_hf_state_dict,
+)
 from torchacc_tpu.models.presets import PRESETS, get_preset
-from torchacc_tpu.models.transformer import ModelConfig, TransformerLM, loss_fn
+from torchacc_tpu.models.transformer import (
+    ModelConfig,
+    TransformerLM,
+    loss_fn,
+    loss_sum_count,
+)
 
 __all__ = [
-    "ModelConfig",
-    "TransformerLM",
-    "loss_fn",
-    "param_axes",
-    "TRANSFORMER_AXES",
-    "PRESETS",
-    "get_preset",
+    "ModelConfig", "TransformerLM", "loss_fn", "loss_sum_count",
+    "param_axes", "TRANSFORMER_AXES", "PRESETS", "get_preset",
+    "generate", "config_from_hf", "load_hf_model",
+    "params_from_hf_state_dict",
 ]

@@ -1,0 +1,77 @@
+"""Greedy/sampling text generation (full-prefix recompute, no KV cache).
+
+Beyond the reference (TorchAcc is training-only; its accuracy benchmark
+shells out to vLLM for inference).  Each decode step re-runs the padded
+forward — O(n^2) compute but a single static shape, so exactly one
+compile; right for eval/sanity generation, not for serving.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("model", "temperature"))
+def _decode_step(model, params, tokens, cur, rng, temperature):
+    b = tokens.shape[0]
+    logits = model.apply({"params": params}, tokens)
+    # logits at position cur-1 predict token cur
+    next_logits = jnp.take_along_axis(
+        logits, (cur - 1)[None, None, None].repeat(b, 0), axis=1)[:, 0]
+    rng, sub = jax.random.split(rng)
+    if temperature > 0:
+        nxt = jax.random.categorical(sub, next_logits / temperature)
+    else:
+        nxt = jnp.argmax(next_logits, axis=-1)
+    return tokens.at[:, cur].set(nxt.astype(jnp.int32)), rng
+
+
+def generate(
+    model,
+    params,
+    prompt_ids: jax.Array,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_id: Optional[int] = None,
+) -> jax.Array:
+    """Autoregressive decoding via full-prefix recompute.
+
+    Simple and correct: each step re-runs the (jitted, padded-to-max)
+    forward on the prefix — O(n^2) but static-shaped, so exactly one
+    compile.  Returns [batch, prompt+max_new_tokens].  temperature 0 =
+    greedy; eos_id stops per-sequence growth (positions after a
+    sequence's eos hold eos; once every sequence has finished, the
+    remaining tail stays 0-padded).
+    """
+    b, p = prompt_ids.shape
+    total = p + max_new_tokens
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    tokens = jnp.zeros((b, total), jnp.int32)
+    tokens = tokens.at[:, :p].set(prompt_ids)
+
+    done = jnp.zeros((b,), jnp.bool_)
+    for i in range(max_new_tokens):
+        cur = jnp.asarray(p + i)
+        # module-level jitted step: repeated generate() calls with the
+        # same shapes reuse one compiled executable
+        new_tokens, rng = _decode_step(model, params, tokens, cur, rng,
+                                       temperature)
+        if eos_id is not None:
+            prev = tokens
+            new_col = new_tokens[:, p + i]
+            new_col = jnp.where(done, eos_id, new_col)
+            done = done | (new_col == eos_id)
+            tokens = prev.at[:, p + i].set(new_col)
+            if bool(done.all()):
+                break
+        else:
+            tokens = new_tokens
+    return tokens

@@ -5,6 +5,7 @@ Reference layer: torchacc/dist/* (SURVEY.md §2 #9-21).  Under JAX the
 context parallelism have real algorithmic modules (pp.py, ops/context_parallel).
 """
 
+from torchacc_tpu.parallel.distributed import initialize_distributed, is_primary
 from torchacc_tpu.parallel.mesh import build_mesh, describe_mesh, mesh_axis_size
 from torchacc_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -16,6 +17,8 @@ from torchacc_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "initialize_distributed",
+    "is_primary",
     "build_mesh",
     "describe_mesh",
     "mesh_axis_size",

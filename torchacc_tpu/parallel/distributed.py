@@ -1,0 +1,52 @@
+"""Multi-host initialisation.
+
+Reference: ``ta.dist.init_process_group`` + NCCL warmup
+(dist/__init__.py:45-98) driven by torchrun env vars.  JAX multi-host is
+one call — ``jax.distributed.initialize`` — after which ``jax.devices()``
+spans every host of the pod/slice and the SAME single-program code runs
+on each host (no rank-conditional logic anywhere in this framework).
+Collective warmup cliques are unnecessary: XLA programs embed their
+collectives.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from torchacc_tpu.utils.logger import logger
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Initialise multi-host JAX.
+
+    With no arguments, TPU pod environments are auto-detected (GKE/GCE
+    metadata), mirroring how the reference reads torchrun's
+    RANK/WORLD_SIZE/MASTER_ADDR (utils/distributed.py env plumbing).
+    Explicit args override; env vars COORDINATOR_ADDRESS / NUM_PROCESSES
+    / PROCESS_ID are honoured as a fallback.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    logger.info(
+        f"distributed initialised: process {jax.process_index()}/"
+        f"{jax.process_count()}, {len(jax.devices())} global devices")
+
+
+def is_primary() -> bool:
+    """True on the host that should write logs/checkpoint metadata."""
+    return jax.process_index() == 0

@@ -1,0 +1,52 @@
+"""Learning-rate schedules + optimizer presets.
+
+Reference analogue: the HF ``run_clm``/Trainer recipes the reference's
+benchmarks rely on (linear/cosine warmup schedules, AdamW with weight
+decay and grad clipping).  Thin optax compositions, named here so
+configs/benchmarks can reference them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import optax
+
+
+def warmup_cosine(peak_lr: float, total_steps: int,
+                  warmup_steps: int = 0, end_lr_ratio: float = 0.1):
+    if warmup_steps <= 0:
+        return optax.cosine_decay_schedule(
+            peak_lr, max(total_steps, 1), alpha=end_lr_ratio)
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=peak_lr * end_lr_ratio)
+
+
+def warmup_linear(peak_lr: float, total_steps: int, warmup_steps: int = 0):
+    decay = optax.linear_schedule(
+        peak_lr, 0.0, max(total_steps - warmup_steps, 1))
+    if warmup_steps <= 0:
+        return decay
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, peak_lr, warmup_steps), decay],
+        [warmup_steps])
+
+
+def adamw(
+    lr,
+    *,
+    weight_decay: float = 0.01,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    eps: float = 1e-8,
+    grad_clip_norm: Optional[float] = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW with optional global-norm clipping (the LLM-training
+    default the reference benchmarks use)."""
+    tx = [optax.clip_by_global_norm(grad_clip_norm)] if grad_clip_norm else []
+    tx.append(optax.adamw(lr, b1=b1, b2=b2, eps=eps,
+                          weight_decay=weight_decay))
+    return optax.chain(*tx)
