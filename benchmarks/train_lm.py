@@ -2,14 +2,15 @@
 
 Reference equivalent: ``benchmarks/transformer.py`` (GPT-2/HF CLM loop
 with --dp/--fsdp/--pp/--gc/--fp16/--bf16/--profile flags,
-transformer.py:33-220).  Trains a zoo preset on synthetic or provided
-data and reports tokens/s, step time, and MFU.
+transformer.py:33-220).  Trains a zoo preset on synthetic data and
+reports tokens/s, step time, and MFU.
 
 Examples:
   python benchmarks/train_lm.py --model llama-tiny --steps 20
-  python benchmarks/train_lm.py --model gpt2 --fsdp 8 --gc --bf16
+  python benchmarks/train_lm.py --model gpt2 --fsdp 8 --gc
   python benchmarks/train_lm.py --model llama3-8b --fsdp 16 --tp 4 \
       --seq 4096 --batch 16 --profile /tmp/trace
+  python benchmarks/train_lm.py --config my_config.json --json
 """
 
 from __future__ import annotations
@@ -54,23 +55,15 @@ def parse_args(argv=None):
     p.add_argument("--grad_accum", type=int, default=1)
     p.add_argument("--profile", default=None, metavar="LOGDIR")
     p.add_argument("--json", action="store_true", help="one JSON line out")
+    p.add_argument("--config", default=None, metavar="JSON_FILE",
+                   help="full ta.Config as JSON (overrides parallelism/"
+                        "memory/numerics flags)")
     return p.parse_args(argv)
 
 
-def main(argv=None) -> int:
-    args = parse_args(argv)
-
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
+def _config_from_flags(args, dtype):
     import torchacc_tpu as ta
-    from torchacc_tpu.models import get_preset
-    from torchacc_tpu.train import accelerate
-
-    dtype = "float16" if args.fp16 else ("float32" if args.fp32 else "bfloat16")
-    cfg = ta.Config(
+    return ta.Config(
         compute=ta.ComputeConfig(dtype=dtype,
                                  flash_attention=not args.no_flash),
         memory=ta.MemoryConfig(gc=args.gc, gc_policy=args.gc_policy),
@@ -87,6 +80,28 @@ def main(argv=None) -> int:
         ),
         grad_accum=args.grad_accum,
     )
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+
+    import jax
+    import numpy as np
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import get_preset
+    from torchacc_tpu.train import accelerate
+
+    if args.config:
+        with open(args.config) as f:
+            cfg = ta.Config.from_dict(json.load(f))
+        dtype = cfg.compute.dtype
+    else:
+        dtype = ("float16" if args.fp16
+                 else ("float32" if args.fp32 else "bfloat16"))
+        cfg = _config_from_flags(args, dtype)
+
     mc = get_preset(args.model, max_seq_len=max(args.seq, 8))
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(args.lr))
     trainer.init()

@@ -61,6 +61,13 @@ def accelerate(
     """
     config = config or Config()
     config.validate()
+    import jax
+    # set unconditionally ('default' -> None) so one accelerate() call's
+    # precision choice cannot leak into the next
+    jax.config.update(
+        "jax_default_matmul_precision",
+        None if config.compute.matmul_precision == "default"
+        else config.compute.matmul_precision)
     if isinstance(model, ModelConfig):
         model = TransformerLM(apply_config_to_model(model, config))
     trainer = Trainer(model, config, optimizer=optimizer, **trainer_kwargs)

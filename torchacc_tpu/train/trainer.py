@@ -98,7 +98,19 @@ class Trainer:
         self._abstract: Optional[TrainState] = None
         self.batch_sharding = NamedSharding(self.mesh, batch_spec(config))
         self._train_step = None
+        self._train_step_structure = None
         self._metrics_sharding = NamedSharding(self.mesh, PartitionSpec())
+
+    def _batch_shardings(self, batch) -> Dict[str, Any]:
+        """Per-leaf batch shardings: leading dim over the data axes, seq
+        dim (rank>=2) over the sequence axes, scalars replicated."""
+        full = self.batch_sharding.spec
+
+        def one(leaf):
+            ndim = getattr(leaf, "ndim", 0)
+            spec = PartitionSpec(*full[:min(ndim, len(full))])
+            return NamedSharding(self.mesh, spec)
+        return jax.tree.map(one, batch)
 
     # -- init ---------------------------------------------------------------
     def resolve_shardings(
@@ -189,7 +201,7 @@ class Trainer:
             l_sum = l_sum + self._aux_weight * aux * count
         return l_sum, count
 
-    def _build_train_step(self):
+    def _build_train_step(self, sample_batch):
         accum = self.config.grad_accum
         optimizer = self.optimizer
         fsc = self._forward_sum_count
@@ -217,9 +229,13 @@ class Trainer:
                     (l, c), g = grad_sum(state.params, mb)
                     return (jax.tree.map(jnp.add, g_acc, g),
                             l_acc + l, c_acc + c), None
-                mbs = jax.tree.map(
-                    lambda x: x.reshape((accum, x.shape[0] // accum)
-                                        + x.shape[1:]), batch)
+                def to_micro(x):
+                    if getattr(x, "ndim", 0) == 0:
+                        # scalar leaves replicate across micro-steps
+                        return jnp.broadcast_to(x, (accum,))
+                    return x.reshape((accum, x.shape[0] // accum)
+                                     + x.shape[1:])
+                mbs = jax.tree.map(to_micro, batch)
                 zeros = jax.tree.map(
                     lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
                 (grads, loss_sum, count), _ = jax.lax.scan(
@@ -270,7 +286,8 @@ class Trainer:
 
         return jax.jit(
             train_step,
-            in_shardings=(self.state_shardings, self.batch_sharding),
+            in_shardings=(self.state_shardings,
+                          self._batch_shardings(sample_batch)),
             out_shardings=(self.state_shardings, self._metrics_sharding),
             donate_argnums=(0,),
         )
@@ -279,8 +296,13 @@ class Trainer:
         """One optimizer step; returns (async) metrics."""
         if self.state is None:
             self.init()
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
+        # keyed on structure AND leaf ranks: in_shardings depend on rank
+        structure = (jax.tree.structure(batch),
+                     tuple(getattr(x, "ndim", 0)
+                           for x in jax.tree.leaves(batch)))
+        if self._train_step is None or structure != self._train_step_structure:
+            self._train_step = self._build_train_step(batch)
+            self._train_step_structure = structure
         with jax.sharding.set_mesh(self.mesh):
             self.state, metrics = self._train_step(self.state, batch)
         return metrics
@@ -368,14 +390,18 @@ class Trainer:
     def eval_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
         if self.state is None:
             self.init()
-        if not hasattr(self, "_eval_step") or self._eval_step is None:
+        if (getattr(self, "_eval_step", None) is None
+                or getattr(self, "_eval_step_structure", None)
+                != jax.tree.structure(batch)):
             fsc = self._forward_sum_count
 
             def ev(state, batch):
                 l, c = fsc(state.params, batch)
                 return l / jnp.maximum(c, 1.0)
             self._eval_step = jax.jit(
-                ev, in_shardings=(self.state_shardings, self.batch_sharding),
+                ev, in_shardings=(self.state_shardings,
+                                  self._batch_shardings(batch)),
                 out_shardings=self._metrics_sharding)
+            self._eval_step_structure = jax.tree.structure(batch)
         with jax.sharding.set_mesh(self.mesh):
             return self._eval_step(self.state, batch)
