@@ -128,6 +128,74 @@ def test_grads_with_segments_and_window():
                                    atol=5e-4, rtol=1e-3, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_alibi_matches_reference(causal):
+    q, k, v = _make_qkv(2, 128, 128, 4, 2, 64, seed=11)
+    slopes = jnp.asarray([0.25, 0.0625, 0.015625, 0.00390625], jnp.float32)
+    out = flash_attention(q, k, v, causal=causal, alibi_slopes=slopes,
+                          block_q=64, block_k=64)
+    ref = attention_reference(q, k, v, causal=causal, alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_alibi_grads_match_reference():
+    q, k, v = _make_qkv(1, 96, 96, 4, 4, 64, seed=12)
+    slopes = jnp.asarray([0.5, 0.125, 0.03125, 0.0078125], jnp.float32)
+
+    def f_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       alibi_slopes=slopes,
+                                       block_q=32, block_k=32) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True,
+                                           alibi_slopes=slopes) ** 2)
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=1e-3, err_msg=f"d{name}")
+
+
+def test_alibi_with_segments():
+    q, k, v = _make_qkv(1, 64, 64, 2, 2, 64, seed=13)
+    slopes = jnp.asarray([0.25, 0.0625], jnp.float32)
+    seg = jnp.concatenate([jnp.zeros((1, 24), jnp.int32),
+                           jnp.ones((1, 40), jnp.int32)], axis=1)
+    out = flash_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                          q_segment_ids=seg, kv_segment_ids=seg,
+                          block_q=32, block_k=32)
+    ref = attention_reference(q, k, v, causal=True, alibi_slopes=slopes,
+                              q_segment_ids=seg, kv_segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_alibi_cross_attention_alignment():
+    """sq != sk: bottom-right alignment — last query aligns with last key."""
+    q, k, v = _make_qkv(1, 32, 96, 2, 2, 64, seed=14)
+    slopes = jnp.asarray([0.25, 0.0625], jnp.float32)
+    out = flash_attention(q, k, v, causal=False, alibi_slopes=slopes,
+                          block_q=32, block_k=32)
+    ref = attention_reference(q, k, v, causal=False, alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_alibi_slopes_not_trainable_consistently():
+    """Both backends treat slopes as constants: zero gradient from each."""
+    q, k, v = _make_qkv(1, 32, 32, 2, 2, 64, seed=15)
+    slopes = jnp.asarray([0.25, 0.0625], jnp.float32)
+
+    g1 = jax.grad(lambda s: jnp.sum(flash_attention(
+        q, k, v, causal=True, alibi_slopes=s, block_q=32, block_k=32)
+        .astype(jnp.float32) ** 2))(slopes)
+    g2 = jax.grad(lambda s: jnp.sum(attention_reference(
+        q, k, v, causal=True, alibi_slopes=s).astype(jnp.float32) ** 2))(slopes)
+    np.testing.assert_array_equal(np.asarray(g1), 0.0)
+    np.testing.assert_array_equal(np.asarray(g2), 0.0)
+
+
 def test_bf16_fwd_close():
     q, k, v = _make_qkv(1, 128, 128, 2, 2, 64, dtype=jnp.bfloat16, seed=8)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)

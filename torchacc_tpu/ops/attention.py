@@ -85,6 +85,7 @@ def attention_reference(
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
+    alibi_slopes: Optional[jax.Array] = None,
     q_offset: int = 0,
     return_lse: bool = False,
 ):
@@ -106,6 +107,15 @@ def attention_reference(
                         k.astype(jnp.float32)) * scale
     if bias is not None:
         scores = scores + bias.astype(jnp.float32)
+    if alibi_slopes is not None:
+        # -slope * |i - j| per head, bottom-right aligned and offset-aware
+        # (reference ops/flash_attn.py:411-413); slopes are hyperparams
+        # (stop_gradient keeps backends' gradients identical)
+        slopes = jax.lax.stop_gradient(alibi_slopes.astype(jnp.float32))
+        q_pos = jnp.arange(sq, dtype=jnp.float32) + q_offset + (sk - sq)
+        k_pos = jnp.arange(sk, dtype=jnp.float32)
+        dist = jnp.abs(q_pos[:, None] - k_pos[None, :])
+        scores = scores - slopes[:, None, None] * dist[None]
     mask = make_attention_mask(
         sq, sk, causal=causal, window=window,
         q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
@@ -137,6 +147,7 @@ def attention_reference_bwd(
     scale: Optional[float] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    alibi_slopes: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Plain-XLA flash-style backward from saved (o, lse): (dq, dk, dv).
 
@@ -156,6 +167,12 @@ def attention_reference_bwd(
     of = o.astype(jnp.float32)
 
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr) * scale
+    if alibi_slopes is not None:
+        slopes = jax.lax.stop_gradient(alibi_slopes.astype(jnp.float32))
+        q_pos = jnp.arange(sq, dtype=jnp.float32) + (sk - sq)
+        k_pos = jnp.arange(sk, dtype=jnp.float32)
+        s = s - (slopes[:, None, None]
+                 * jnp.abs(q_pos[:, None] - k_pos[None, :])[None])
     mask = make_attention_mask(sq, sk, causal=causal, window=window,
                                q_segment_ids=q_segment_ids,
                                kv_segment_ids=kv_segment_ids)

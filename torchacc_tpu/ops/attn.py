@@ -30,6 +30,7 @@ def attention(
     scale: Optional[float] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    alibi_slopes: Optional[jax.Array] = None,
     impl: str = "auto",
     return_lse: bool = False,
 ):
@@ -43,7 +44,7 @@ def attention(
             return flash_attention(
                 q, k, v, causal=causal, window=window, scale=scale,
                 q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-                return_lse=return_lse)
+                alibi_slopes=alibi_slopes, return_lse=return_lse)
         except ImportError:
             if forced:
                 raise
@@ -56,4 +57,4 @@ def attention(
     return attention_reference(
         q, k, v, causal=causal, window=window, scale=scale,
         q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-        return_lse=return_lse)
+        alibi_slopes=alibi_slopes, return_lse=return_lse)

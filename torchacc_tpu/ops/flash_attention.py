@@ -66,6 +66,18 @@ def _band_mask(q_start, k_start, block_q, block_k, causal, window):
     return mask
 
 
+def _alibi_bias(slope, q_start, k_start, block_q, block_k, qk_shift):
+    """Additive ALiBi bias -slope * |q_pos + (sk - sq) - k_pos| for one
+    tile — bottom-right aligned like the reference (alibi_slopes through
+    every flash op, ops/flash_attn.py:411-413), so decode-style sq != sk
+    keeps the most recent keys least penalised."""
+    q_pos = q_start + qk_shift + jax.lax.broadcasted_iota(
+        jnp.float32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.float32,
+                                               (block_q, block_k), 1)
+    return -slope * jnp.abs(q_pos - k_pos)
+
+
 def _block_should_run(q_start, k_start, block_q, block_k, causal, window):
     left, right = window
     run = True
@@ -82,10 +94,11 @@ def _block_should_run(q_start, k_start, block_q, block_k, causal, window):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
                 o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
-                *, scale, causal, window, block_q, block_k, num_kv_blocks):
+                *, scale, causal, window, block_q, block_k, num_kv_blocks,
+                qk_shift=0):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -107,6 +120,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [bq, bk]
+        if alibi_ref is not None:
+            s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
+                                block_q, block_k, qk_shift)
 
         mask = _band_mask(q_start, k_start, block_q, block_k, causal, window)
         if qseg_ref is not None:
@@ -142,14 +158,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         lse_ref[0, 0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
 
 
-def _fwd_kernel_noseg(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                      m_scr, l_scr, acc_scr, **kw):
-    _fwd_kernel(q_ref, k_ref, v_ref, None, None, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, **kw)
+def _mk_kernel(core, has_seg, has_alibi, **kw):
+    """Adapter: unpack the optional (seg, alibi) refs positionally so one
+    core kernel serves all feature combinations."""
+    def kernel(*refs):
+        q_ref, k_ref, v_ref = refs[:3]
+        i = 3
+        qseg = kseg = alibi = None
+        if has_seg:
+            qseg, kseg = refs[i], refs[i + 1]
+            i += 2
+        if has_alibi:
+            alibi = refs[i]
+            i += 1
+        rest = refs[i:]
+        core(q_ref, k_ref, v_ref, qseg, kseg, alibi, *rest, **kw)
+    return kernel
 
 
-def _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal, window,
-         block_q, block_k):
+def _alibi_operand(alibi_slopes):
+    """[h] slopes -> TPU-legal (h, 8, 128) broadcast for per-head blocks."""
+    h = alibi_slopes.shape[0]
+    return jax.lax.broadcast_in_dim(
+        alibi_slopes.astype(jnp.float32), (h, _SUBLANES, _LANES), (0,))
+
+
+def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, scale,
+         causal, window, block_q, block_k, qk_shift=0):
     """q,k,v in BHSD.  Returns (o BHSD, lse [b,h,sq] f32)."""
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
@@ -157,11 +192,13 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal, window,
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
     has_seg = q_segment_ids is not None
+    has_alibi = alibi_slopes is not None
 
-    kernel = functools.partial(
-        _fwd_kernel if has_seg else _fwd_kernel_noseg,
+    kernel = _mk_kernel(
+        _fwd_kernel, has_seg, has_alibi,
         scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, num_kv_blocks=nk)
+        block_q=block_q, block_k=block_k, num_kv_blocks=nk,
+        qk_shift=qk_shift)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
@@ -183,6 +220,10 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal, window,
                          lambda b_, h, qi, ki: (b_, 0, ki)),
         ]
         args += [qseg, kseg]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec((1, _SUBLANES, _LANES),
+                                     lambda b_, h, qi, ki: (h, 0, 0)))
+        args.append(_alibi_operand(alibi_slopes))
 
     o, lse4 = pl.pallas_call(
         kernel,
@@ -215,13 +256,16 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal, window,
 # backward
 # ---------------------------------------------------------------------------
 
-def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
+def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, lse,
                  q_start, k_start, *, scale, causal, window,
-                 block_q, block_k):
+                 block_q, block_k, qk_shift=0):
     q = q_ref[0, 0, :, :].astype(jnp.float32)
     k = k_ref[0, 0, :, :].astype(jnp.float32)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    if alibi_ref is not None:
+        s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
+                            block_q, block_k, qk_shift)
     mask = _band_mask(q_start, k_start, block_q, block_k, causal, window)
     if qseg_ref is not None:
         seg = qseg_ref[0, :, 0][:, None] == kseg_ref[0, 0, :][None, :]
@@ -232,10 +276,10 @@ def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
     return p, q, k
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   qseg_ref, kseg_ref, dq_ref, dq_scr,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
+                   do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
                    *, scale, causal, window, block_q, block_k,
-                   num_kv_blocks):
+                   num_kv_blocks, qk_shift=0):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -253,10 +297,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, :, 0]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
-        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
-                               q_start, k_start, scale=scale, causal=causal,
-                               window=window, block_q=block_q,
-                               block_k=block_k)
+        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref,
+                               lse, q_start, k_start, scale=scale,
+                               causal=causal, window=window, block_q=block_q,
+                               block_k=block_k, qk_shift=qk_shift)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
@@ -269,16 +313,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0, 0, :, :] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _bwd_dq_kernel_noseg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, **kw):
-    _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   None, None, dq_ref, dq_scr, **kw)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
+                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr,
                     *, scale, causal, window, block_q, block_k,
-                    num_q_blocks, group):
+                    num_q_blocks, group, qk_shift=0):
     # grid (b, hk, nk, group, nq): the scratch accumulates over the whole
     # (group, q-block) inner sweep, so GQA/MQA grads never materialise
     # per-q-head dk/dv in HBM.
@@ -301,10 +343,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0, :, 0]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
-        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, lse,
-                               q_start, k_start, scale=scale, causal=causal,
-                               window=window, block_q=block_q,
-                               block_k=block_k)
+        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref,
+                               lse, q_start, k_start, scale=scale,
+                               causal=causal, window=window, block_q=block_q,
+                               block_k=block_k, qk_shift=qk_shift)
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
@@ -321,20 +363,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0, :, :] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_dkv_kernel_noseg(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, **kw):
-    _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    None, None, dk_ref, dv_ref, dk_scr, dv_scr, **kw)
 
 
-def _bwd(res, do, *, scale, causal, window, block_q, block_k):
-    q, k, v, o, lse, q_segment_ids, kv_segment_ids = res
+
+def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
+    (q, k, v, o, lse, q_segment_ids, kv_segment_ids, alibi_slopes) = res
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = hq // hk
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
     has_seg = q_segment_ids is not None
+    has_alibi = alibi_slopes is not None
 
     # delta = rowsum(do * o); lane-broadcast alongside lse for the kernels
     delta = jnp.einsum("bhqd,bhqd->bhq", do.astype(jnp.float32),
@@ -343,7 +383,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k):
     delta4 = jnp.broadcast_to(delta[..., None], (b, hq, sq, _LANES))
 
     common = dict(scale=scale, causal=causal, window=window,
-                  block_q=block_q, block_k=block_k)
+                  block_q=block_q, block_k=block_k, qk_shift=qk_shift)
 
     if has_seg:
         qseg = jax.lax.broadcast_in_dim(
@@ -358,13 +398,8 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k):
                      lambda b_, h, qi, ki: (b_, h // group, ki, 0)),
         pl.BlockSpec((1, 1, block_k, d),
                      lambda b_, h, qi, ki: (b_, h // group, ki, 0)),
-        pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, _LANES),
-                     lambda b_, h, qi, ki: (b_, h, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, _LANES),
-                     lambda b_, h, qi, ki: (b_, h, qi, 0)),
     ]
-    args = [q, k, v, do, lse4, delta4]
+    args = [q, k, v]
     if has_seg:
         in_specs += [
             pl.BlockSpec((1, block_q, _LANES),
@@ -373,10 +408,21 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k):
                          lambda b_, h, qi, ki: (b_, 0, ki)),
         ]
         args += [qseg, kseg]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec((1, _SUBLANES, _LANES),
+                                     lambda b_, h, qi, ki: (h, 0, 0)))
+        args.append(_alibi_operand(alibi_slopes))
+    in_specs += [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, h, qi, ki: (b_, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, h, qi, ki: (b_, h, qi, 0)),
+    ]
+    args += [do, lse4, delta4]
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel if has_seg else _bwd_dq_kernel_noseg,
-            num_kv_blocks=nk, **common),
+        _mk_kernel(_bwd_dq_kernel, has_seg, has_alibi,
+                   num_kv_blocks=nk, **common),
         grid=(b, hq, nq, nk),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q, d),
@@ -398,14 +444,8 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k):
                      lambda b_, hkv, ki, g, qi: (b_, hkv, ki, 0)),
         pl.BlockSpec((1, 1, block_k, d),
                      lambda b_, hkv, ki, g, qi: (b_, hkv, ki, 0)),
-        pl.BlockSpec((1, 1, block_q, d),
-                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, _LANES),
-                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
-        pl.BlockSpec((1, 1, block_q, _LANES),
-                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
     ]
-    args = [q, k, v, do, lse4, delta4]
+    args = [q, k, v]
     if has_seg:
         in_specs += [
             pl.BlockSpec((1, block_q, _LANES),
@@ -414,10 +454,23 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k):
                          lambda b_, hkv, ki, g, qi: (b_, 0, ki)),
         ]
         args += [qseg, kseg]
+    if has_alibi:
+        in_specs.append(pl.BlockSpec(
+            (1, _SUBLANES, _LANES),
+            lambda b_, hkv, ki, g, qi: (hkv * group + g, 0, 0)))
+        args.append(_alibi_operand(alibi_slopes))
+    in_specs += [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+        pl.BlockSpec((1, 1, block_q, _LANES),
+                     lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
+    ]
+    args += [do, lse4, delta4]
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel if has_seg else _bwd_dkv_kernel_noseg,
-            num_q_blocks=nq, group=group, **common),
+        _mk_kernel(_bwd_dkv_kernel, has_seg, has_alibi,
+                   num_q_blocks=nq, group=group, **common),
         grid=(b, hk, nk, group, nq),
         in_specs=in_specs,
         out_specs=[
@@ -439,7 +492,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k):
                                  "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*args)
-    return (dq, dk, dv, None, None)
+    return (dq, dk, dv, None, None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -456,24 +509,25 @@ def _pad_seq(x, block, axis, value=0):
     return jnp.pad(x, pad, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash(q, k, v, q_segment_ids, kv_segment_ids,
-           scale, causal, window, block_q, block_k):
-    o, _ = _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal,
-                window, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+           scale, causal, window, block_q, block_k, qk_shift):
+    o, _ = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+                scale, causal, window, block_q, block_k, qk_shift)
     return o
 
 
-def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids,
-               scale, causal, window, block_q, block_k):
-    o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale, causal,
-                  window, block_q, block_k)
-    return o, (q, k, v, o, lse, q_segment_ids, kv_segment_ids)
+def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+               scale, causal, window, block_q, block_k, qk_shift):
+    o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+                  scale, causal, window, block_q, block_k, qk_shift)
+    return o, (q, k, v, o, lse, q_segment_ids, kv_segment_ids,
+               alibi_slopes)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, res, g):
+def _flash_bwd(scale, causal, window, block_q, block_k, qk_shift, res, g):
     return _bwd(res, g, scale=scale, causal=causal, window=window,
-                block_q=block_q, block_k=block_k)
+                block_q=block_q, block_k=block_k, qk_shift=qk_shift)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -489,12 +543,15 @@ def flash_attention(
     scale: Optional[float] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    alibi_slopes: Optional[jax.Array] = None,
     return_lse: bool = False,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ):
     """[b, s, h, d] flash attention (see module docstring).
 
+    ``alibi_slopes``: [num_q_heads] f32 per-head ALiBi slopes (additive
+    -slope*|i-j| bias, reference ops/flash_attn.py:411-413).
     With ``return_lse`` returns (out, lse[b, h, s]); that path is
     forward-only (used by the context-parallel ring, which defines its
     own VJP around the merged result).
@@ -507,6 +564,14 @@ def flash_attention(
     if (q_segment_ids is None) != (kv_segment_ids is None):
         raise ValueError(
             "q_segment_ids and kv_segment_ids must be provided together")
+    if alibi_slopes is not None:
+        if alibi_slopes.shape != (hq,):
+            raise ValueError(
+                f"alibi_slopes must have shape ({hq},) — one slope per q "
+                f"head — got {alibi_slopes.shape}")
+        # slopes are hyperparameters, not weights: stop_gradient keeps the
+        # pallas and xla backends' gradients identical
+        alibi_slopes = jax.lax.stop_gradient(alibi_slopes)
     if scale is None:
         scale = d ** -0.5
     bq0, bk0 = _block_sizes(sq, sk)
@@ -532,11 +597,12 @@ def flash_attention(
     v = _pad_seq(v, block_k, 1).swapaxes(1, 2)
 
     if return_lse:
-        o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, scale,
-                      causal, window, block_q, block_k)
+        o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+                      scale, causal, window, block_q, block_k,
+                      qk_shift=sk - sq)
         return o.swapaxes(1, 2)[:, :sq], lse[:, :, :sq]
-    o = _flash(q, k, v, q_segment_ids, kv_segment_ids, scale, causal,
-               window, block_q, block_k)
+    o = _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+               scale, causal, window, block_q, block_k, sk - sq)
     return o.swapaxes(1, 2)[:, :sq]
 
 
@@ -553,6 +619,7 @@ def flash_attention_bwd(
     scale: Optional[float] = None,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    alibi_slopes: Optional[jax.Array] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -586,9 +653,11 @@ def flash_attention_bwd(
     doT = _pad_seq(do, block_q, 1).swapaxes(1, 2)
     lseP = _pad_seq(lse, block_q, 2)
 
-    res = (qT, kT, vT, oT, lseP, q_segment_ids, kv_segment_ids)
-    dq, dk, dv, _, _ = _bwd(res, doT, scale=scale, causal=causal,
-                            window=window, block_q=block_q, block_k=block_k)
+    res = (qT, kT, vT, oT, lseP, q_segment_ids, kv_segment_ids,
+           alibi_slopes)
+    dq, dk, dv, _, _, _ = _bwd(res, doT, scale=scale, causal=causal,
+                               window=window, block_q=block_q,
+                               block_k=block_k, qk_shift=sk - sq)
     return (dq.swapaxes(1, 2)[:, :sq], dk.swapaxes(1, 2)[:, :sk],
             dv.swapaxes(1, 2)[:, :sk])
 
