@@ -182,6 +182,26 @@ def test_alibi_cross_attention_alignment():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_causal_cross_attention_bottom_right():
+    """causal with sq != sk: bottom-right aligned (flash-attn semantics) —
+    the LAST query sees ALL keys, the first query sees sk-sq+1 keys."""
+    q, k, v = _make_qkv(1, 16, 48, 2, 2, 64, seed=16)
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    # last query row attends everything -> differs from a sk-truncated call
+    full_row = attention_reference(q[:, -1:], k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(ref[:, -1:]),
+                               np.asarray(full_row), atol=1e-5)
+    # alibi + causal cross-attention agree between backends too
+    slopes = jnp.asarray([0.25, 0.0625], jnp.float32)
+    out_a = flash_attention(q, k, v, causal=True, alibi_slopes=slopes,
+                            block_q=16, block_k=16)
+    ref_a = attention_reference(q, k, v, causal=True, alibi_slopes=slopes)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(ref_a),
+                               atol=2e-5)
+
+
 def test_alibi_slopes_not_trainable_consistently():
     """Both backends treat slopes as constants: zero gradient from each."""
     q, k, v = _make_qkv(1, 32, 32, 2, 2, 64, seed=15)

@@ -37,6 +37,16 @@ def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
     return jnp.repeat(k, num_q_heads // num_kv, axis=2)
 
 
+def _alibi_scores(alibi_slopes, sq: int, sk: int, shift: int):
+    """[h, sq, sk] additive ALiBi bias, bottom-right aligned via ``shift``
+    (= q_offset + sk - sq).  Slopes are hyperparameters (stop_gradient)."""
+    slopes = jax.lax.stop_gradient(alibi_slopes.astype(jnp.float32))
+    q_pos = jnp.arange(sq, dtype=jnp.float32) + shift
+    k_pos = jnp.arange(sk, dtype=jnp.float32)
+    dist = jnp.abs(q_pos[:, None] - k_pos[None, :])
+    return -slopes[:, None, None] * dist[None]
+
+
 def make_attention_mask(
     q_len: int,
     kv_len: int,
@@ -108,18 +118,17 @@ def attention_reference(
     if bias is not None:
         scores = scores + bias.astype(jnp.float32)
     if alibi_slopes is not None:
-        # -slope * |i - j| per head, bottom-right aligned and offset-aware
-        # (reference ops/flash_attn.py:411-413); slopes are hyperparams
-        # (stop_gradient keeps backends' gradients identical)
-        slopes = jax.lax.stop_gradient(alibi_slopes.astype(jnp.float32))
-        q_pos = jnp.arange(sq, dtype=jnp.float32) + q_offset + (sk - sq)
-        k_pos = jnp.arange(sk, dtype=jnp.float32)
-        dist = jnp.abs(q_pos[:, None] - k_pos[None, :])
-        scores = scores - slopes[:, None, None] * dist[None]
+        # bottom-right aligned bias, same geometry as the mask below
+        # (reference ops/flash_attn.py:411-413)
+        scores = scores + _alibi_scores(alibi_slopes, sq, sk,
+                                        q_offset + (sk - sq))
+    # bottom-right alignment for sq != sk (flash-attn semantics): the
+    # LAST query aligns with the LAST key — consistent with the Pallas
+    # kernel and with the ALiBi bias above
     mask = make_attention_mask(
         sq, sk, causal=causal, window=window,
         q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-        q_offset=q_offset)
+        q_offset=q_offset + (sk - sq))
     if mask.ndim == 3:  # [b, q, k] from segment ids
         mask = mask[:, None, :, :]
     scores = jnp.where(mask, scores, NEG_INF)
@@ -168,14 +177,11 @@ def attention_reference_bwd(
 
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr) * scale
     if alibi_slopes is not None:
-        slopes = jax.lax.stop_gradient(alibi_slopes.astype(jnp.float32))
-        q_pos = jnp.arange(sq, dtype=jnp.float32) + (sk - sq)
-        k_pos = jnp.arange(sk, dtype=jnp.float32)
-        s = s - (slopes[:, None, None]
-                 * jnp.abs(q_pos[:, None] - k_pos[None, :])[None])
+        s = s + _alibi_scores(alibi_slopes, sq, sk, sk - sq)
     mask = make_attention_mask(sq, sk, causal=causal, window=window,
                                q_segment_ids=q_segment_ids,
-                               kv_segment_ids=kv_segment_ids)
+                               kv_segment_ids=kv_segment_ids,
+                               q_offset=sk - sq)
     if mask.ndim == 3:
         mask = mask[:, None, :, :]
     p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)

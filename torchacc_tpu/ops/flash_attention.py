@@ -49,12 +49,18 @@ def _block_sizes(sq: int, sk: int) -> Tuple[int, int]:
     return min(512, _round_up(sq, 8)), min(512, _round_up(sk, _LANES))
 
 
-def _band_mask(q_start, k_start, block_q, block_k, causal, window):
-    """Positional (causal + sliding window) mask for one tile, or None."""
+def _band_mask(q_start, k_start, block_q, block_k, causal, window,
+               qk_shift=0):
+    """Positional (causal + sliding window) mask for one tile, or None.
+
+    ``qk_shift = sk - sq`` bottom-right aligns the geometry for sq != sk
+    (flash-attn semantics: the LAST query aligns with the LAST key), the
+    same shift the ALiBi bias uses — mask and bias always agree."""
     left, right = window
     if not causal and left < 0 and right < 0:
         return None
-    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    q_pos = q_start + qk_shift + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     mask = jnp.ones((block_q, block_k), jnp.bool_)
     if causal:
@@ -78,15 +84,18 @@ def _alibi_bias(slope, q_start, k_start, block_q, block_k, qk_shift):
     return -slope * jnp.abs(q_pos - k_pos)
 
 
-def _block_should_run(q_start, k_start, block_q, block_k, causal, window):
+def _block_should_run(q_start, k_start, block_q, block_k, causal, window,
+                      qk_shift=0):
     left, right = window
+    q_hi = q_start + qk_shift + block_q - 1
+    q_lo = q_start + qk_shift
     run = True
     if causal:
-        run = jnp.logical_and(run, k_start <= q_start + block_q - 1)
+        run = jnp.logical_and(run, k_start <= q_hi)
     if left >= 0:
-        run = jnp.logical_and(run, k_start + block_k - 1 >= q_start - left)
+        run = jnp.logical_and(run, k_start + block_k - 1 >= q_lo - left)
     if right >= 0:
-        run = jnp.logical_and(run, k_start <= q_start + block_q - 1 + right)
+        run = jnp.logical_and(run, k_start <= q_hi + right)
     return run
 
 
@@ -112,7 +121,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
     k_start = ki * block_k
 
     @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window))
+                               causal, window, qk_shift))
     def _compute():
         q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, d]
         k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
@@ -124,7 +133,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
             s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
                                 block_q, block_k, qk_shift)
 
-        mask = _band_mask(q_start, k_start, block_q, block_k, causal, window)
+        mask = _band_mask(q_start, k_start, block_q, block_k, causal, window,
+                          qk_shift)
         if qseg_ref is not None:
             qs = qseg_ref[0, :, 0]                          # [bq]
             ks = kseg_ref[0, 0, :]                          # [bk]
@@ -266,7 +276,8 @@ def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, lse,
     if alibi_ref is not None:
         s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
                             block_q, block_k, qk_shift)
-    mask = _band_mask(q_start, k_start, block_q, block_k, causal, window)
+    mask = _band_mask(q_start, k_start, block_q, block_k, causal, window,
+                      qk_shift)
     if qseg_ref is not None:
         seg = qseg_ref[0, :, 0][:, None] == kseg_ref[0, 0, :][None, :]
         mask = seg if mask is None else mask & seg
@@ -291,7 +302,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
     k_start = ki * block_k
 
     @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window))
+                               causal, window, qk_shift))
     def _compute():
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
@@ -337,7 +348,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
     k_start = ki * block_k
 
     @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window))
+                               causal, window, qk_shift))
     def _compute():
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]

@@ -1,0 +1,44 @@
+"""Curated XLA/LIBTPU performance flags (opt-in).
+
+Reference: `_set_env` merges ~20 XLA_FLAGS perf defaults at import time
+(torchacc/__init__.py:72-132 — latency-hiding scheduler, async
+collectives, combine thresholds).  XLA:TPU already defaults to the
+latency-hiding scheduler and async collectives, so this framework sets
+NOTHING implicitly; this module provides the same levers explicitly for
+tuning runs.  Call BEFORE the first jax import/backend init.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+# conservative, TPU-appropriate tuning set (names are stable XLA flags)
+PERFORMANCE_FLAGS: Dict[str, str] = {
+    # bigger combined collectives amortise ICI latency (reference sets the
+    # GPU analogues of these thresholds)
+    "xla_tpu_enable_async_collective_fusion": "true",
+    "xla_tpu_enable_async_collective_fusion_fuse_all_gather": "true",
+    # overlap-friendly scheduling is default on TPU; listed for visibility
+    "xla_tpu_enable_latency_hiding_scheduler": "true",
+}
+
+
+def apply_performance_flags(extra: Optional[Dict[str, str]] = None) -> str:
+    """Merge the curated flag set (plus ``extra``) into XLA_FLAGS.
+
+    Returns the resulting XLA_FLAGS string.  Existing user-set flags take
+    precedence (mirroring the reference's merge semantics,
+    torchacc/__init__.py:93-121).
+    """
+    flags = dict(PERFORMANCE_FLAGS)
+    if extra:
+        flags.update(extra)
+    current = os.environ.get("XLA_FLAGS", "")
+    existing_names = {tok.split("=")[0].lstrip("-")
+                      for tok in current.split() if tok.startswith("--")}
+    additions = [f"--{k}={v}" for k, v in flags.items()
+                 if k not in existing_names]
+    merged = " ".join([current] + additions).strip()
+    os.environ["XLA_FLAGS"] = merged
+    return merged
