@@ -126,3 +126,73 @@ def test_offload_policy_compiles(devices):
     for b in loader:
         m = trainer.step(b)
     assert np.isfinite(float(m["loss"]))
+
+
+def _loss_after_steps(cfg_mem, n_layers=4, steps=2):
+    import optax
+    mc = dataclasses.replace(_model(), num_layers=n_layers)
+    cfg = ta.Config(memory=cfg_mem)
+    trainer, loader = accelerate(mc, _batches(steps), cfg,
+                                 optimizer=optax.sgd(1e-2))
+    for b in loader:
+        m = trainer.step(b)
+    return float(m["loss"])
+
+
+def test_gc_cnt_partial_remat_matches(devices):
+    """gc_cnt (reference gc_cls/gc_cnt, utils/checkpoint.py:67-81): remat
+    only the first N layers.  Remat must not change values — losses after
+    identical steps match the no-remat and full-remat runs."""
+    base = _loss_after_steps(ta.MemoryConfig(gc=False))
+    full = _loss_after_steps(ta.MemoryConfig(gc=True, gc_policy="dots"))
+    half = _loss_after_steps(
+        ta.MemoryConfig(gc=True, gc_policy="dots", gc_cnt=2))
+    none_cnt = _loss_after_steps(
+        ta.MemoryConfig(gc=True, gc_policy="dots", gc_cnt=0))
+    np.testing.assert_allclose(half, base, rtol=2e-4)
+    np.testing.assert_allclose(half, full, rtol=2e-4)
+    np.testing.assert_allclose(none_cnt, base, rtol=2e-4)
+
+
+def test_gc_cls_submodule_remat_matches(devices):
+    """gc_cls selects WHICH submodules remat (Attention / Mlp) instead of
+    the whole block; values are unchanged."""
+    base = _loss_after_steps(ta.MemoryConfig(gc=False))
+    attn = _loss_after_steps(
+        ta.MemoryConfig(gc=True, gc_policy="nothing", gc_cls=["Attention"]))
+    mlp = _loss_after_steps(
+        ta.MemoryConfig(gc=True, gc_policy="nothing", gc_cls=["Mlp"]))
+    both = _loss_after_steps(
+        ta.MemoryConfig(gc=True, gc_cls=["Attention", "Mlp"]))
+    for v in (attn, mlp, both):
+        np.testing.assert_allclose(v, base, rtol=2e-4)
+
+
+def test_gc_cls_validation():
+    cfg = ta.Config(memory=ta.MemoryConfig(gc=True, gc_cls=["NoSuchLayer"]))
+    with pytest.raises(Exception):
+        cfg.validate()
+
+
+def test_offload_activations_knob(devices):
+    """offload_activations forces the host-offload policy (falls back to
+    'dots' on CPU) and implies gc."""
+    from torchacc_tpu.train.accelerate import apply_config_to_model
+    cfg = ta.Config(memory=ta.MemoryConfig(offload_activations=True))
+    mc = apply_config_to_model(_model(), cfg)
+    assert mc.remat and mc.remat_policy == "offload_dots"
+    loss = _loss_after_steps(ta.MemoryConfig(offload_activations=True))
+    assert np.isfinite(loss)
+
+
+def test_gc_cnt_nonscan_path(devices):
+    """remat_cnt on the unrolled (scan_layers=False) path."""
+    import optax
+    mc = dataclasses.replace(_model(), num_layers=3, scan_layers=False)
+    cfg = ta.Config(memory=ta.MemoryConfig(gc=True, gc_policy="dots",
+                                           gc_cnt=1))
+    trainer, loader = accelerate(mc, _batches(2), cfg,
+                                 optimizer=optax.sgd(1e-2))
+    for b in loader:
+        m = trainer.step(b)
+    assert np.isfinite(float(m["loss"]))

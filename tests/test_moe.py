@@ -65,3 +65,32 @@ def test_ep_matches_single_device(devices):
     t2.init()
     l2 = [float(t2.step(b)["loss"]) for b in batches]
     np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def test_moe_aux_loss_survives_gc_cnt(devices):
+    """The gc_cnt split-scan path must still propagate the sow'd MoE
+    load-balance loss (it runs blocks via raw .apply, which would
+    silently drop intermediates without explicit handling)."""
+    import dataclasses
+    from torchacc_tpu.models import TransformerLM
+    from torchacc_tpu.train.accelerate import apply_config_to_model
+
+    base_cfg = _moe_model(dtype=jnp.float32)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 16)),
+                      jnp.int32)
+
+    def aux_of(mem):
+        cfg = ta.Config(memory=mem)
+        mc = apply_config_to_model(base_cfg, cfg)
+        model = TransformerLM(mc)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        _, mut = model.apply({"params": params}, ids,
+                             mutable=["intermediates"])
+        leaves = [jnp.sum(jnp.asarray(v)) for v in
+                  jax.tree.leaves(mut.get("intermediates", {}))]
+        assert leaves, "moe_aux_loss missing from intermediates"
+        return float(sum(leaves))
+
+    plain = aux_of(ta.MemoryConfig(gc=False))
+    split = aux_of(ta.MemoryConfig(gc=True, gc_policy="dots", gc_cnt=1))
+    np.testing.assert_allclose(split, plain, rtol=1e-5)

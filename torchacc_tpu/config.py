@@ -101,16 +101,27 @@ class MemoryConfig:
     """
 
     gc: bool = False                  # gradient/activation checkpointing (remat)
-    gc_cls: Optional[List[str]] = None  # layer class names to remat (None = block)
-    gc_cnt: Optional[int] = None      # remat only the first N matching layers
+    # layer class names to remat (None = the whole decoder Block); valid:
+    # 'Block', 'Attention', 'Mlp', 'MoEMlp' — reference gc_cls semantics
+    # (utils/checkpoint.py:67-81) mapped onto the zoo model's modules
+    gc_cls: Optional[List[str]] = None
+    gc_cnt: Optional[int] = None      # remat only the first N layers
     gc_policy: str = "nothing"        # 'nothing' | 'dots' | 'dots_with_no_batch_dims' | 'offload_dots'
-    offload_activations: bool = False  # remat residuals to host memory space
+    # force the host-offload remat policy (overrides gc_policy, implies gc)
+    offload_activations: bool = False
+
+    _GC_CLS = ("Block", "Attention", "Mlp", "MoEMlp")
 
     def validate(self) -> None:
         _check(self.gc_policy in ("nothing", "dots", "dots_with_no_batch_dims", "offload_dots"),
                f"memory.gc_policy invalid: {self.gc_policy}")
         if self.gc_cnt is not None:
             _check(self.gc_cnt >= 0, "memory.gc_cnt must be >= 0")
+        if self.gc_cls:
+            for name in self.gc_cls:
+                _check(name in self._GC_CLS,
+                       f"memory.gc_cls entries must be in {self._GC_CLS}, "
+                       f"got {name!r}")
 
 
 @dataclass

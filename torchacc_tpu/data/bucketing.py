@@ -43,13 +43,16 @@ def pad_batch(
 
     The bucket is chosen from the longest feature in the batch so all
     features stay aligned.  Pad values default to 0 except ``labels``
-    which pads with -100 (ignored by the loss), matching the reference's
-    ``pad_value_dict`` defaults (core/async_loader.py:109-138).
+    (-100, ignored by the loss — the reference's ``pad_value_dict``
+    default, core/async_loader.py:109-138) and ``segment_ids`` (-1, the
+    framework-wide "matches nothing" id used by packing and the flash-
+    attention mask, so padded keys are never attendable and shift_labels
+    never trains across the real/pad boundary).
     """
     arrs = {k: _to_numpy(v) for k, v in batch.items()}
     if not buckets:
         return arrs
-    pad_values = {"labels": -100}
+    pad_values = {"labels": -100, "segment_ids": -1}
     if pad_value_dict:
         pad_values.update(pad_value_dict)
     # Only features with a distinct sequence axis participate: 0/1-D

@@ -60,10 +60,13 @@ class MoEMlp(nn.Module):
         out = jnp.einsum("ebsf,efh->ebsh", act, w_down.astype(cfg.dtype))
         y = jnp.einsum("ebsh,bse->bsh", out.astype(jnp.float32), combine)
 
-        # Load-balancing auxiliary loss (switch-style) exposed via sow.
+        # Load-balancing auxiliary loss (switch/mixtral-style top-k)
+        # exposed via sow: count all k selections per token, divided by
+        # k, so load on secondary experts feeds the balance signal.
         probs = jax.nn.softmax(logits, axis=-1)
         frac_tokens = jnp.mean(
-            jax.nn.one_hot(sel[..., 0], e, dtype=jnp.float32), axis=(0, 1))
+            jnp.sum(jax.nn.one_hot(sel, e, dtype=jnp.float32), axis=-2),
+            axis=(0, 1)) / k
         frac_probs = jnp.mean(probs, axis=(0, 1))
         self.sow("intermediates", "moe_aux_loss",
                  e * jnp.sum(frac_tokens * frac_probs))

@@ -48,6 +48,12 @@ class ModelConfig:
     scan_layers: bool = True
     remat: bool = False                     # remat each block (memory.gc)
     remat_policy: str = "nothing"           # see utils/remat.py
+    # selective remat (reference gc_cls/gc_cnt, utils/checkpoint.py:67-81):
+    # remat_cls picks WHICH submodules remat ('Block' = the whole decoder
+    # layer; 'Attention' / 'Mlp' / 'MoEMlp' remat only that part);
+    # remat_cnt remats only the first N layers (None = all).
+    remat_cls: Optional[Tuple[str, ...]] = None
+    remat_cnt: Optional[int] = None
     attention_impl: str = "auto"
     window: Tuple[int, int] = (-1, -1)      # sliding-window attention
     # context parallelism: attention runs in a shard_map region with the
@@ -77,6 +83,11 @@ class ModelConfig:
     def ffn_size(self) -> int:
         if self.intermediate_size is not None:
             return self.intermediate_size
+        if self.activation == "swiglu":
+            # llama sizing: 2/3 * 4h, rounded up to a multiple of 256
+            # (keeps the matmul dims MXU-tile friendly).  Pass
+            # intermediate_size explicitly to pin an exact width.
+            return ((8 * self.hidden_size // 3) + 255) // 256 * 256
         return 4 * self.hidden_size
 
     def num_params(self) -> int:
@@ -211,6 +222,17 @@ class Mlp(nn.Module):
         return dense("down_proj", cfg.hidden_size)(h)
 
 
+def _sub_remat(cfg: ModelConfig) -> bool:
+    """True when remat applies to selected submodules inside the block
+    (reference gc_cls semantics, utils/checkpoint.py:67-81) rather than
+    to the whole decoder layer."""
+    return bool(cfg.remat and cfg.remat_cls and "Block" not in cfg.remat_cls)
+
+
+def _block_remat(cfg: ModelConfig) -> bool:
+    return bool(cfg.remat and not _sub_remat(cfg))
+
+
 class Block(nn.Module):
     cfg: ModelConfig
 
@@ -218,15 +240,23 @@ class Block(nn.Module):
     def __call__(self, x, positions, segment_ids=None):
         from jax.ad_checkpoint import checkpoint_name
         cfg = self.cfg
-        attn_out = Attention(cfg, name="attn")(
+        attn_cls, mlp_cls = Attention, Mlp
+        if cfg.num_experts > 0:
+            from torchacc_tpu.models.moe import MoEMlp
+            mlp_cls = MoEMlp
+        if _sub_remat(cfg):
+            from torchacc_tpu.utils.remat import remat_policy
+            pol = remat_policy(cfg.remat_policy)
+            if "Attention" in cfg.remat_cls:
+                attn_cls = nn.remat(attn_cls, policy=pol, prevent_cse=False)
+            if mlp_cls.__name__ in cfg.remat_cls or "Mlp" in cfg.remat_cls:
+                mlp_cls = nn.remat(mlp_cls, policy=pol, prevent_cse=False)
+        attn_out = attn_cls(cfg, name="attn")(
             Norm(cfg, name="ln1")(x), positions, segment_ids)
         # names referenced by the 'offload_dots' remat policy (utils/remat.py)
         h = x + checkpoint_name(attn_out, "attn_out")
-        if cfg.num_experts > 0:
-            from torchacc_tpu.models.moe import MoEMlp
-            mlp_out = MoEMlp(cfg, name="moe")(Norm(cfg, name="ln2")(h))
-        else:
-            mlp_out = Mlp(cfg, name="mlp")(Norm(cfg, name="ln2")(h))
+        mlp_out = mlp_cls(cfg, name="moe" if cfg.num_experts > 0 else "mlp")(
+            Norm(cfg, name="ln2")(h))
         return h + checkpoint_name(mlp_out, "mlp_out")
 
 
@@ -271,11 +301,16 @@ class TransformerLM(nn.Module):
             x = x + pos_table.astype(cfg.dtype)[positions]
 
         block_cls = ScanBlock
-        if cfg.remat:
+        if _block_remat(cfg):
             from torchacc_tpu.utils.remat import remat_policy
             block_cls = nn.remat(
                 ScanBlock, policy=remat_policy(cfg.remat_policy),
                 prevent_cse=False)
+        # remat_cnt (reference gc_cnt): remat only the first N layers
+        split_n = None
+        if (cfg.remat and cfg.remat_cnt is not None
+                and 0 <= cfg.remat_cnt < cfg.num_layers and cfg.pp_size == 1):
+            split_n = cfg.remat_cnt
         if cfg.scan_layers:
             scan_mod = nn.scan(
                 block_cls,
@@ -303,12 +338,69 @@ class TransformerLM(nn.Module):
                     remat=cfg.remat,
                     remat_policy=(remat_policy(cfg.remat_policy)
                                   if cfg.remat else None))
+            elif split_n is not None and not self.is_initializing():
+                # split the stacked params: first remat_cnt layers run
+                # with remat semantics, the rest without (init still
+                # traces scan_mod so the stacked layout exists)
+                from torchacc_tpu.utils.remat import remat_policy
+                layer_params = self.variables["params"]["layers"]
+                head = jax.tree.map(lambda p: p[:split_n], layer_params)
+                tail = jax.tree.map(lambda p: p[split_n:], layer_params)
+                cfg_off = dataclasses.replace(cfg, remat=False)
+
+                def _aux_sum(vs):
+                    # keep sow'd aux losses flowing through the raw
+                    # .apply (they would otherwise be dropped); filter by
+                    # name to match the trainer's 'aux_loss' contract
+                    paths = jax.tree_util.tree_flatten_with_path(
+                        vs.get("intermediates", {}))[0]
+                    vals = [jnp.sum(v) for path, v in paths
+                            if "aux_loss" in jax.tree_util.keystr(path)]
+                    return (sum(vals) if vals
+                            else jnp.zeros((), jnp.float32))
+
+                def apply_block(block_cfg):
+                    def fn(p, carry):
+                        (new_carry, _), vs = ScanBlock(block_cfg).apply(
+                            {"params": p}, carry, None,
+                            mutable=["intermediates"])
+                        return new_carry, _aux_sum(vs)
+                    return fn
+
+                apply_gc, apply_plain = apply_block(cfg), apply_block(cfg_off)
+                if _block_remat(cfg):
+                    apply_gc = jax.checkpoint(
+                        apply_gc, policy=remat_policy(cfg.remat_policy),
+                        prevent_cse=False)
+
+                def seg(fn, stack, carry):
+                    return jax.lax.scan(
+                        lambda c, p: fn(p, c), carry, stack)
+
+                carry = (x, positions, segment_ids)
+                aux_total = jnp.zeros((), jnp.float32)
+                if split_n > 0:
+                    carry, aux = seg(apply_gc, head, carry)
+                    aux_total = aux_total + jnp.sum(aux)
+                if split_n < cfg.num_layers:
+                    carry, aux = seg(apply_plain, tail, carry)
+                    aux_total = aux_total + jnp.sum(aux)
+                if cfg.num_experts > 0:
+                    self.sow("intermediates", "moe_aux_loss", aux_total)
+                x = carry[0]
             else:
                 (x, _, _), _ = scan_mod((x, positions, segment_ids), None)
         else:
             for i in range(cfg.num_layers):
-                (x, positions, segment_ids), _ = block_cls(
-                    cfg, name=f"layers_{i}")((x, positions, segment_ids), None)
+                past = split_n is not None and i >= split_n
+                cls_i = ScanBlock if past else block_cls
+                # submodule remat is driven by cfg inside Block; switch
+                # it off for layers past remat_cnt
+                cfg_i = (dataclasses.replace(cfg, remat=False)
+                         if past and _sub_remat(cfg) else cfg)
+                (x, positions, segment_ids), _ = cls_i(
+                    cfg_i, name=f"layers_{i}")((x, positions, segment_ids),
+                                               None)
 
         x = Norm(cfg, name="final_norm")(x)
         if return_hidden:

@@ -36,8 +36,15 @@ def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
         param_dtype=_DTYPES[config.compute.param_dtype],
         attention_impl=(config.compute.attention_impl
                         if config.compute.flash_attention else "xla"),
-        remat=config.memory.gc,
-        remat_policy=config.memory.gc_policy,
+        # offload_activations forces the host-offload remat policy
+        # (reference utils/cpu_offload.py analogue); gc_cls/gc_cnt select
+        # which submodules / how many layers remat (utils/checkpoint.py:67-81)
+        remat=config.memory.gc or config.memory.offload_activations,
+        remat_policy=("offload_dots" if config.memory.offload_activations
+                      else config.memory.gc_policy),
+        remat_cls=(tuple(config.memory.gc_cls)
+                   if config.memory.gc_cls else None),
+        remat_cnt=config.memory.gc_cnt,
         context_parallel=config.dist.sp.size > 1,
         pp_size=config.dist.pp.size,
         pp_num_micro=config.dist.pp.num_micro_batches,

@@ -390,9 +390,13 @@ class Trainer:
     def eval_step(self, batch: Dict[str, jax.Array]) -> jax.Array:
         if self.state is None:
             self.init()
+        # same (structure, leaf-rank) key as step(): in_shardings depend
+        # on per-leaf rank, not just the tree structure
+        eval_key = (jax.tree.structure(batch),
+                    tuple(getattr(x, "ndim", 0)
+                          for x in jax.tree.leaves(batch)))
         if (getattr(self, "_eval_step", None) is None
-                or getattr(self, "_eval_step_structure", None)
-                != jax.tree.structure(batch)):
+                or getattr(self, "_eval_step_structure", None) != eval_key):
             fsc = self._forward_sum_count
 
             def ev(state, batch):
@@ -402,6 +406,6 @@ class Trainer:
                 ev, in_shardings=(self.state_shardings,
                                   self._batch_shardings(batch)),
                 out_shardings=self._metrics_sharding)
-            self._eval_step_structure = jax.tree.structure(batch)
+            self._eval_step_structure = eval_key
         with jax.sharding.set_mesh(self.mesh):
             return self._eval_step(self.state, batch)
