@@ -1,0 +1,120 @@
+"""Accuracy-parity harness: torch HF training vs the converted model.
+
+Reference: the accuracy benchmark suite trains the SAME model under
+torch and under torchacc on identical data/hyper-parameters and compares
+loss curves (+ downstream eval) — benchmarks/accuracy/README.md:95-109,
+.github/workflows/accuracy_benchmark.yml.  TPU-native equivalent: build
+a small HF Llama in torch (CPU), fine-tune it with a plain torch loop,
+convert the SAME initial weights through models/hf.py and fine-tune with
+this framework's Trainer on the SAME token stream and hyper-parameters,
+then require the two loss curves to agree step by step.
+
+One command, one JSON verdict line::
+
+    python benchmarks/accuracy_parity.py [--steps 20] [--tol 0.02]
+
+Exit code 0 iff the curves agree within --tol max relative deviation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# CPU-only determinism for both frameworks (run before importing jax)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python benchmarks/accuracy_parity.py` from a checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def torch_curve(hf_model, ids, steps, lr):
+    """Plain torch fine-tune loop: next-token CE, SGD, f32."""
+    import torch
+
+    model = hf_model.train()
+    opt = torch.optim.SGD(model.parameters(), lr=lr)
+    losses = []
+    for step in range(steps):
+        batch = torch.from_numpy(ids[step])
+        out = model(input_ids=batch, labels=batch)
+        # HF computes shifted CE internally (mean over tokens)
+        opt.zero_grad()
+        out.loss.backward()
+        opt.step()
+        losses.append(float(out.loss.detach()))
+    return losses
+
+
+def converted_curve(hf_model, ids, steps, lr):
+    """Same initial weights via models/hf.py, trained by the Trainer."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import load_hf_model
+    from torchacc_tpu.train import accelerate
+
+    mc, params = load_hf_model(hf_model, dtype=jnp.float32,
+                               param_dtype=jnp.float32)
+    cfg = ta.Config(compute=ta.ComputeConfig(
+        dtype="float32", fused_kernels=False))
+    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(lr))
+    trainer.init()
+    trainer.state = trainer.state.replace(params=params)
+    losses = []
+    for step in range(steps):
+        m = trainer.step({"input_ids": jnp.asarray(ids[step])})
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--tol", type=float, default=0.02,
+                    help="max allowed relative loss deviation")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    import transformers
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=args.seq, rope_theta=10000.0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).float()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, size=(args.steps, args.batch, args.seq)
+                       ).astype(np.int64)
+
+    ours = converted_curve(hf_model, ids, args.steps, args.lr)
+    theirs = torch_curve(hf_model, ids, args.steps, args.lr)
+
+    devs = [abs(a - b) / max(abs(b), 1e-6) for a, b in zip(ours, theirs)]
+    max_dev = max(devs)
+    improved = ours[-1] < ours[0]
+    ok = bool(max_dev <= args.tol and improved)
+    print(json.dumps({
+        "metric": "accuracy_parity_llama_sft",
+        "ok": ok,
+        "max_rel_dev": round(max_dev, 5),
+        "tol": args.tol,
+        "loss_first": {"torch": round(theirs[0], 5),
+                       "torchacc_tpu": round(ours[0], 5)},
+        "loss_last": {"torch": round(theirs[-1], 5),
+                      "torchacc_tpu": round(ours[-1], 5)},
+        "steps": args.steps,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,3 +69,42 @@ def test_hybrid_mesh_falls_back_on_cpu(devices):
                          fsdp=ta.FSDPConfig(size=4), num_slices=2)
     mesh = ta.parallel.build_mesh(dist, devices=devices)
     assert mesh.devices.size == 8
+
+
+def test_plot_mem_parse_and_render(tmp_path):
+    """plot_mem (reference tools/plot_mem.py equivalent): parse a real
+    XLA dump produced in a subprocess, compute lifetimes, render a PNG."""
+    import subprocess
+    import sys
+
+    dump = str(tmp_path / "dump")
+    src = (
+        "import os\n"
+        f"os.environ['XLA_FLAGS'] = '--xla_dump_to={dump} "
+        "--xla_dump_hlo_as_text'\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "def f(x, w):\n"
+        "    return jnp.tanh(x @ w).sum()\n"
+        "g = jax.jit(jax.grad(f, argnums=1))\n"
+        "print(g(jnp.ones((32, 64)), jnp.ones((64, 128))).shape)\n"
+    )
+    subprocess.run([sys.executable, "-c", src], check=True, timeout=120,
+                   capture_output=True)
+
+    from torchacc_tpu.utils import plot_mem
+    ba, hlo = plot_mem.find_dump_files(dump)
+    text = open(ba).read()
+    allocs = plot_mem.parse_buffer_assignment(text)
+    assert allocs and any(a.kind == "parameter" for a in allocs)
+    assert sum(a.size for a in allocs) > 0
+    uses = plot_mem.parse_uses(text)
+    assert uses
+    order = plot_mem.parse_hlo_order(open(hlo).read()) if hlo else {}
+    n = plot_mem.assign_lifetimes(allocs, uses, order)
+    assert n >= 1
+    out = str(tmp_path / "mem.png")
+    rc = plot_mem.main([dump, "-o", out])
+    assert rc == 0 and (tmp_path / "mem.png").stat().st_size > 1000

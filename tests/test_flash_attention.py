@@ -223,3 +223,114 @@ def test_bf16_fwd_close():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         atol=2e-2, rtol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# attention dropout (reference ops/flash_attn.py:418-423) + global offsets
+# ---------------------------------------------------------------------------
+
+def test_dropout_pallas_matches_xla_exactly():
+    """Same seed -> bit-identical mask on both backends (the stateless
+    coordinate hash), so outputs agree to numerics."""
+    q, k, v = _make_qkv(2, 128, 128, 4, 4, 64, seed=7)
+    out = flash_attention(q, k, v, causal=True, dropout_p=0.3,
+                          dropout_seed=17, block_q=64, block_k=64)
+    ref = attention_reference(q, k, v, causal=True, dropout_p=0.3,
+                              dropout_seed=17)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_dropout_zero_is_identity():
+    q, k, v = _make_qkv(1, 128, 128, 2, 2, 64, seed=8)
+    a = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    b = flash_attention(q, k, v, causal=True, dropout_p=0.0,
+                        dropout_seed=5, block_q=64, block_k=64)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dropout_seed_changes_output_deterministically():
+    q, k, v = _make_qkv(1, 128, 128, 2, 2, 64, seed=9)
+    f = functools.partial(flash_attention, causal=True, dropout_p=0.5,
+                          block_q=64, block_k=64)
+    a1 = f(q, k, v, dropout_seed=1)
+    a1b = f(q, k, v, dropout_seed=1)
+    a2 = f(q, k, v, dropout_seed=2)
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a1b))
+    assert np.abs(np.asarray(a1) - np.asarray(a2)).max() > 1e-3
+
+
+def test_dropout_seed_is_traced_not_compiled():
+    """Seed arrives via SMEM scalars: stepping the seed must not trigger
+    a recompile (one jit trace, many seeds)."""
+    q, k, v = _make_qkv(1, 128, 128, 2, 2, 64, seed=10)
+    traces = 0
+
+    @jax.jit
+    def f(q, k, v, seed):
+        nonlocal traces
+        traces += 1
+        return flash_attention(q, k, v, causal=True, dropout_p=0.2,
+                               dropout_seed=seed, block_q=64, block_k=64)
+
+    outs = [f(q, k, v, jnp.int32(s)) for s in range(3)]
+    assert traces == 1
+    assert np.abs(np.asarray(outs[0]) - np.asarray(outs[1])).max() > 1e-4
+
+
+@pytest.mark.parametrize("hq,hk", [(4, 4), (4, 2)])
+def test_dropout_grads_match_xla(hq, hk):
+    """The custom-VJP dropped-softmax backward (dS = P-tilde dP - P delta)
+    against jax autodiff through the dense XLA path with the SAME mask."""
+    q, k, v = _make_qkv(1, 128, 128, hq, hk, 32, seed=11)
+
+    def f_pallas(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, dropout_p=0.25,
+                                       dropout_seed=3, block_q=64,
+                                       block_k=64) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True,
+                                           dropout_p=0.25,
+                                           dropout_seed=3) ** 2)
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_global_offsets_match_full_attention():
+    """flash(q_chunk, k_chunk, q_offset, k_offset) must equal the
+    corresponding tile of full attention — the contract the CP ring is
+    built on (causal geometry + ALiBi + dropout all keyed globally)."""
+    b, s, h, d = 1, 256, 2, 32
+    q, k, v = _make_qkv(b, s, s, h, h, d, seed=12)
+    slopes = jnp.asarray([0.25, 0.5], jnp.float32)
+
+    # full lse for the merged comparison
+    full, full_lse = attention_reference(q, k, v, causal=True,
+                                         alibi_slopes=slopes,
+                                         return_lse=True)
+    half = s // 2
+    # second q chunk attends to both kv chunks: merge two offset calls
+    from torchacc_tpu.ops.context_parallel.merge import merge_attention
+    from torchacc_tpu.ops._common import NEG_INF
+    q2 = q[:, half:]
+    o_a, lse_a = flash_attention(q2, k[:, :half], v[:, :half], causal=True,
+                                 q_offset=half, k_offset=0,
+                                 return_lse=True, block_q=64, block_k=64,
+                                 alibi_slopes=slopes)
+    o_b, lse_b = flash_attention(q2, k[:, half:], v[:, half:], causal=True,
+                                 q_offset=half, k_offset=half,
+                                 return_lse=True, block_q=64, block_k=64,
+                                 alibi_slopes=slopes)
+    out0 = jnp.zeros(o_a.shape, jnp.float32)
+    lse0 = jnp.full(lse_a.shape, NEG_INF, jnp.float32)
+    out, lse = merge_attention(out0, lse0, o_a.astype(jnp.float32), lse_a)
+    out, lse = merge_attention(out, lse, o_b.astype(jnp.float32), lse_b)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full[:, half:]),
+                               atol=3e-5, rtol=3e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(full_lse[:, :, half:]),
+                               atol=3e-5, rtol=3e-5)

@@ -83,3 +83,22 @@ def test_converted_model_trains(devices):
     l0 = float(trainer.step(b)["loss"])
     l1 = float(trainer.step(b)["loss"])
     assert np.isfinite(l0) and l1 < l0
+
+
+@pytest.mark.slow
+def test_accuracy_parity_harness():
+    """The one-command torch-vs-converted training comparison (reference
+    benchmarks/accuracy/ analogue) emits ok=true."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks",
+                                      "accuracy_parity.py"), "--steps", "6"],
+        capture_output=True, text=True, timeout=480)
+    assert r.returncode == 0, r.stdout + r.stderr
+    verdict = json.loads(r.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] and verdict["max_rel_dev"] <= 0.02, verdict

@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from torchacc_tpu.models import (
@@ -94,3 +95,104 @@ def test_scan_vs_loop_equivalence():
             lambda x: x[i], params["layers"])
     out_loop = m_loop.apply({"params": loop_params}, ids)
     assert jnp.allclose(out_scan, out_loop, atol=1e-5)
+
+
+def test_alibi_pos_emb_model():
+    """pos_emb='alibi': no rope/learned table, standard slope schedule."""
+    import dataclasses
+    from torchacc_tpu.models import TransformerLM, get_preset
+    from torchacc_tpu.models.transformer import alibi_slopes
+
+    assert np.allclose(alibi_slopes(8),
+                       [2 ** (-i) for i in range(1, 9)])
+    # non-power-of-two: paper interpolation
+    assert len(alibi_slopes(6)) == 6
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=4,
+                    intermediate_size=64, pos_emb="alibi",
+                    dtype=jnp.float32)
+    model = TransformerLM(mc)
+    ids = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    assert "pos_embed" not in params
+    logits = model.apply({"params": params}, ids)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_attn_dropout_train_vs_eval():
+    """Dropout active iff a seed is passed; per-layer + per-seed masks
+    differ; eval (no seed) is deterministic."""
+    from torchacc_tpu.models import TransformerLM, get_preset
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=4,
+                    intermediate_size=64, attn_dropout=0.5,
+                    dtype=jnp.float32)
+    model = TransformerLM(mc)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    eval1 = model.apply({"params": params}, ids)
+    eval2 = model.apply({"params": params}, ids)
+    np.testing.assert_array_equal(np.asarray(eval1), np.asarray(eval2))
+    tr1 = model.apply({"params": params}, ids, dropout_seed=jnp.int32(1))
+    tr1b = model.apply({"params": params}, ids, dropout_seed=jnp.int32(1))
+    tr2 = model.apply({"params": params}, ids, dropout_seed=jnp.int32(2))
+    np.testing.assert_array_equal(np.asarray(tr1), np.asarray(tr1b))
+    assert np.abs(np.asarray(tr1) - np.asarray(eval1)).max() > 1e-4
+    assert np.abs(np.asarray(tr1) - np.asarray(tr2)).max() > 1e-4
+
+
+def test_attn_dropout_trainer_end_to_end(devices):
+    """Trainer passes the step-derived seed on train steps only; the
+    deterministic flag disables it."""
+    import optax
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import get_preset
+    from torchacc_tpu.train import accelerate
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=4,
+                    intermediate_size=64, attn_dropout=0.3)
+    rng = np.random.default_rng(0)
+    data = {"input_ids": rng.integers(0, 64, (8, 32)).astype(np.int32)}
+    cfg = ta.Config()
+    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
+    m = trainer.step(data)
+    assert np.isfinite(float(m["loss"]))
+    ev1 = float(trainer.eval_step(data))
+    ev2 = float(trainer.eval_step(data))
+    assert ev1 == ev2  # eval is deterministic
+
+    cfg_det = ta.Config(compute=ta.ComputeConfig(deterministic=True))
+    tr_det, _ = accelerate(mc, None, cfg_det, optimizer=optax.sgd(1e-2))
+    assert not tr_det._attn_dropout_on
+
+
+def test_attn_dropout_grad_accum_decorrelated(devices):
+    """grad_accum micro-steps draw fresh dropout masks (seed advances per
+    micro index); the run still trains and differs from accum=1."""
+    import optax
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=4,
+                    intermediate_size=64, attn_dropout=0.4,
+                    dtype=jnp.float32)
+    data = {"input_ids": np.random.default_rng(0)
+            .integers(0, 64, (8, 32)).astype(np.int32)}
+
+    def one_loss(accum):
+        cfg = ta.Config(grad_accum=accum)
+        tr, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
+        tr.init(rng=jax.random.PRNGKey(0))
+        tr.step(data)
+        return float(tr.eval_step(data))
+
+    l1, l4 = one_loss(1), one_loss(4)
+    assert np.isfinite(l1) and np.isfinite(l4)
+    # same data, same init — only the dropout masks (and accumulation
+    # order) differ; with shared masks the two were bit-identical
+    assert l1 != l4

@@ -76,3 +76,121 @@ def test_pp_rejects_bad_configs():
         ta.Config(dist=ta.DistConfig(
             pp=ta.PPConfig(size=2, num_micro_batches=4),
             sp=ta.SPConfig(size=2))).validate()
+
+
+# ---------------------------------------------------------------------------
+# 1F1B schedule (reference pp/schedule.py:156-227 PipeDreamFlushTrain)
+# ---------------------------------------------------------------------------
+
+def _toy_setup(P=4, L=8, M=8, mb=2, D=16):
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 4)
+    stacked = jax.random.normal(ks[0], (L, D, D)) * 0.3
+    head = jax.random.normal(ks[1], (D, D)) * 0.3
+    x = jax.random.normal(ks[2], (M * mb, D))
+    labels = jax.random.normal(ks[3], (M * mb, D))
+
+    def apply_block(p, carry):
+        return (jnp.tanh(carry[0] @ p),)
+
+    def head_loss(hp, y, lab):
+        pred = y @ hp
+        return jnp.sum((pred - lab) ** 2), jnp.asarray(
+            float(np.prod(lab.shape)), jnp.float32)
+
+    def ref_loss(stacked, hp, x):
+        def one(c, p):
+            return jnp.tanh(c @ p), None
+        y, _ = jax.lax.scan(one, x, stacked)
+        return jnp.sum((y @ hp - labels) ** 2)
+
+    return stacked, head, x, labels, apply_block, head_loss, ref_loss
+
+
+@pytest.mark.parametrize("P,M", [(1, 4), (2, 4), (4, 8), (4, 4)])
+def test_1f1b_loss_and_grads_match_straightline(devices, P, M):
+    """The interleaved F/B schedule is a pure re-ordering: loss and all
+    three gradient groups must match jax.grad of the unrolled stack."""
+    from jax.sharding import Mesh
+    from torchacc_tpu.parallel.pp import pipeline_loss_1f1b
+
+    stacked, head, x, labels, apply_block, head_loss, ref_loss = _toy_setup(
+        P=P, M=M)
+    mesh = Mesh(np.array(jax.devices()[:P]), ("pp",))
+
+    def loss_1f1b(stacked, hp, x):
+        ls, cnt = pipeline_loss_1f1b(
+            apply_block, head_loss, stacked, hp, x, (), labels,
+            P, M, "pp")
+        return ls
+
+    with jax.sharding.set_mesh(mesh):
+        l1, g1 = jax.value_and_grad(loss_1f1b, argnums=(0, 1, 2))(
+            stacked, head, x)
+    l0, g0 = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(
+        stacked, head, x)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-5)
+    for a, b, name in zip(g1, g0, ("stacked", "head", "x")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("pp,mb", [(2, 4), (4, 8)])
+def test_pp_1f1b_matches_single(devices, pp, mb):
+    """1F1B training == pp=1 training: the schedule is a re-ordering of
+    identical math, including through the optimizer."""
+    import optax
+    batches = list(_batches(4))
+
+    cfg_pp = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=pp, num_micro_batches=mb, schedule="1f1b")))
+    t_pp, _ = accelerate(_model(), None, cfg_pp, optimizer=optax.adam(1e-3))
+    t_pp.init()
+    losses_pp = [float(t_pp.step(b)["loss"]) for b in batches]
+
+    cfg_1 = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)))
+    t_1, _ = accelerate(_model(), None, cfg_1, optimizer=optax.adam(1e-3))
+    t_1.init()
+    losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
+
+    np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
+
+
+def test_pp_1f1b_tied_embeddings(devices):
+    """Tied embeddings under 1F1B: the table gets gradient from both the
+    embed side (via dx) and the head side (inside the last stage)."""
+    import dataclasses
+    import optax
+    mc = dataclasses.replace(_model(), tie_embeddings=True)
+    batches = list(_batches(3))
+    cfg_pp = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=4, schedule="1f1b")))
+    t_pp, _ = accelerate(mc, None, cfg_pp, optimizer=optax.adam(1e-3))
+    losses_pp = [float(t_pp.step(b)["loss"]) for b in batches]
+    cfg_1 = ta.Config()
+    t_1, _ = accelerate(mc, None, cfg_1, optimizer=optax.adam(1e-3))
+    losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
+    np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
+
+
+@pytest.mark.slow
+def test_pp_1f1b_memory_beats_gpipe(devices):
+    """The 1F1B schedule's raison d'etre: peak temp memory below the
+    GPipe-under-autodiff path at equal micro-batches (the residual ring
+    holds ~2(P-1)+1 stage inputs instead of all M+P-1 scan carries;
+    measured 0.77x at this shape)."""
+    import optax
+    mc = _model(num_layers=8)
+    mems = {}
+    for sched in ("gpipe", "1f1b"):
+        cfg = ta.Config(dist=ta.DistConfig(
+            pp=ta.PPConfig(size=4, num_micro_batches=32, schedule=sched)))
+        cfg.memory.gc = sched == "gpipe"   # gpipe needs remat to compete
+        tr, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
+        tr.init()
+        batch = {"input_ids": np.zeros((32, 512), np.int32)}
+        fn = tr._build_train_step(batch)
+        with jax.sharding.set_mesh(tr.mesh):
+            mem = fn.lower(tr.state, batch).compile().memory_analysis()
+        mems[sched] = mem.temp_size_in_bytes
+    assert mems["1f1b"] < mems["gpipe"], mems

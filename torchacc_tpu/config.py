@@ -71,10 +71,11 @@ class ComputeConfig:
     # 'auto': pallas on TPU, interpreter elsewhere; 'xla': plain jnp reference
     attention_impl: str = "auto"     # 'auto' | 'pallas' | 'xla'
     fused_kernels: bool = True       # fused (chunked) linear+CE loss path
-    # Unlike the reference's CUDA kernels (deterministic flag threaded
-    # through every flash op, flash_attn.py:421-423), every kernel here is
-    # bit-deterministic by construction (no atomics, no dropout): this
-    # flag is accepted for config parity and asserts nothing.
+    # Reference threads a deterministic flag through every flash op
+    # (flash_attn.py:421-423).  Kernels here are bit-deterministic by
+    # construction (no atomics; dropout uses a stateless coordinate hash
+    # reproducible from the checkpointed step).  Setting this True
+    # additionally disables attention dropout in train steps.
     deterministic: bool = False
     # 'default' | 'high' | 'highest' — jax default matmul precision
     matmul_precision: str = "default"
@@ -206,18 +207,26 @@ class PPConfig:
     On TPU the pipeline is a single SPMD program: layers are stacked on a
     stage axis and micro-batches circulate via ``ppermute`` (see
     parallel/pp.py), so ``split_points`` become a balanced layer
-    partition.  The schedule is GPipe-shaped (M+P-1 ticks, same bubble
-    fraction as the reference's PipeDreamFlush); 1F1B's *memory* benefit
-    is delivered by per-stage rematerialisation instead of schedule
-    reordering, since XLA's autodiff owns the backward ordering.
+    partition.  ``schedule`` picks between GPipe-under-autodiff and the
+    true 1F1B interleaved schedule (a custom-VJP region with the
+    PipeDreamFlush warmup/steady/cooldown structure and memory profile).
     """
     size: int = 1
     num_micro_batches: int = 1
     broadcast_loss: bool = True
+    # 'gpipe': autodiff through the circulating-microbatch scan (simple,
+    #          composes with any loss; memory ~ M in-flight carries).
+    # '1f1b':  PipeDreamFlush interleaved schedule (pp/schedule.py:156-227)
+    #          as a custom-VJP region — backward starts per micro-batch,
+    #          residual memory ~ min(2(P-1)+1, M) stage inputs.  Zoo-model
+    #          train steps only (head+loss fused into the last stage).
+    schedule: str = "gpipe"
 
     def validate(self) -> None:
         _check(self.size >= 1, "pp.size must be >= 1")
         _check(self.num_micro_batches >= 1, "pp.num_micro_batches must be >= 1")
+        _check(self.schedule in ("gpipe", "1f1b"),
+               f"pp.schedule must be gpipe|1f1b, got {self.schedule}")
         if self.size > 1:
             _check(self.num_micro_batches % self.size == 0,
                    "pp.num_micro_batches must be a multiple of pp.size")

@@ -9,13 +9,14 @@ from torchacc_tpu.models.presets import PRESETS, get_preset
 from torchacc_tpu.models.transformer import (
     ModelConfig,
     TransformerLM,
+    alibi_slopes,
     loss_fn,
     loss_sum_count,
 )
 
 __all__ = [
     "ModelConfig", "TransformerLM", "loss_fn", "loss_sum_count",
-    "param_axes", "TRANSFORMER_AXES", "PRESETS", "get_preset",
-    "generate", "config_from_hf", "load_hf_model",
+    "alibi_slopes", "param_axes", "TRANSFORMER_AXES", "PRESETS",
+    "get_preset", "generate", "config_from_hf", "load_hf_model",
     "params_from_hf_state_dict",
 ]

@@ -36,7 +36,7 @@ class ModelConfig:
     head_dim: Optional[int] = None          # None = hidden/heads
     intermediate_size: Optional[int] = None  # None = 4x hidden (gelu) / llama rule
     max_seq_len: int = 2048
-    pos_emb: str = "rope"                   # 'rope' | 'learned'
+    pos_emb: str = "rope"                   # 'rope' | 'learned' | 'alibi'
     norm: str = "rmsnorm"                   # 'rmsnorm' | 'layernorm'
     activation: str = "swiglu"              # 'swiglu' | 'gelu'
     qkv_bias: bool = False                  # Qwen2 style
@@ -56,6 +56,9 @@ class ModelConfig:
     remat_cnt: Optional[int] = None
     attention_impl: str = "auto"
     window: Tuple[int, int] = (-1, -1)      # sliding-window attention
+    # post-softmax attention dropout (reference flash_attn.py:418-423);
+    # active only when the caller passes deterministic=False + a seed
+    attn_dropout: float = 0.0
     # context parallelism: attention runs in a shard_map region with the
     # sequence dim sharded over ('sp', 'spu') — see ops/context_parallel
     context_parallel: bool = False
@@ -153,11 +156,35 @@ class Norm(nn.Module):
                 + bias.astype(jnp.float32)).astype(cfg.dtype)
 
 
+def alibi_slopes(num_heads: int) -> Tuple[float, ...]:
+    """Standard ALiBi per-head slopes (geometric 2^(-8i/n) with the
+    paper's interpolation for non-power-of-two head counts) — the same
+    table the reference's models pass as ``alibi_slopes``."""
+    import math
+
+    def pow2(n):
+        start = 2.0 ** (-8.0 / n)
+        return [start ** (i + 1) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        return tuple(pow2(num_heads))
+    m = 2 ** math.floor(math.log2(num_heads))
+    return tuple(pow2(m) + pow2(2 * m)[0::2][:num_heads - m])
+
+
+def _layer_seed(dropout_seed, layer_idx):
+    """Decorrelate dropout across layers: mix the layer index into the
+    seed (the hash itself only sees batch/head/q/k coordinates)."""
+    s = jnp.asarray(dropout_seed, jnp.int32).astype(jnp.uint32)
+    li = jnp.asarray(layer_idx, jnp.int32).astype(jnp.uint32)
+    return (s + li * jnp.uint32(0x9E3779B9)).astype(jnp.int32)
+
+
 class Attention(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, dropout_seed=None):
         cfg = self.cfg
         d = cfg.head_size
         dense = lambda name, heads: nn.DenseGeneral(
@@ -178,16 +205,28 @@ class Attention(nn.Module):
         v = activation_constraint(v, ("batch", "seq", "heads", None), rules)
         if cfg.pos_emb == "rope":
             q, k = _rope(q, k, positions, cfg.rope_theta)
+        slopes = (jnp.asarray(alibi_slopes(cfg.num_heads), jnp.float32)
+                  if cfg.pos_emb == "alibi" else None)
+        # per-layer decorrelation already happened in TransformerLM
+        # (seeds_xs = _layer_seed(seed, arange(L)))
+        dropout_p, seed = 0.0, None
+        if cfg.attn_dropout > 0.0 and dropout_seed is not None:
+            dropout_p = cfg.attn_dropout
+            seed = dropout_seed
         if cfg.context_parallel:
             from torchacc_tpu.ops.context_parallel import cp_attention
             out = cp_attention(q, k, v, causal=True, window=cfg.window,
                                q_segment_ids=segment_ids,
                                kv_segment_ids=segment_ids,
+                               alibi_slopes=slopes, dropout_p=dropout_p,
+                               dropout_seed=seed,
                                impl=cfg.attention_impl)
         else:
             out = attention(q, k, v, causal=True, window=cfg.window,
                             q_segment_ids=segment_ids,
                             kv_segment_ids=segment_ids,
+                            alibi_slopes=slopes, dropout_p=dropout_p,
+                            dropout_seed=seed,
                             impl=cfg.attention_impl)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
@@ -237,7 +276,7 @@ class Block(nn.Module):
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, dropout_seed=None):
         from jax.ad_checkpoint import checkpoint_name
         cfg = self.cfg
         attn_cls, mlp_cls = Attention, Mlp
@@ -252,7 +291,7 @@ class Block(nn.Module):
             if mlp_cls.__name__ in cfg.remat_cls or "Mlp" in cfg.remat_cls:
                 mlp_cls = nn.remat(mlp_cls, policy=pol, prevent_cse=False)
         attn_out = attn_cls(cfg, name="attn")(
-            Norm(cfg, name="ln1")(x), positions, segment_ids)
+            Norm(cfg, name="ln1")(x), positions, segment_ids, dropout_seed)
         # names referenced by the 'offload_dots' remat policy (utils/remat.py)
         h = x + checkpoint_name(attn_out, "attn_out")
         mlp_out = mlp_cls(cfg, name="moe" if cfg.num_experts > 0 else "mlp")(
@@ -261,13 +300,15 @@ class Block(nn.Module):
 
 
 class ScanBlock(nn.Module):
-    """Block adapted to nn.scan's (carry, _) -> (carry, out) signature."""
+    """Block adapted to nn.scan's (carry, xs) -> (carry, out) signature;
+    ``seed`` is the per-layer dropout seed (scanned xs) or None."""
     cfg: ModelConfig
 
     @nn.compact
-    def __call__(self, carry, _):
+    def __call__(self, carry, seed):
         x, positions, segment_ids = carry
-        x = Block(self.cfg, name="block")(x, positions, segment_ids)
+        x = Block(self.cfg, name="block")(x, positions, segment_ids,
+                                          dropout_seed=seed)
         return (x, positions, segment_ids), None
 
 
@@ -281,8 +322,15 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, segment_ids=None,
-                 return_hidden=False):
+                 return_hidden=False, dropout_seed=None):
         cfg = self.cfg
+        # Attention dropout is active iff the caller supplies a seed
+        # (train steps do; eval/inference omit it — the deterministic
+        # story).  One base seed fans out to per-layer seeds here.
+        seeds_xs = None
+        if cfg.attn_dropout > 0.0 and dropout_seed is not None:
+            seeds_xs = _layer_seed(
+                dropout_seed, jnp.arange(cfg.num_layers, dtype=jnp.int32))
         if cfg.pp_size > 1 and not cfg.scan_layers:
             raise ValueError(
                 "pipeline parallelism (pp_size > 1) requires scan_layers="
@@ -325,15 +373,26 @@ class TransformerLM(nn.Module):
                 # exist with the stacked layout)
                 from torchacc_tpu.parallel.pp import pipeline_blocks
                 layer_params = self.variables["params"]["layers"]
+                if seeds_xs is not None:
+                    # per-layer seeds ride the stacked pytree so each
+                    # pp stage sees its own layers' seeds
+                    stacked = {"p": layer_params, "s": seeds_xs}
 
-                def apply_one(p, carry):
-                    new_carry, _ = ScanBlock(cfg).apply({"params": p},
-                                                        carry, None)
-                    return new_carry
+                    def apply_one(ps, carry):
+                        new_carry, _ = ScanBlock(cfg).apply(
+                            {"params": ps["p"]}, carry, ps["s"])
+                        return new_carry
+                else:
+                    stacked = layer_params
+
+                    def apply_one(p, carry):
+                        new_carry, _ = ScanBlock(cfg).apply({"params": p},
+                                                            carry, None)
+                        return new_carry
 
                 from torchacc_tpu.utils.remat import remat_policy
                 x = pipeline_blocks(
-                    apply_one, layer_params, (x, positions, segment_ids),
+                    apply_one, stacked, (x, positions, segment_ids),
                     pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
                     remat=cfg.remat,
                     remat_policy=(remat_policy(cfg.remat_policy)
@@ -360,9 +419,10 @@ class TransformerLM(nn.Module):
                             else jnp.zeros((), jnp.float32))
 
                 def apply_block(block_cfg):
-                    def fn(p, carry):
+                    def fn(ps, carry):
+                        p, s = ps
                         (new_carry, _), vs = ScanBlock(block_cfg).apply(
-                            {"params": p}, carry, None,
+                            {"params": p}, carry, s,
                             mutable=["intermediates"])
                         return new_carry, _aux_sum(vs)
                     return fn
@@ -373,23 +433,29 @@ class TransformerLM(nn.Module):
                         apply_gc, policy=remat_policy(cfg.remat_policy),
                         prevent_cse=False)
 
-                def seg(fn, stack, carry):
+                def seg(fn, stack, lo, hi, carry):
+                    if seeds_xs is None:
+                        return jax.lax.scan(
+                            lambda c, p: fn((p, None), c), carry, stack)
                     return jax.lax.scan(
-                        lambda c, p: fn(p, c), carry, stack)
+                        lambda c, ps: fn(ps, c), carry,
+                        (stack, seeds_xs[lo:hi]))
 
                 carry = (x, positions, segment_ids)
                 aux_total = jnp.zeros((), jnp.float32)
                 if split_n > 0:
-                    carry, aux = seg(apply_gc, head, carry)
+                    carry, aux = seg(apply_gc, head, 0, split_n, carry)
                     aux_total = aux_total + jnp.sum(aux)
                 if split_n < cfg.num_layers:
-                    carry, aux = seg(apply_plain, tail, carry)
+                    carry, aux = seg(apply_plain, tail, split_n,
+                                     cfg.num_layers, carry)
                     aux_total = aux_total + jnp.sum(aux)
                 if cfg.num_experts > 0:
                     self.sow("intermediates", "moe_aux_loss", aux_total)
                 x = carry[0]
             else:
-                (x, _, _), _ = scan_mod((x, positions, segment_ids), None)
+                (x, _, _), _ = scan_mod((x, positions, segment_ids),
+                                        seeds_xs)
         else:
             for i in range(cfg.num_layers):
                 past = split_n is not None and i >= split_n
@@ -398,9 +464,10 @@ class TransformerLM(nn.Module):
                 # it off for layers past remat_cnt
                 cfg_i = (dataclasses.replace(cfg, remat=False)
                          if past and _sub_remat(cfg) else cfg)
+                seed_i = None if seeds_xs is None else seeds_xs[i]
                 (x, positions, segment_ids), _ = cls_i(
                     cfg_i, name=f"layers_{i}")((x, positions, segment_ids),
-                                               None)
+                                               seed_i)
 
         x = Norm(cfg, name="final_norm")(x)
         if return_hidden:
@@ -442,3 +509,61 @@ def loss_fn(logits: jax.Array, labels: jax.Array,
     """Mean next-token cross entropy (see loss_sum_count)."""
     total, count = loss_sum_count(logits, labels, loss_mask)
     return total / jnp.maximum(count, 1.0)
+
+
+def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
+                              positions=None, segment_ids=None,
+                              labels=None, pp_axis: str = "pp"):
+    """(loss_sum, count) for a zoo model under the 1F1B pipeline schedule.
+
+    The 1F1B schedule (parallel/pp.py pipeline_loss_1f1b; reference
+    pp/schedule.py:156-227) fuses final-norm + head + loss into the last
+    stage so each micro-batch's backward starts as soon as its forward
+    finishes.  That means the loss cannot be computed OUTSIDE model.apply
+    the way the GPipe path does — this function replaces the trainer's
+    forward for pp.schedule == '1f1b'.  Embedding (+ learned positions)
+    runs outside the region, replicated over 'pp', exactly like the
+    GPipe path; gradients flow into it through the pipeline's dx.
+
+    Not yet composed with attention dropout or MoE aux losses (both
+    raise at config validation).
+    """
+    from torchacc_tpu.parallel.pp import pipeline_loss_1f1b
+    from torchacc_tpu.train.trainer import shift_labels
+
+    b, s = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    emb_table = params["embed_tokens"]["embedding"]
+    x = emb_table[input_ids].astype(cfg.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    if labels is None:
+        labels = shift_labels(input_ids, segment_ids)
+
+    stacked = params["layers"]
+    head_params = {"final_norm": params["final_norm"]}
+    if cfg.tie_embeddings:
+        head_params["embed"] = emb_table
+    else:
+        head_params["lm_head"] = params["lm_head"]
+
+    def apply_block(p, carry):
+        new_carry, _ = ScanBlock(cfg).apply({"params": p}, carry, None)
+        return new_carry
+
+    def head_loss(hp, y, lab):
+        xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsh,vh->bsv", xn.astype(jnp.float32),
+                                hp["embed"].astype(jnp.float32))
+        else:
+            logits = jnp.einsum(
+                "bsh,hv->bsv", xn.astype(jnp.float32),
+                hp["lm_head"]["kernel"].astype(jnp.float32))
+        return loss_sum_count(logits, lab)
+
+    riders = (positions, segment_ids)
+    return pipeline_loss_1f1b(
+        apply_block, head_loss, stacked, head_params, x, riders, labels,
+        cfg.pp_size, cfg.pp_num_micro, pp_axis)

@@ -18,3 +18,49 @@ def on_tpu() -> bool:
 def interpret_mode() -> bool:
     """Pallas kernels run in interpreter mode off-TPU (CPU tests)."""
     return not on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# Counter-based dropout hash (attention dropout)
+#
+# The reference threads dropout_p through every flash op via cuRAND states
+# (ops/flash_attn.py:418-423).  TPU-native equivalent: a stateless
+# murmur3-finalizer hash of the ABSOLUTE coordinates (seed, batch, head,
+# global q position, global k position) -> uint32, thresholded at
+# dropout_p * 2^32.  Because the mask is a pure function of absolute
+# coordinates it is bit-identical between the forward and both backward
+# kernels regardless of block sizes, identical between the Pallas and XLA
+# paths (exact-match testable), and consistent across context-parallel
+# ring steps when global offsets are passed.  Plain uint32 ops only, so
+# it runs on the MXU-adjacent VPU and in interpreter mode alike.
+# ---------------------------------------------------------------------------
+
+def mix32(x):
+    """murmur3 finalizer: uint32 -> well-mixed uint32."""
+    import jax.numpy as jnp
+    x = jnp.asarray(x).astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+_B_PRIME = 0x85EBCA6B
+_K_PRIME = 0x9E3779B9  # golden-ratio odd constant
+
+
+def dropout_keep(seed, b_idx, h_idx, q_pos, k_pos, dropout_p: float):
+    """Boolean keep mask: True = keep.  ``q_pos`` [.., bq] and ``k_pos``
+    [.., bk] are GLOBAL int32 positions; broadcasting forms [.., bq, bk].
+    P(keep) = 1 - dropout_p (2^-32 granularity)."""
+    import jax.numpy as jnp
+    base = mix32(jnp.uint32(seed)
+                 + jnp.uint32(b_idx) * jnp.uint32(_B_PRIME)
+                 + jnp.uint32(h_idx))
+    row = mix32(base ^ q_pos.astype(jnp.uint32))
+    col = mix32(k_pos.astype(jnp.uint32) * jnp.uint32(_K_PRIME))
+    bits = mix32(row[..., :, None] ^ col[..., None, :])
+    threshold = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return bits >= threshold

@@ -24,7 +24,18 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torchacc_tpu.ops._common import NEG_INF
+from torchacc_tpu.ops._common import NEG_INF, dropout_keep
+
+
+def _dropout_keep_dense(seed, b: int, h: int, q_pos, k_pos,
+                        dropout_p: float, h_offset=0, b_offset=0):
+    """[b, h, sq, sk] keep mask — the dense twin of the Pallas kernel's
+    _keep_mask_2d, bit-identical for the same coordinates."""
+    b_idx = (jnp.arange(b, dtype=jnp.int32)[:, None, None]
+             + b_offset).astype(jnp.uint32)
+    h_idx = (jnp.arange(h, dtype=jnp.int32)[None, :, None]
+             + h_offset).astype(jnp.uint32)
+    return dropout_keep(seed, b_idx, h_idx, q_pos, k_pos, dropout_p)
 
 
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
@@ -82,7 +93,7 @@ def make_attention_mask(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "window", "scale", "return_lse", "q_offset"),
+    static_argnames=("causal", "window", "scale", "return_lse", "dropout_p"),
 )
 def attention_reference(
     q: jax.Array,
@@ -96,14 +107,22 @@ def attention_reference(
     kv_segment_ids: Optional[jax.Array] = None,
     bias: Optional[jax.Array] = None,
     alibi_slopes: Optional[jax.Array] = None,
-    q_offset: int = 0,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
+    q_offset=0,
+    k_offset=0,
+    h_offset=0,
+    b_offset=0,
     return_lse: bool = False,
 ):
     """Plain-XLA attention.  Returns ``out`` or ``(out, lse)``.
 
     ``lse`` is [batch, heads, q_len] in float32, natural log base — the
     same contract as the reference kernels' softmax_lse output
-    (ops/flash_attn.py:60-63), enabling CP merging.
+    (ops/flash_attn.py:60-63), enabling CP merging.  ``q_offset`` /
+    ``k_offset`` are GLOBAL chunk positions (traced ints allowed — used
+    by the context-parallel ring); dropout uses the shared coordinate
+    hash, bit-identical to the Pallas kernel for the same seed.
     """
     orig_dtype = q.dtype
     b, sq, hq, d = q.shape
@@ -117,18 +136,18 @@ def attention_reference(
                         k.astype(jnp.float32)) * scale
     if bias is not None:
         scores = scores + bias.astype(jnp.float32)
+    shift = q_offset - k_offset + (sk - sq)
     if alibi_slopes is not None:
         # bottom-right aligned bias, same geometry as the mask below
         # (reference ops/flash_attn.py:411-413)
-        scores = scores + _alibi_scores(alibi_slopes, sq, sk,
-                                        q_offset + (sk - sq))
+        scores = scores + _alibi_scores(alibi_slopes, sq, sk, shift)
     # bottom-right alignment for sq != sk (flash-attn semantics): the
     # LAST query aligns with the LAST key — consistent with the Pallas
     # kernel and with the ALiBi bias above
     mask = make_attention_mask(
         sq, sk, causal=causal, window=window,
         q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-        q_offset=q_offset + (sk - sq))
+        q_offset=shift)
     if mask.ndim == 3:  # [b, q, k] from segment ids
         mask = mask[:, None, :, :]
     scores = jnp.where(mask, scores, NEG_INF)
@@ -136,6 +155,14 @@ def attention_reference(
     probs = jnp.exp(scores - lse[..., None])
     # Fully-masked rows (padding queries): output zeros, lse = -inf-ish.
     probs = jnp.where(mask, probs, 0.0)
+    if dropout_p > 0.0:
+        seed = 0 if dropout_seed is None else dropout_seed
+        keep = _dropout_keep_dense(
+            seed, b, hq,
+            jnp.arange(sq, dtype=jnp.int32) + q_offset,
+            jnp.arange(sk, dtype=jnp.int32) + k_offset, dropout_p,
+            h_offset=h_offset, b_offset=b_offset)
+        probs = jnp.where(keep, probs, 0.0) * (1.0 / (1.0 - dropout_p))
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     out = out.astype(orig_dtype)
     if return_lse:
@@ -157,12 +184,20 @@ def attention_reference_bwd(
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     alibi_slopes: Optional[jax.Array] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
+    q_offset=0,
+    k_offset=0,
+    h_offset=0,
+    b_offset=0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Plain-XLA flash-style backward from saved (o, lse): (dq, dk, dv).
 
     Same contract as flash_attention_bwd — used by the context-parallel
     ring when the Pallas kernel is disabled (impl='xla').  GQA grads are
-    group-reduced.
+    group-reduced.  The dropped-softmax VJP is
+        dS = P̃ ∘ (dO Vᵀ) − P ∘ delta
+    (P̃ = dropout-scaled probabilities, delta = rowsum(dO ∘ O)).
     """
     b, sq, hq, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
@@ -175,22 +210,32 @@ def attention_reference_bwd(
     dof = do.astype(jnp.float32)
     of = o.astype(jnp.float32)
 
+    shift = q_offset - k_offset + (sk - sq)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr) * scale
     if alibi_slopes is not None:
-        s = s + _alibi_scores(alibi_slopes, sq, sk, sk - sq)
+        s = s + _alibi_scores(alibi_slopes, sq, sk, shift)
     mask = make_attention_mask(sq, sk, causal=causal, window=window,
                                q_segment_ids=q_segment_ids,
                                kv_segment_ids=kv_segment_ids,
-                               q_offset=sk - sq)
+                               q_offset=shift)
     if mask.ndim == 3:
         mask = mask[:, None, :, :]
     p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+    p_tilde = p
+    if dropout_p > 0.0:
+        seed = 0 if dropout_seed is None else dropout_seed
+        keep = _dropout_keep_dense(
+            seed, b, hq,
+            jnp.arange(sq, dtype=jnp.int32) + q_offset,
+            jnp.arange(sk, dtype=jnp.int32) + k_offset, dropout_p,
+            h_offset=h_offset, b_offset=b_offset)
+        p_tilde = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
     delta = jnp.einsum("bqhd,bqhd->bhq", dof, of)
     dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vr)
-    ds = p * (dp - delta[..., None]) * scale
+    ds = (p_tilde * dp - p * delta[..., None]) * scale
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kr)
     dk_full = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-    dv_full = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dv_full = jnp.einsum("bhqk,bqhd->bkhd", p_tilde, dof)
     if group > 1:
         dk = dk_full.reshape(b, sk, hk, group, d).sum(axis=3)
         dv = dv_full.reshape(b, sk, hk, group, d).sum(axis=3)

@@ -31,10 +31,16 @@ def attention(
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     alibi_slopes: Optional[jax.Array] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
     impl: str = "auto",
     return_lse: bool = False,
 ):
-    """[b, s, h, d] attention with optional LSE output."""
+    """[b, s, h, d] attention with optional LSE output.
+
+    ``dropout_p``/``dropout_seed``: post-softmax attention dropout; the
+    stateless coordinate-hash mask (ops/_common.py) makes the pallas and
+    xla backends bit-identical for the same seed."""
     forced = impl == "pallas"
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "xla"
@@ -44,7 +50,8 @@ def attention(
             return flash_attention(
                 q, k, v, causal=causal, window=window, scale=scale,
                 q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-                alibi_slopes=alibi_slopes, return_lse=return_lse)
+                alibi_slopes=alibi_slopes, dropout_p=dropout_p,
+                dropout_seed=dropout_seed, return_lse=return_lse)
         except ImportError:
             if forced:
                 raise
@@ -57,4 +64,5 @@ def attention(
     return attention_reference(
         q, k, v, causal=causal, window=window, scale=scale,
         q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
-        alibi_slopes=alibi_slopes, return_lse=return_lse)
+        alibi_slopes=alibi_slopes, dropout_p=dropout_p,
+        dropout_seed=dropout_seed, return_lse=return_lse)

@@ -7,6 +7,13 @@ equivalent of the reference's 2D FlashSequence (context_parallel_2d.py:
 Degenerates automatically: spu=1 -> pure ring, sp=1 -> pure ulysses,
 both 1 -> plain (local) flash attention.
 
+The full attention feature matrix passes through CP (the reference ring
+accepts window_size/alibi_slopes/dropout_p, ring_attn.py:32-36): sliding
+windows and ALiBi ride the ring via per-step GLOBAL chunk offsets, and
+dropout's stateless coordinate hash is keyed by global (batch, head, q,
+k) indices so a CP run is bit-identical to a single-device run with the
+same seed.
+
 Called from the model's attention layer when context parallelism is on;
 the surrounding train step is an ordinary jit and the region's in/out
 specs splice into the global sharding (dp/fsdp on batch, tp on heads).
@@ -37,6 +44,13 @@ def _ambient_mesh() -> Optional[Mesh]:
     return None
 
 
+def _axis_index(mesh, name: str):
+    """axis_index, or 0 when the axis is absent / extent 1."""
+    if name and int(mesh.shape.get(name, 1)) > 1:
+        return jax.lax.axis_index(name)
+    return jnp.int32(0)
+
+
 def cp_attention(
     q: jax.Array,
     k: jax.Array,
@@ -46,6 +60,9 @@ def cp_attention(
     window: Tuple[int, int] = (-1, -1),
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
+    alibi_slopes: Optional[jax.Array] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
     mesh: Optional[Mesh] = None,
     ring_axis: str = "sp",
     a2a_axis: str = "spu",
@@ -62,49 +79,77 @@ def cp_attention(
     if ring_n * ul_n == 1:
         return attention(q, k, v, causal=causal, window=window,
                          q_segment_ids=q_segment_ids,
-                         kv_segment_ids=kv_segment_ids, impl=impl)
-    if window != (-1, -1):
-        raise NotImplementedError(
-            "sliding-window attention is not supported under context "
-            "parallelism (the reference ring implementation has the same "
-            "limitation); disable the window or set sp.size = 1")
+                         kv_segment_ids=kv_segment_ids,
+                         alibi_slopes=alibi_slopes, dropout_p=dropout_p,
+                         dropout_seed=dropout_seed, impl=impl)
     # 'auto' resolves to the Pallas kernel (interpret mode off-TPU);
     # an explicit 'xla' request is honoured down the whole CP stack.
     inner_impl = "pallas" if impl == "auto" else impl
 
     d = q.shape[-1]
     has_seg = q_segment_ids is not None
+    has_alibi = alibi_slopes is not None
+    has_seed = dropout_seed is not None
     seq_axes = (ring_axis, a2a_axis)
     qkv_spec = P(data_axes, seq_axes, tp_axis, None)
     seg_spec = P(data_axes, seq_axes)
 
-    def region(q, k, v, qseg=None, kseg=None):
+    def region(q, k, v, *rest):
+        rest = list(rest)
+        qseg = rest.pop(0) if has_seg else None
+        kseg = rest.pop(0) if has_seg else None
+        slopes_tp = rest.pop(0) if has_alibi else None  # [h_tp] local slice
+        seed = rest.pop(0) if has_seed else None
         scale = d ** -0.5
 
+        # global offsets of this shard's rows: batch over the data axes,
+        # heads over tp (further split by the ulysses a2a below)
+        b_loc = q.shape[0]
+        b_pos = jnp.int32(0)
+        for ax in data_axes:
+            b_pos = b_pos * jnp.int32(int(mesh.shape.get(ax, 1))) \
+                + _axis_index(mesh, ax)
+        b_off = b_pos * b_loc
+        h_tp_off = _axis_index(mesh, tp_axis) * q.shape[2]
+
         def local_attn(q_, k_, v_, qs_, ks_):
+            h_inner = q_.shape[2]
+            # ulysses a2a gave this device head chunk [spu_idx*h_inner ...)
+            spu_idx = _axis_index(mesh, a2a_axis)
+            h_off = h_tp_off + spu_idx * h_inner
+            slopes = slopes_tp
+            if slopes is not None and ul_n > 1:
+                slopes = jax.lax.dynamic_slice_in_dim(
+                    slopes_tp, spu_idx * h_inner, h_inner)
             if ring_n > 1:
-                return ring_attention(q_, k_, v_, qs_, ks_,
-                                      ring_axis, ring_n, causal, inner_impl)
-            if inner_impl == "xla":
-                return attention_reference(
-                    q_, k_, v_, causal=causal, scale=scale,
-                    q_segment_ids=qs_, kv_segment_ids=ks_)
-            return flash_attention(q_, k_, v_, causal=causal, scale=scale,
-                                   q_segment_ids=qs_, kv_segment_ids=ks_)
+                return ring_attention(q_, k_, v_, qs_, ks_, slopes, seed,
+                                      h_off, b_off,
+                                      ring_axis, ring_n, causal, window,
+                                      dropout_p, inner_impl)
+            fn = (attention_reference if inner_impl == "xla"
+                  else flash_attention)
+            return fn(q_, k_, v_, causal=causal, window=window, scale=scale,
+                      q_segment_ids=qs_, kv_segment_ids=ks_,
+                      alibi_slopes=slopes, dropout_p=dropout_p,
+                      dropout_seed=seed, h_offset=h_off, b_offset=b_off)
 
         return ulysses_attention(q, k, v, qseg, kseg, a2a_axis, ul_n,
                                  inner=local_attn)
 
+    in_specs = [qkv_spec, qkv_spec, qkv_spec]
+    args = [q, k, v]
     if has_seg:
-        return jax.shard_map(
-            region, mesh=mesh,
-            in_specs=(qkv_spec, qkv_spec, qkv_spec, seg_spec, seg_spec),
-            out_specs=qkv_spec,
-            check_vma=False,
-        )(q, k, v, q_segment_ids, kv_segment_ids)
+        in_specs += [seg_spec, seg_spec]
+        args += [q_segment_ids, kv_segment_ids]
+    if has_alibi:
+        in_specs.append(P(tp_axis))
+        args.append(alibi_slopes)
+    if has_seed:
+        in_specs.append(P())
+        args.append(jnp.asarray(dropout_seed, jnp.int32))
     return jax.shard_map(
         region, mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        in_specs=tuple(in_specs),
         out_specs=qkv_spec,
         check_vma=False,
-    )(q, k, v)
+    )(*args)

@@ -4,11 +4,13 @@ TPU-native redesign of the reference's ring flash attention
 (ops/context_parallel/ring_attn.py:22-271): kv shards rotate around the
 ring via ``ppermute`` (the reference uses batched NCCL isend/irecv through
 ``RingComm``, cp/utils.py:368-423), partial results merge through LSE
-(reference `_update_out_and_lse` cp/utils.py:302-343), and causality is
-handled by the block decomposition — a step is *full* (kv chunk strictly
-before my queries), *diagonal* (my own chunk, causal), or *skipped*
-(kv chunk after my queries; reference skips via `step > rank`
-ring_attn.py:55,174).
+(reference `_update_out_and_lse` cp/utils.py:302-343), and masking is
+handled by GLOBAL geometry: every per-step flash call receives the global
+offsets of its q and kv chunks, so causality, sliding windows
+(reference ring_attn.py:32-36 ``window_size``), ALiBi slopes and dropout
+all see the same positions they would in a single-device call.  Steps
+whose band is provably empty are skipped (the reference skips via
+`step > rank` ring_attn.py:55,174; the window adds distance-based skips).
 
 The backward is a custom VJP that re-walks the ring in the same order,
 evaluating each step's flash backward against the GLOBAL (merged) lse and
@@ -50,50 +52,65 @@ def _rotate(x, axis_name: str, n: int):
     return jax.lax.ppermute(x, axis_name, [(j, (j + 1) % n) for j in range(n)])
 
 
-def _step_mode(me, src, causal: bool):
-    """0 = skip, 1 = diagonal (causal within chunk), 2 = full."""
-    if not causal:
-        return jnp.full_like(me, 2)
-    return jnp.where(src > me, 0, jnp.where(src == me, 1, 2))
+def _step_should_run(me, src, s: int, causal: bool, window):
+    """False when the (q chunk me, kv chunk src) band is provably empty:
+    causal skip (src entirely after me) or window skip (chunks further
+    apart than the band reaches)."""
+    left, right = window
+    run = jnp.asarray(True)
+    if causal:
+        run = jnp.logical_and(run, src <= me)
+    if left >= 0:
+        # kv chunk ends at (src+1)s-1; the earliest in-band key for my
+        # queries is me*s - left
+        run = jnp.logical_and(run, (src + 1) * s - 1 >= me * s - left)
+    if right >= 0 and not causal:
+        run = jnp.logical_and(run, src * s <= (me + 1) * s - 1 + right)
+    return run
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def ring_attention(q, k, v, q_segment_ids, kv_segment_ids,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13, 14))
+def ring_attention(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
+                   dropout_seed, h_offset, b_offset,
                    axis_name: str, n: int, causal: bool,
+                   window: Tuple[int, int] = (-1, -1),
+                   dropout_p: float = 0.0,
                    impl: str = "pallas"):
     out, _ = _ring_fwd_impl(q, k, v, q_segment_ids, kv_segment_ids,
-                            axis_name, n, causal, impl)
+                            alibi_slopes, dropout_seed, h_offset, b_offset,
+                            axis_name, n, causal, window, dropout_p, impl)
     return out
 
 
-def _ring_fwd_impl(q, k, v, qseg, kseg, axis_name, n, causal, impl):
+def _ring_fwd_impl(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
+                   h_offset, b_offset,
+                   axis_name, n, causal, window, dropout_p, impl):
     b, sq, hq, d = q.shape
     me = jax.lax.axis_index(axis_name)
     scale = d ** -0.5
 
     out0 = jnp.zeros((b, sq, hq, d), jnp.float32)
     lse0 = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
+    fwd = _fwd_fn(impl)
 
     def body(i, carry):
         out, lse, k_cur, v_cur, kseg_cur = carry
         src = (me - i) % n
-        mode = _step_mode(me, src, causal)
 
         def _skip(_):
             return (jnp.zeros((b, sq, hq, d), q.dtype),
                     jnp.full((b, hq, sq), NEG_INF, jnp.float32))
 
-        fwd = _fwd_fn(impl)
+        def _run(_):
+            return fwd(q, k_cur, v_cur, causal=causal, window=window,
+                       scale=scale, q_segment_ids=qseg,
+                       kv_segment_ids=kseg_cur, alibi_slopes=alibi_slopes,
+                       dropout_p=dropout_p, dropout_seed=dropout_seed,
+                       q_offset=me * sq, k_offset=src * sq,
+                       h_offset=h_offset, b_offset=b_offset)
 
-        def _diag(_):
-            return fwd(q, k_cur, v_cur, causal=True, scale=scale,
-                       q_segment_ids=qseg, kv_segment_ids=kseg_cur)
-
-        def _full(_):
-            return fwd(q, k_cur, v_cur, causal=False, scale=scale,
-                       q_segment_ids=qseg, kv_segment_ids=kseg_cur)
-
-        o_i, lse_i = jax.lax.switch(mode, [_skip, _diag, _full], None)
+        o_i, lse_i = jax.lax.cond(
+            _step_should_run(me, src, sq, causal, window), _run, _skip, None)
         out, lse = merge_attention(out, lse, o_i.astype(jnp.float32), lse_i)
         # rotate kv onward (last rotation returns shards home)
         k_cur = _rotate(k_cur, axis_name, n)
@@ -107,13 +124,20 @@ def _ring_fwd_impl(q, k, v, qseg, kseg, axis_name, n, causal, impl):
     return out.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, qseg, kseg, axis_name, n, causal, impl):
-    out, lse = _ring_fwd_impl(q, k, v, qseg, kseg, axis_name, n, causal, impl)
-    return out, (q, k, v, qseg, kseg, out, lse)
+def _ring_fwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
+              h_offset, b_offset,
+              axis_name, n, causal, window, dropout_p, impl):
+    out, lse = _ring_fwd_impl(q, k, v, qseg, kseg, alibi_slopes,
+                              dropout_seed, h_offset, b_offset,
+                              axis_name, n, causal, window,
+                              dropout_p, impl)
+    return out, (q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
+                 h_offset, b_offset, out, lse)
 
 
-def _ring_bwd(axis_name, n, causal, impl, res, do):
-    q, k, v, qseg, kseg, o, lse = res
+def _ring_bwd(axis_name, n, causal, window, dropout_p, impl, res, do):
+    (q, k, v, qseg, kseg, alibi_slopes, dropout_seed, h_offset, b_offset,
+     o, lse) = res
     b, sq, hq, d = q.shape
     me = jax.lax.axis_index(axis_name)
     scale = d ** -0.5
@@ -121,27 +145,26 @@ def _ring_bwd(axis_name, n, causal, impl, res, do):
     dq0 = jnp.zeros(q.shape, jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
     dv0 = jnp.zeros(v.shape, jnp.float32)
+    bwd = _bwd_fn(impl)
 
     def body(i, carry):
         dq, dk, dv, k_cur, v_cur, kseg_cur = carry
         src = (me - i) % n
-        mode = _step_mode(me, src, causal)
 
         def _skip(_):
             return (jnp.zeros(q.shape, q.dtype), jnp.zeros(k.shape, k.dtype),
                     jnp.zeros(v.shape, v.dtype))
 
-        bwd = _bwd_fn(impl)
+        def _run(_):
+            return bwd(q, k_cur, v_cur, o, lse, do, causal=causal,
+                       window=window, scale=scale, q_segment_ids=qseg,
+                       kv_segment_ids=kseg_cur, alibi_slopes=alibi_slopes,
+                       dropout_p=dropout_p, dropout_seed=dropout_seed,
+                       q_offset=me * sq, k_offset=src * sq,
+                       h_offset=h_offset, b_offset=b_offset)
 
-        def _mk(is_causal):
-            def f(_):
-                return bwd(q, k_cur, v_cur, o, lse, do, causal=is_causal,
-                           scale=scale, q_segment_ids=qseg,
-                           kv_segment_ids=kseg_cur)
-            return f
-
-        dq_i, dk_i, dv_i = jax.lax.switch(
-            mode, [_skip, _mk(True), _mk(False)], None)
+        dq_i, dk_i, dv_i = jax.lax.cond(
+            _step_should_run(me, src, sq, causal, window), _run, _skip, None)
         dq = dq + dq_i.astype(jnp.float32)
         dk = dk + dk_i.astype(jnp.float32)
         dv = dv + dv_i.astype(jnp.float32)
@@ -158,7 +181,7 @@ def _ring_bwd(axis_name, n, causal, impl, res, do):
     dq, dk, dv, _, _, _ = jax.lax.fori_loop(
         0, n, body, (dq0, dk0, dv0, k, v, kseg))
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            None, None)
+            None, None, None, None, None, None)
 
 
 ring_attention.defvjp(_ring_fwd, _ring_bwd)

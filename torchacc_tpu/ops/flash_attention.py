@@ -32,10 +32,37 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from torchacc_tpu.ops._common import NEG_INF, interpret_mode as _interpret
+from torchacc_tpu.ops._common import (
+    _B_PRIME,
+    _K_PRIME,
+    NEG_INF,
+    interpret_mode as _interpret,
+    mix32,
+)
 
 _LANES = 128
 _SUBLANES = 8
+
+
+def _keep_mask_2d(seed, b_idx, h_idx, q0, k0, block_q, block_k,
+                  dropout_p: float):
+    """[block_q, block_k] dropout keep mask from GLOBAL coordinates.
+
+    Same formula as ops._common.dropout_keep (the XLA path) expressed via
+    2-D broadcasted iota so it lowers on TPU: the mask is a pure function
+    of (seed, batch, head, absolute q, absolute k), hence bit-identical
+    across the forward and both backward kernels, across block-size
+    choices, and across context-parallel ring steps."""
+    base = mix32(jnp.uint32(seed).astype(jnp.uint32)
+                 + jnp.uint32(b_idx) * jnp.uint32(_B_PRIME)
+                 + jnp.uint32(h_idx))
+    gq = (q0 + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)).astype(jnp.uint32)
+    gk = (k0 + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)).astype(jnp.uint32)
+    bits = mix32(mix32(base ^ gq) ^ mix32(gk * jnp.uint32(_K_PRIME)))
+    threshold = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
+    return bits >= threshold
 
 
 def _round_up(x: int, m: int) -> int:
@@ -103,11 +130,13 @@ def _block_should_run(q_start, k_start, block_q, block_k, causal, window,
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
                 o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, scale, causal, window, block_q, block_k, num_kv_blocks,
-                qk_shift=0):
+                qk_shift=0, dropout_p=0.0):
+    bi = pl.program_id(0)
+    hi = pl.program_id(1)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -119,9 +148,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
+    # meta = [seed, q_off, k_off, h_off, b_off] (see _make_meta): the
+    # dynamic global q/k offsets (context-parallel ring chunks) fold
+    # into the positional shift; h/b offsets key the dropout hash
+    shift = qk_shift
+    if meta_ref is not None:
+        shift = shift + meta_ref[1] - meta_ref[2]
 
     @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window, qk_shift))
+                               causal, window, shift))
     def _compute():
         q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, d]
         k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
@@ -131,10 +166,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
             preferred_element_type=jnp.float32) * scale     # [bq, bk]
         if alibi_ref is not None:
             s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
-                                block_q, block_k, qk_shift)
+                                block_q, block_k, shift)
 
         mask = _band_mask(q_start, k_start, block_q, block_k, causal, window,
-                          qk_shift)
+                          shift)
         if qseg_ref is not None:
             qs = qseg_ref[0, :, 0]                          # [bq]
             ks = kseg_ref[0, 0, :]                          # [bk]
@@ -151,9 +186,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         alpha = jnp.where(m_prev == NEG_INF, 0.0, alpha)
+        # dropout applies to the accumulated P@V only: l (and so the lse)
+        # stays the UNdropped softmax normaliser — exactly flash-attn's
+        # decomposition, and what the backward recomputation assumes
         l_new = alpha * l_scr[:, 0] + jnp.sum(p, axis=1)
+        p_v = p
+        if dropout_p > 0.0:
+            keep = _keep_mask_2d(
+                meta_ref[0], meta_ref[4] + bi, meta_ref[3] + hi,
+                meta_ref[1] + q_start, meta_ref[2] + k_start,
+                block_q, block_k, dropout_p)
+            p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p_v, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
@@ -168,21 +213,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
         lse_ref[0, 0, :, :] = jnp.broadcast_to(lse[:, None], lse_ref.shape[2:])
 
 
-def _mk_kernel(core, has_seg, has_alibi, **kw):
-    """Adapter: unpack the optional (seg, alibi) refs positionally so one
-    core kernel serves all feature combinations."""
+def _mk_kernel(core, has_seg, has_alibi, has_meta=False, **kw):
+    """Adapter: unpack the optional (seg, alibi, meta) refs positionally
+    so one core kernel serves all feature combinations."""
     def kernel(*refs):
         q_ref, k_ref, v_ref = refs[:3]
         i = 3
-        qseg = kseg = alibi = None
+        qseg = kseg = alibi = meta = None
         if has_seg:
             qseg, kseg = refs[i], refs[i + 1]
             i += 2
         if has_alibi:
             alibi = refs[i]
             i += 1
+        if has_meta:
+            meta = refs[i]
+            i += 1
         rest = refs[i:]
-        core(q_ref, k_ref, v_ref, qseg, kseg, alibi, *rest, **kw)
+        core(q_ref, k_ref, v_ref, qseg, kseg, alibi, meta, *rest, **kw)
     return kernel
 
 
@@ -193,9 +241,14 @@ def _alibi_operand(alibi_slopes):
         alibi_slopes.astype(jnp.float32), (h, _SUBLANES, _LANES), (0,))
 
 
-def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, scale,
-         causal, window, block_q, block_k, qk_shift=0):
-    """q,k,v in BHSD.  Returns (o BHSD, lse [b,h,sq] f32)."""
+def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta, scale,
+         causal, window, block_q, block_k, qk_shift=0, dropout_p=0.0):
+    """q,k,v in BHSD.  Returns (o BHSD, lse [b,h,sq] f32).
+
+    ``meta``: optional int32 [5] = (dropout seed, global q offset,
+    global k offset, global head offset, global batch offset) — SMEM
+    scalars, traced (no recompile per seed/offset); layout owned by
+    _make_meta."""
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = hq // hk
@@ -203,12 +256,13 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, scale,
     nk = pl.cdiv(sk, block_k)
     has_seg = q_segment_ids is not None
     has_alibi = alibi_slopes is not None
+    has_meta = meta is not None
 
     kernel = _mk_kernel(
-        _fwd_kernel, has_seg, has_alibi,
+        _fwd_kernel, has_seg, has_alibi, has_meta,
         scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_kv_blocks=nk,
-        qk_shift=qk_shift)
+        qk_shift=qk_shift, dropout_p=dropout_p)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
@@ -234,6 +288,9 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, scale,
         in_specs.append(pl.BlockSpec((1, _SUBLANES, _LANES),
                                      lambda b_, h, qi, ki: (h, 0, 0)))
         args.append(_alibi_operand(alibi_slopes))
+    if has_meta:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(meta)
 
     o, lse4 = pl.pallas_call(
         kernel,
@@ -266,31 +323,51 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, scale,
 # backward
 # ---------------------------------------------------------------------------
 
-def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, lse,
-                 q_start, k_start, *, scale, causal, window,
-                 block_q, block_k, qk_shift=0):
+def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref, lse,
+                 q_start, k_start, b_idx, h_idx, *, scale, causal, window,
+                 block_q, block_k, qk_shift=0, dropout_p=0.0):
+    """Rebuild (p, p_tilde, q, k) for one tile from the saved lse.
+
+    ``p`` is the exact softmax tile; ``p_tilde`` is the dropout-scaled
+    tile actually used in the forward P@V (equal to ``p`` when dropout is
+    off).  The VJP through dropped softmax is
+        dS = P̃ ∘ (dO Vᵀ) − P ∘ delta
+    with delta = rowsum(dO ∘ O) — note P̃ multiplies the dO Vᵀ term and
+    the plain P multiplies delta."""
+    shift = qk_shift
+    if meta_ref is not None:
+        shift = shift + meta_ref[1] - meta_ref[2]
     q = q_ref[0, 0, :, :].astype(jnp.float32)
     k = k_ref[0, 0, :, :].astype(jnp.float32)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if alibi_ref is not None:
         s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
-                            block_q, block_k, qk_shift)
+                            block_q, block_k, shift)
     mask = _band_mask(q_start, k_start, block_q, block_k, causal, window,
-                      qk_shift)
+                      shift)
     if qseg_ref is not None:
         seg = qseg_ref[0, :, 0][:, None] == kseg_ref[0, 0, :][None, :]
         mask = seg if mask is None else mask & seg
     p = jnp.exp(s - lse[:, None])
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
-    return p, q, k
+    p_tilde = p
+    if dropout_p > 0.0:
+        keep = _keep_mask_2d(
+            meta_ref[0], meta_ref[4] + b_idx, meta_ref[3] + h_idx,
+            meta_ref[1] + q_start, meta_ref[2] + k_start,
+            block_q, block_k, dropout_p)
+        p_tilde = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
+    return p, p_tilde, q, k
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
-                   do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+                   meta_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
                    *, scale, causal, window, block_q, block_k,
-                   num_kv_blocks, qk_shift=0):
+                   num_kv_blocks, qk_shift=0, dropout_p=0.0):
+    bi = pl.program_id(0)
+    hi = pl.program_id(1)
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -300,21 +377,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
+    shift = qk_shift
+    if meta_ref is not None:
+        shift = shift + meta_ref[1] - meta_ref[2]
 
     @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window, qk_shift))
+                               causal, window, shift))
     def _compute():
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
-        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref,
-                               lse, q_start, k_start, scale=scale,
-                               causal=causal, window=window, block_q=block_q,
-                               block_k=block_k, qk_shift=qk_shift)
+        p, p_tilde, q, k = _recompute_p(
+            q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
+            lse, q_start, k_start, bi, hi, scale=scale,
+            causal=causal, window=window, block_q=block_q,
+            block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = (p_tilde * dp - p * delta[:, None]) * scale
         dq_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -328,16 +409,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
-                    do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    meta_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                     dk_scr, dv_scr,
                     *, scale, causal, window, block_q, block_k,
-                    num_q_blocks, group, qk_shift=0):
+                    num_q_blocks, group, qk_shift=0, dropout_p=0.0):
     # grid (b, hk, nk, group, nq): the scratch accumulates over the whole
     # (group, q-block) inner sweep, so GQA/MQA grads never materialise
     # per-q-head dk/dv in HBM.
+    bi = pl.program_id(0)
     ki = pl.program_id(2)
     g = pl.program_id(3)
     qi = pl.program_id(4)
+    # global q-head index: the dropout mask is keyed by q head
+    h_idx = pl.program_id(1) * group + g
 
     @pl.when(jnp.logical_and(g == 0, qi == 0))
     def _init():
@@ -346,24 +430,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
 
     q_start = qi * block_q
     k_start = ki * block_k
+    shift = qk_shift
+    if meta_ref is not None:
+        shift = shift + meta_ref[1] - meta_ref[2]
 
     @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window, qk_shift))
+                               causal, window, shift))
     def _compute():
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         do = do_ref[0, 0, :, :].astype(jnp.float32)
         v = v_ref[0, 0, :, :].astype(jnp.float32)
-        p, q, k = _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref,
-                               lse, q_start, k_start, scale=scale,
-                               causal=causal, window=window, block_q=block_q,
-                               block_k=block_k, qk_shift=qk_shift)
+        p, p_tilde, q, k = _recompute_p(
+            q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
+            lse, q_start, k_start, bi, h_idx, scale=scale,
+            causal=causal, window=window, block_q=block_q,
+            block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_tilde, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale                  # [bq, bk]
+        ds = (p_tilde * dp - p * delta[:, None]) * scale        # [bq, bk]
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
@@ -377,8 +465,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
 
 
 
-def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
-    (q, k, v, o, lse, q_segment_ids, kv_segment_ids, alibi_slopes) = res
+def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0,
+         dropout_p=0.0):
+    (q, k, v, o, lse, q_segment_ids, kv_segment_ids, alibi_slopes,
+     meta) = res
     b, hq, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = hq // hk
@@ -386,6 +476,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
     nk = pl.cdiv(sk, block_k)
     has_seg = q_segment_ids is not None
     has_alibi = alibi_slopes is not None
+    has_meta = meta is not None
 
     # delta = rowsum(do * o); lane-broadcast alongside lse for the kernels
     delta = jnp.einsum("bhqd,bhqd->bhq", do.astype(jnp.float32),
@@ -394,7 +485,8 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
     delta4 = jnp.broadcast_to(delta[..., None], (b, hq, sq, _LANES))
 
     common = dict(scale=scale, causal=causal, window=window,
-                  block_q=block_q, block_k=block_k, qk_shift=qk_shift)
+                  block_q=block_q, block_k=block_k, qk_shift=qk_shift,
+                  dropout_p=dropout_p)
 
     if has_seg:
         qseg = jax.lax.broadcast_in_dim(
@@ -423,6 +515,9 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
         in_specs.append(pl.BlockSpec((1, _SUBLANES, _LANES),
                                      lambda b_, h, qi, ki: (h, 0, 0)))
         args.append(_alibi_operand(alibi_slopes))
+    if has_meta:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(meta)
     in_specs += [
         pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
         pl.BlockSpec((1, 1, block_q, _LANES),
@@ -432,7 +527,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
     ]
     args += [do, lse4, delta4]
     dq = pl.pallas_call(
-        _mk_kernel(_bwd_dq_kernel, has_seg, has_alibi,
+        _mk_kernel(_bwd_dq_kernel, has_seg, has_alibi, has_meta,
                    num_kv_blocks=nk, **common),
         grid=(b, hq, nq, nk),
         in_specs=in_specs,
@@ -470,6 +565,9 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
             (1, _SUBLANES, _LANES),
             lambda b_, hkv, ki, g, qi: (hkv * group + g, 0, 0)))
         args.append(_alibi_operand(alibi_slopes))
+    if has_meta:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(meta)
     in_specs += [
         pl.BlockSpec((1, 1, block_q, d),
                      lambda b_, hkv, ki, g, qi: (b_, hkv * group + g, qi, 0)),
@@ -480,7 +578,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
     ]
     args += [do, lse4, delta4]
     dk, dv = pl.pallas_call(
-        _mk_kernel(_bwd_dkv_kernel, has_seg, has_alibi,
+        _mk_kernel(_bwd_dkv_kernel, has_seg, has_alibi, has_meta,
                    num_q_blocks=nq, group=group, **common),
         grid=(b, hk, nk, group, nq),
         in_specs=in_specs,
@@ -503,7 +601,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0):
                                  "arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*args)
-    return (dq, dk, dv, None, None, None)
+    return (dq, dk, dv, None, None, None, None)
 
 
 # ---------------------------------------------------------------------------
@@ -520,28 +618,49 @@ def _pad_seq(x, block, axis, value=0):
     return jnp.pad(x, pad, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
-           scale, causal, window, block_q, block_k, qk_shift):
-    o, _ = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
-                scale, causal, window, block_q, block_k, qk_shift)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
+           scale, causal, window, block_q, block_k, qk_shift, dropout_p):
+    o, _ = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
+                scale, causal, window, block_q, block_k, qk_shift, dropout_p)
     return o
 
 
-def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
-               scale, causal, window, block_q, block_k, qk_shift):
-    o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
-                  scale, causal, window, block_q, block_k, qk_shift)
+def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
+               scale, causal, window, block_q, block_k, qk_shift, dropout_p):
+    o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
+                  scale, causal, window, block_q, block_k, qk_shift,
+                  dropout_p)
     return o, (q, k, v, o, lse, q_segment_ids, kv_segment_ids,
-               alibi_slopes)
+               alibi_slopes, meta)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, qk_shift, res, g):
+def _flash_bwd(scale, causal, window, block_q, block_k, qk_shift, dropout_p,
+               res, g):
     return _bwd(res, g, scale=scale, causal=causal, window=window,
-                block_q=block_q, block_k=block_k, qk_shift=qk_shift)
+                block_q=block_q, block_k=block_k, qk_shift=qk_shift,
+                dropout_p=dropout_p)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _make_meta(dropout_p, dropout_seed, q_offset, k_offset,
+               h_offset=0, b_offset=0):
+    """int32 [5] (seed, q_off, k_off, h_off, b_off) — or None when every
+    feature that needs it is off, keeping the plain kernel signature
+    unchanged.  h/b offsets are the GLOBAL head/batch indices of local
+    row 0: under tensor/sequence/data parallelism they decorrelate the
+    dropout hash across shards (and make CP bit-match single-device)."""
+    static_off = all(isinstance(x, int) and x == 0
+                     for x in (q_offset, k_offset, h_offset, b_offset))
+    if dropout_p == 0.0 and static_off:
+        return None
+    seed = 0 if dropout_seed is None else dropout_seed
+    return jnp.stack([
+        jnp.asarray(x, jnp.int32).reshape(())
+        for x in (seed, q_offset, k_offset, h_offset, b_offset)
+    ])
 
 
 def flash_attention(
@@ -555,6 +674,12 @@ def flash_attention(
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     alibi_slopes: Optional[jax.Array] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
+    q_offset=0,
+    k_offset=0,
+    h_offset=0,
+    b_offset=0,
     return_lse: bool = False,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
@@ -563,6 +688,14 @@ def flash_attention(
 
     ``alibi_slopes``: [num_q_heads] f32 per-head ALiBi slopes (additive
     -slope*|i-j| bias, reference ops/flash_attn.py:411-413).
+    ``dropout_p``/``dropout_seed``: attention dropout on the post-softmax
+    probabilities (reference ops/flash_attn.py:418-423) via the stateless
+    coordinate hash in ops/_common.py — same seed, same mask, on every
+    backend.  ``q_offset``/``k_offset``: GLOBAL positions of this q/kv
+    chunk (traced ints allowed; used by the context-parallel ring so
+    causality, windows, ALiBi and dropout see global geometry).
+    ``h_offset``/``b_offset``: global head/batch index of local row 0
+    (decorrelates the dropout hash across tp/dp shards inside shard_map).
     With ``return_lse`` returns (out, lse[b, h, s]); that path is
     forward-only (used by the context-parallel ring, which defines its
     own VJP around the merged result).
@@ -606,14 +739,16 @@ def flash_attention(
     q = _pad_seq(q, block_q, 1).swapaxes(1, 2)   # -> BHSD
     k = _pad_seq(k, block_k, 1).swapaxes(1, 2)
     v = _pad_seq(v, block_k, 1).swapaxes(1, 2)
+    meta = _make_meta(dropout_p, dropout_seed, q_offset, k_offset,
+                      h_offset, b_offset)
 
     if return_lse:
         o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
-                      scale, causal, window, block_q, block_k,
-                      qk_shift=sk - sq)
+                      meta, scale, causal, window, block_q, block_k,
+                      qk_shift=sk - sq, dropout_p=dropout_p)
         return o.swapaxes(1, 2)[:, :sq], lse[:, :, :sq]
-    o = _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
-               scale, causal, window, block_q, block_k, sk - sq)
+    o = _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
+               scale, causal, window, block_q, block_k, sk - sq, dropout_p)
     return o.swapaxes(1, 2)[:, :sq]
 
 
@@ -631,6 +766,12 @@ def flash_attention_bwd(
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     alibi_slopes: Optional[jax.Array] = None,
+    dropout_p: float = 0.0,
+    dropout_seed=None,
+    q_offset=0,
+    k_offset=0,
+    h_offset=0,
+    b_offset=0,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -639,7 +780,9 @@ def flash_attention_bwd(
     BSHD in/out; lse is [b, h, sq].  Exposed for context-parallel ring
     attention, whose custom VJP evaluates each ring step's backward with
     the GLOBAL lse/o (the exact decomposition the reference implements at
-    ring_attn.py:130-271 with reverse kv rotation).
+    ring_attn.py:130-271 with reverse kv rotation).  Dropout/offset
+    arguments follow :func:`flash_attention` — pass the SAME values the
+    forward used so the regenerated dropout mask matches exactly.
     """
     b, sq, hq, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
@@ -664,11 +807,14 @@ def flash_attention_bwd(
     doT = _pad_seq(do, block_q, 1).swapaxes(1, 2)
     lseP = _pad_seq(lse, block_q, 2)
 
+    meta = _make_meta(dropout_p, dropout_seed, q_offset, k_offset,
+                      h_offset, b_offset)
     res = (qT, kT, vT, oT, lseP, q_segment_ids, kv_segment_ids,
-           alibi_slopes)
-    dq, dk, dv, _, _, _ = _bwd(res, doT, scale=scale, causal=causal,
-                               window=window, block_q=block_q,
-                               block_k=block_k, qk_shift=sk - sq)
+           alibi_slopes, meta)
+    dq, dk, dv, _, _, _, _ = _bwd(res, doT, scale=scale, causal=causal,
+                                  window=window, block_q=block_q,
+                                  block_k=block_k, qk_shift=sk - sq,
+                                  dropout_p=dropout_p)
     return (dq.swapaxes(1, 2)[:, :sq], dk.swapaxes(1, 2)[:, :sk],
             dv.swapaxes(1, 2)[:, :sk])
 

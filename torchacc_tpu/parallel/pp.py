@@ -145,3 +145,306 @@ def pipeline_blocks(
         axis_names=frozenset({pp_axis}),
     )(staged, *micro)
     return out.astype(x.dtype)
+
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDreamFlush) schedule
+# ---------------------------------------------------------------------------
+
+def pipeline_train_1f1b(
+    apply_block: Callable[[Any, Tuple], Tuple],
+    head_loss: Callable[[Any, jax.Array, jax.Array], Tuple[jax.Array, jax.Array]],
+    stacked_params: Any,
+    head_params: Any,
+    carry_in: Tuple[jax.Array, ...],
+    labels: jax.Array,
+    *,
+    pp_size: int,
+    num_micro: int,
+    pp_axis: str = "pp",
+    mesh: Optional[Mesh] = None,
+    remat_policy: Optional[Any] = None,
+):
+    """One-forward-one-backward pipeline TRAIN step (loss + grads).
+
+    TPU-native redesign of the reference's PipeDreamFlushTrain schedule
+    (pp/schedule.py:156-227: warmup of ``stages - stage_id`` forwards,
+    1F1B steady state, cooldown backwards, buffer count
+    ``min(stages - stage_id, micro_batches)``).  XLA autodiff owns
+    backward ordering, so the memory-shaped schedule cannot be expressed
+    through jax.grad of a GPipe loop; instead the whole stacked-layer
+    train step runs here with forward AND backward interleaved by hand:
+
+      tick t, device me:  F of micro  f = t - me            (if 0<=f<M)
+                          B of micro  b = t - 2(P-1) + me   (if 0<=b<M)
+
+    over T = M + 2(P-1) lockstep ticks.  The last stage owns final-norm +
+    head + loss (``head_loss``), so a micro-batch's backward begins the
+    same tick its forward ends — the defining 1F1B property.  Each device
+    keeps a residual ring of only min(2(P-1-me)+1, M) stage inputs (vs
+    all M+P-1 scan carries for GPipe-under-autodiff) and re-runs its
+    stage under ``jax.vjp`` in the B sub-tick (per-stage remat, the same
+    recompute GPipe needs anyway).  Activations ppermute forward and
+    cotangents ppermute backward once per tick; idle sub-ticks are real
+    ``lax.cond`` skips, not masked compute.
+
+    Returns ``(loss_sum, count), (d_stacked, d_head, d_x)`` where d_x is
+    the cotangent of ``carry_in[0]``.  Use :func:`pipeline_loss_1f1b`
+    for a differentiable loss.
+    """
+    mesh = mesh or _ambient_mesh()
+    x = carry_in[0]
+    B = x.shape[0]
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if B % num_micro:
+        raise ValueError(f"batch {B} not divisible by num_micro_batches "
+                         f"{num_micro}")
+    if L % pp_size:
+        raise ValueError(f"num_layers {L} not divisible by pp size {pp_size}")
+    per_stage = L // pp_size
+    M, Pn = num_micro, pp_size
+    mb = B // M
+    T = M + 2 * (Pn - 1)
+    S = min(2 * (Pn - 1) + 1, M)          # residual ring slots
+
+    staged = jax.tree.map(
+        lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), stacked_params)
+    compute_dtype = x.dtype
+    # f32 at the shard_map boundary (see pipeline_blocks note)
+    carry_in_f = (x.astype(jnp.float32),) + tuple(carry_in[1:])
+    micro = tuple(jax.tree.map(
+        lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in_f)
+    labels_micro = labels.reshape((M, mb) + labels.shape[1:])
+
+    param_spec = jax.tree.map(lambda _: P(pp_axis), staged)
+    data_spec = tuple(P() for _ in micro)
+    head_spec = jax.tree.map(lambda _: P(), head_params)
+
+    def region(params_local, head_p, labels_m, *micro_local):
+        params_me = jax.tree.map(lambda a: a[0], params_local)  # [L/P, ...]
+        me = jax.lax.axis_index(pp_axis)
+
+        def stage(p, carry):
+            def one(c, pl):
+                return apply_block(pl, c), None
+            return jax.lax.scan(one, carry, p)[0]
+
+        def stage_remat(p, carry):
+            # B sub-tick: per-LAYER remat, so the vjp's scan residuals
+            # are the small inter-layer carries, not every layer's
+            # attention internals stacked [L/P, ...] at once (that stack
+            # is what would erase 1F1B's memory win)
+            def one(c, pl):
+                return apply_block(pl, c), None
+            body = jax.checkpoint(one, policy=remat_policy,
+                                  prevent_cse=False)
+            return jax.lax.scan(body, carry, p)[0]
+
+        def _pad_to_T(c):
+            return jax.tree.map(
+                lambda a: jnp.concatenate(
+                    [a, jnp.zeros((T - a.shape[0],) + a.shape[1:],
+                                  a.dtype)], 0), c)
+
+        feed = tuple(_pad_to_T(c) for c in micro_local)         # F feed @ t
+        # labels consumed by the last stage at t = m + (P-1)
+        lab_feed = jnp.concatenate([
+            jnp.zeros((Pn - 1,) + labels_m.shape[1:], labels_m.dtype),
+            labels_m,
+            jnp.zeros((T - M - (Pn - 1),) + labels_m.shape[1:],
+                      labels_m.dtype)], 0)
+
+        zero_mb = tuple(jax.tree.map(
+            lambda a: jnp.zeros(a.shape[1:], a.dtype), c)
+            for c in micro_local)
+        x_zero = zero_mb[0]                                     # f32 [mb,...]
+
+        ring0 = jax.tree.map(
+            lambda a: jnp.zeros((S,) + a.shape, a.dtype), zero_mb)
+        dp0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                           params_me)
+        dhead0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                              head_p)
+        dx_bank0 = jnp.zeros((M,) + x_zero.shape, jnp.float32)
+        zero_head = lambda: jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), head_p)
+
+        def body(state, xs):
+            (f_hand, b_hand, ring_buf, dp, dhead, dx_bank,
+             loss_sum, count) = state
+            t, lab_t, fed = xs
+            f_idx = t - me
+            b_idx = t - 2 * (Pn - 1) + me
+            f_on = jnp.logical_and(f_idx >= 0, f_idx < M)
+            b_on = jnp.logical_and(b_idx >= 0, b_idx < M)
+
+            # F input: stage 0 ingests the feed, others the handoff
+            x_in = jax.tree.map(
+                lambda f, h: jnp.where(me == 0, f, h), fed, f_hand)
+
+            # ---- F sub-tick (head+loss fused on the last stage) ----
+            def do_f(_):
+                cin = (x_in[0].astype(compute_dtype),) + tuple(x_in[1:])
+                y = stage(params_me, cin)[0].astype(jnp.float32)
+
+                def last(_):
+                    (ls, cnt), hvjp = jax.vjp(
+                        lambda hp, yl: head_loss(
+                            hp, yl.astype(compute_dtype), lab_t),
+                        head_p, y)
+                    dhp, dy = hvjp((jnp.ones((), jnp.float32),
+                                    jnp.zeros((), jnp.float32)))
+                    return (ls, cnt,
+                            jax.tree.map(lambda a: a.astype(jnp.float32),
+                                         dhp),
+                            dy.astype(jnp.float32))
+
+                def mid(_):
+                    return (jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.float32), zero_head(),
+                            jnp.zeros_like(y))
+
+                ls, cnt, dhp, dy = jax.lax.cond(me == Pn - 1, last, mid,
+                                                None)
+                return y, ls, cnt, dhp, dy
+
+            def no_f(_):
+                return (jnp.zeros_like(x_in[0]), jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.float32), zero_head(),
+                        jnp.zeros_like(x_in[0]))
+
+            y, ls, cnt, dhp, dy_last = jax.lax.cond(f_on, do_f, no_f, None)
+            loss_sum = loss_sum + ls
+            count = count + cnt
+            dhead = jax.tree.map(jnp.add, dhead, dhp)
+
+            # bank this F's input (activation + riders) for its backward
+            slot_f = jnp.maximum(f_idx, 0) % S
+            ring_buf = jax.tree.map(
+                lambda r, v: jnp.where(
+                    f_on,
+                    jax.lax.dynamic_update_index_in_dim(r, v, slot_f, 0),
+                    r),
+                ring_buf, tuple(x_in))
+
+            # ---- B sub-tick (stage recompute under vjp) ----
+            slot_b = jnp.maximum(b_idx, 0) % S
+            saved = jax.tree.map(
+                lambda r: jax.lax.dynamic_index_in_dim(
+                    r, slot_b, 0, keepdims=False), ring_buf)
+            dy_in = jnp.where(me == Pn - 1, dy_last, b_hand)
+            # sequence B strictly after F (1F *then* 1B, like the
+            # reference's per-cycle ordering) so the two sub-ticks'
+            # working sets never coexist — without this barrier XLA may
+            # overlap them and double the in-tick peak
+            y, dy_in = jax.lax.optimization_barrier((y, dy_in))
+
+            def do_b(_):
+                riders = tuple(saved[1:])
+
+                def f_of(p, xact):
+                    cin = (xact.astype(compute_dtype),) + riders
+                    return stage_remat(p, cin)[0].astype(jnp.float32)
+
+                _, vjp = jax.vjp(f_of, params_me, saved[0])
+                dpl, dxl = vjp(dy_in)
+                return (jax.tree.map(lambda a: a.astype(jnp.float32), dpl),
+                        dxl.astype(jnp.float32))
+
+            def no_b(_):
+                return (jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, jnp.float32), params_me),
+                    jnp.zeros(x_zero.shape, jnp.float32))
+
+            dpl, dxl = jax.lax.cond(b_on, do_b, no_b, None)
+            dp = jax.tree.map(jnp.add, dp, dpl)
+
+            # stage 0's dx is the pipeline's input cotangent for micro b
+            dx_bank = jnp.where(
+                jnp.logical_and(b_on, me == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dx_bank, dxl, jnp.maximum(b_idx, 0), 0),
+                dx_bank)
+
+            # ---- handoffs: activations forward, cotangents backward ----
+            f_next = jax.tree.map(
+                lambda a: jax.lax.ppermute(
+                    a, pp_axis, [(j, (j + 1) % Pn) for j in range(Pn)]),
+                (y,) + tuple(x_in[1:]))
+            b_next = jax.lax.ppermute(
+                dxl, pp_axis, [(j, (j - 1) % Pn) for j in range(Pn)])
+
+            return (f_next, b_next, ring_buf, dp, dhead, dx_bank,
+                    loss_sum, count), None
+
+        init = (tuple(zero_mb),
+                jnp.zeros(x_zero.shape, jnp.float32),
+                ring0, dp0, dhead0, dx_bank0,
+                jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+        (_, _, _, dp, dhead, dx_bank, loss_sum, count), _ = jax.lax.scan(
+            body, init, (jnp.arange(T), lab_feed, feed))
+
+        loss_sum = jax.lax.psum(loss_sum, pp_axis)
+        count = jax.lax.psum(count, pp_axis)
+        dhead = jax.tree.map(lambda a: jax.lax.psum(a, pp_axis), dhead)
+        dx_all = jax.lax.psum(dx_bank, pp_axis)  # only stage 0 wrote
+        # [L/P, ...] local grads -> [1, L/P, ...]; the 'pp' out spec
+        # reassembles the stacked [P, L/P, ...] layout
+        dp_out = jax.tree.map(lambda a: a[None], dp)
+        return loss_sum, count, dp_out, dhead, dx_all
+
+    out_specs = (P(), P(),
+                 jax.tree.map(lambda _: P(pp_axis), staged),
+                 jax.tree.map(lambda _: P(), head_params),
+                 P())
+    loss_sum, count, dstaged, dhead, dx_micro = jax.shard_map(
+        region, mesh=mesh,
+        in_specs=(param_spec, head_spec, P()) + data_spec,
+        out_specs=out_specs,
+        check_vma=False,
+        axis_names=frozenset({pp_axis}),
+    )(staged, head_params, labels_micro, *micro)
+
+    # cotangent dtypes must match the primals' (custom_vjp contract)
+    d_stacked = jax.tree.map(
+        lambda a, ref: a.reshape((L,) + a.shape[2:]).astype(ref.dtype),
+        dstaged, stacked_params)
+    dhead = jax.tree.map(lambda a, ref: a.astype(ref.dtype), dhead,
+                         head_params)
+    dx = dx_micro.reshape((B,) + dx_micro.shape[2:]).astype(x.dtype)
+    return (loss_sum, count), (d_stacked, dhead, dx)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 7, 8, 9))
+def pipeline_loss_1f1b(apply_block, head_loss, stacked_params, head_params,
+                       x, riders, labels, pp_size, num_micro, pp_axis="pp"):
+    """Differentiable (loss_sum, count) via the 1F1B schedule: the
+    schedule already computed the grads during the forward, so the VJP
+    just scales them by the loss cotangent (they are linear in it).
+    ``riders`` (positions, segment ids, ...) are non-differentiable."""
+    (loss_sum, count), _ = pipeline_train_1f1b(
+        apply_block, head_loss, stacked_params, head_params,
+        (x,) + tuple(riders), labels, pp_size=pp_size,
+        num_micro=num_micro, pp_axis=pp_axis)
+    return loss_sum, count
+
+
+def _pl1f1b_fwd(apply_block, head_loss, stacked_params, head_params,
+                x, riders, labels, pp_size, num_micro, pp_axis="pp"):
+    (loss_sum, count), grads = pipeline_train_1f1b(
+        apply_block, head_loss, stacked_params, head_params,
+        (x,) + tuple(riders), labels, pp_size=pp_size,
+        num_micro=num_micro, pp_axis=pp_axis)
+    return (loss_sum, count), grads
+
+
+def _pl1f1b_bwd(apply_block, head_loss, pp_size, num_micro, pp_axis,
+                res, ct):
+    d_stacked, dhead, dx = res
+    dls = ct[0]  # count is parameter-independent
+    scale = lambda tree: jax.tree.map(
+        lambda a: a * dls.astype(a.dtype), tree)
+    return (scale(d_stacked), scale(dhead), dx * dls.astype(dx.dtype),
+            None, None)
+
+
+pipeline_loss_1f1b.defvjp(_pl1f1b_fwd, _pl1f1b_bwd)

@@ -83,6 +83,7 @@ class Trainer:
         self._axes_rules = axes_rules
         # loss(logits, batch) -> scalar mean OR (sum, valid_count); the
         # sum/count form gives exact big-batch equivalence under grad accum.
+        self._custom_loss = loss is not None
         self.loss = loss or (lambda logits, batch: loss_sum_count(
             logits, batch.get("labels", shift_labels(
                 batch["input_ids"], batch.get("segment_ids")))))
@@ -163,9 +164,40 @@ class Trainer:
         return self.state
 
     # -- train step ---------------------------------------------------------
-    def _forward_sum_count(self, params, batch):
+    @property
+    def _attn_dropout_on(self) -> bool:
+        mc = getattr(self.model, "cfg", None)
+        return (bool(getattr(mc, "attn_dropout", 0.0))
+                and not self.config.compute.deterministic)
+
+    def _forward_sum_count(self, params, batch, dropout_seed=None):
         """(loss_sum, token_count) incl. sown auxiliary losses (MoE router
-        load-balance — models/moe.py) weighted per token."""
+        load-balance — models/moe.py) weighted per token.
+
+        ``dropout_seed`` is passed only on train steps of zoo models with
+        attn_dropout configured — eval/inference stays deterministic."""
+        pp = self.config.dist.pp
+        if (pp.size > 1 and pp.schedule == "1f1b"
+                and hasattr(self.model, "cfg")):
+            # 1F1B fuses head+loss into the last pipeline stage, so the
+            # whole forward+loss goes through the schedule (the GPipe
+            # path below instead autodiffs through model.apply)
+            if self._custom_loss:
+                raise ValueError(
+                    "pp.schedule='1f1b' fuses the built-in CE loss into "
+                    "the last pipeline stage; a custom Trainer loss is "
+                    "not applied there — use the gpipe schedule")
+            from torchacc_tpu.models.transformer import (
+                pp_1f1b_forward_sum_count,
+            )
+            return pp_1f1b_forward_sum_count(
+                self.model.cfg, params, batch["input_ids"],
+                positions=batch.get("positions"),
+                segment_ids=batch.get("segment_ids"),
+                labels=batch.get("labels"))
+        extra = {}
+        if dropout_seed is not None and self._attn_dropout_on:
+            extra["dropout_seed"] = dropout_seed
         if self._use_fused_ce:
             from torchacc_tpu.ops.fused import fused_linear_cross_entropy
             hidden, mutated = self.model.apply(
@@ -173,7 +205,7 @@ class Trainer:
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
                 return_hidden=True,
-                mutable=["intermediates"])
+                mutable=["intermediates"], **extra)
             if "lm_head" in params:
                 w_head = params["lm_head"]["kernel"]
             else:  # tied embeddings
@@ -187,7 +219,7 @@ class Trainer:
                 {"params": params}, batch["input_ids"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
-                mutable=["intermediates"])
+                mutable=["intermediates"], **extra)
             logits, mutated = out
             res = self.loss(logits, batch)
             if isinstance(res, tuple):
@@ -204,10 +236,22 @@ class Trainer:
     def _build_train_step(self, sample_batch):
         accum = self.config.grad_accum
         optimizer = self.optimizer
-        fsc = self._forward_sum_count
         use_scaler = self.config.compute.dtype == "float16"
+        dropout_on = self._attn_dropout_on
+        base_fsc = self._forward_sum_count
 
         def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+            # train steps supply a per-step dropout seed (step * accum,
+            # deterministic given the checkpointed step, advanced per
+            # accumulation micro-step below so every forward draws a
+            # fresh mask); eval/inference never passes one
+            if dropout_on:
+                step_seed = state.step.astype(jnp.int32) * accum
+                fsc = lambda p, b, s=None: base_fsc(
+                    p, b, dropout_seed=step_seed if s is None
+                    else step_seed + s)
+            else:
+                fsc = lambda p, b, s=None: base_fsc(p, b)
             # fp16: scale the loss so small grads survive the fp16 range
             # (reference GradScaler core/amp.py; here fully in-jit)
             scale = (state.scaler["scale"] if use_scaler
@@ -218,15 +262,16 @@ class Trainer:
                     raise ValueError(
                         f"batch size {bsz} not divisible by grad_accum {accum}")
 
-                def scaled_sum(p, mb):
-                    l, c = fsc(p, mb)
+                def scaled_sum(p, mb, mi):
+                    l, c = fsc(p, mb, mi)
                     return l * scale, c
 
                 grad_sum = jax.value_and_grad(scaled_sum, has_aux=True)
 
-                def micro(carry, mb):
+                def micro(carry, xs):
+                    mb, mi = xs
                     g_acc, l_acc, c_acc = carry
-                    (l, c), g = grad_sum(state.params, mb)
+                    (l, c), g = grad_sum(state.params, mb, mi)
                     return (jax.tree.map(jnp.add, g_acc, g),
                             l_acc + l, c_acc + c), None
                 def to_micro(x):
@@ -240,7 +285,8 @@ class Trainer:
                     lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
                 (grads, loss_sum, count), _ = jax.lax.scan(
                     micro, (zeros, jnp.zeros((), jnp.float32),
-                            jnp.zeros((), jnp.float32)), mbs)
+                            jnp.zeros((), jnp.float32)),
+                    (mbs, jnp.arange(accum, dtype=jnp.int32)))
                 denom = jnp.maximum(count, 1.0) * scale
                 grads = jax.tree.map(lambda g: g / denom, grads)
                 loss_val = loss_sum / denom

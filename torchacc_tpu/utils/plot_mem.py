@@ -1,0 +1,273 @@
+"""Per-buffer memory-lifetime visualisation from XLA buffer assignment.
+
+Reference: ``tools/plot_mem.py`` (1-340) parses a torch_xla buffer-
+assignment dump and renders every buffer's live range with the peak
+annotated.  TPU-native equivalent: XLA writes the same information for
+any jitted program when dumping is enabled —
+
+    XLA_FLAGS="--xla_dump_to=DIR --xla_dump_hlo_as_text" python train.py
+
+produces ``module_*.jit_<name>.*buffer-assignment.txt`` (allocations,
+logical values, uses) and ``module_*.jit_<name>.*after_optimizations.txt``
+(the scheduled HLO, giving instruction order = the time axis).  This
+module parses both and renders the reference-style plot:
+
+  - each temp/output allocation drawn as a rectangle spanning
+    [first definition, last use] in instruction order, stacked on a
+    bytes axis, colored by kind;
+  - the live-bytes step curve with the peak annotated;
+  - parameters shown as the always-live baseline.
+
+CLI::
+
+    python -m torchacc_tpu.utils.plot_mem DUMP_DIR -o mem.png
+    python -m torchacc_tpu.utils.plot_mem DUMP_DIR --module train_step
+
+Parsing is defensive: anything unrecognised degrades to "no lifetime"
+(bar spanning the whole program) rather than an error, so the tool keeps
+working across XLA dump-format drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Alloc:
+    index: int
+    size: int
+    kind: str                      # 'parameter' | 'temp' | 'output' | 'constant'
+    values: List[str]              # logical value instruction names
+    start: Optional[int] = None    # instruction-order live range
+    end: Optional[int] = None
+
+
+_ALLOC_RE = re.compile(r"^allocation (\d+): size (\d+), (.*):$")
+_VALUE_RE = re.compile(r"^\s+value: <\d+ ([^ ]+) @\d+>")
+_USED_VALUE_RE = re.compile(r"^<\d+ ([^ ]+) @\d+>")
+_HLO_INSTR_RE = re.compile(r"^\s+(?:ROOT\s+)?%([^ ]+) = ")
+
+
+def _alloc_kind(desc: str) -> str:
+    if "parameter" in desc:
+        return "parameter"
+    if "constant" in desc:
+        return "constant"
+    if "temp" in desc:
+        return "temp"
+    if "output" in desc or "live-out" in desc:
+        return "output"
+    return "temp"
+
+
+def parse_buffer_assignment(text: str) -> List[Alloc]:
+    """Allocations with sizes, kinds, and their logical values."""
+    allocs: List[Alloc] = []
+    cur: Optional[Alloc] = None
+    for line in text.splitlines():
+        m = _ALLOC_RE.match(line)
+        if m:
+            cur = Alloc(index=int(m.group(1)), size=int(m.group(2)),
+                        kind=_alloc_kind(m.group(3)), values=[])
+            allocs.append(cur)
+            continue
+        if cur is not None:
+            mv = _VALUE_RE.match(line)
+            if mv:
+                cur.values.append(mv.group(1))
+            elif line and not line.startswith(" "):
+                cur = None  # left the allocation block
+    return allocs
+
+
+def parse_uses(text: str) -> Dict[str, List[str]]:
+    """'Used values' section: value instruction name -> using instructions."""
+    uses: Dict[str, List[str]] = {}
+    cur: Optional[str] = None
+    mode = None
+    for line in text.splitlines():
+        m = _USED_VALUE_RE.match(line)
+        if m:
+            cur = m.group(1)
+            uses[cur] = []
+            mode = None
+            continue
+        s = line.strip()
+        if s == "uses:":
+            mode = "uses"
+            continue
+        if s in ("positions:",) or s.startswith("from instruction"):
+            mode = None
+            continue
+        if cur is not None and mode == "uses" and s:
+            # e.g. "dot, operand 0" / "fusion, operand 1"
+            uses[cur].append(s.split(",")[0].strip())
+    return uses
+
+
+def parse_hlo_order(text: str) -> Dict[str, int]:
+    """Instruction name -> position in the (scheduled) HLO text."""
+    order: Dict[str, int] = {}
+    i = 0
+    for line in text.splitlines():
+        m = _HLO_INSTR_RE.match(line)
+        if m:
+            name = m.group(1)
+            if name not in order:
+                order[name] = i
+                i += 1
+    return order
+
+
+def assign_lifetimes(allocs: List[Alloc], uses: Dict[str, List[str]],
+                     order: Dict[str, int]) -> int:
+    """Fill start/end from instruction order; returns program length."""
+    n = max(order.values(), default=0) + 1
+    for a in allocs:
+        if a.kind == "parameter":
+            a.start, a.end = 0, n - 1
+            continue
+        starts, ends = [], []
+        for v in a.values:
+            if v in order:
+                starts.append(order[v])
+                ends.append(order[v])
+            for u in uses.get(v, []):
+                if u in order:
+                    ends.append(order[u])
+        a.start = min(starts) if starts else 0
+        a.end = max(ends) if ends else n - 1
+    return n
+
+
+def find_dump_files(path: str, module: Optional[str] = None
+                    ) -> Tuple[str, Optional[str]]:
+    """(buffer_assignment_path, hlo_path) — largest matching module wins."""
+    if os.path.isfile(path):
+        hlo = path.replace("-buffer-assignment", "")
+        return path, hlo if os.path.isfile(hlo) and hlo != path else None
+    cands = [f for f in os.listdir(path) if "buffer-assignment" in f]
+    if module:
+        cands = [f for f in cands if module in f]
+    if not cands:
+        raise FileNotFoundError(
+            f"no *buffer-assignment* file under {path!r}"
+            + (f" matching {module!r}" if module else "")
+            + " — run with XLA_FLAGS='--xla_dump_to=DIR "
+              "--xla_dump_hlo_as_text'")
+    best = max(cands, key=lambda f: os.path.getsize(os.path.join(path, f)))
+    hlo = os.path.join(path, best.replace("-buffer-assignment", ""))
+    return (os.path.join(path, best),
+            hlo if os.path.isfile(hlo) else None)
+
+
+def summarize(allocs: List[Alloc]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for a in allocs:
+        out[a.kind] = out.get(a.kind, 0) + a.size
+    out["total"] = sum(a.size for a in allocs)
+    return out
+
+
+def live_curve(allocs: List[Alloc], n: int) -> List[int]:
+    """Live bytes at each instruction position (temp/output only)."""
+    delta = [0] * (n + 1)
+    for a in allocs:
+        if a.kind == "parameter" or a.start is None:
+            continue
+        delta[a.start] += a.size
+        delta[min(a.end, n - 1) + 1] -= a.size
+    curve, cur = [], 0
+    for d in delta[:n]:
+        cur += d
+        curve.append(cur)
+    return curve
+
+
+def render(allocs: List[Alloc], n: int, out_path: str,
+           title: str = "XLA buffer lifetimes") -> None:
+    """Reference-style plot: lifetime rectangles + live-bytes curve."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from matplotlib.patches import Rectangle
+
+    colors = {"parameter": "#9aa7b5", "constant": "#c4b391",
+              "temp": "#4f81bd", "output": "#5aa469"}
+    fig, (ax, ax2) = plt.subplots(
+        2, 1, figsize=(12, 8), sharex=True,
+        gridspec_kw={"height_ratios": [3, 1]})
+
+    base = sum(a.size for a in allocs if a.kind == "parameter")
+    y = base
+    shown = [a for a in allocs if a.kind != "parameter" and a.size > 0]
+    shown.sort(key=lambda a: (a.start or 0, -(a.size)))
+    for a in shown:
+        w = max((a.end or n - 1) - (a.start or 0), 1)
+        ax.add_patch(Rectangle(((a.start or 0), y), w, a.size,
+                               facecolor=colors.get(a.kind, "#999999"),
+                               edgecolor="white", linewidth=0.3,
+                               alpha=0.85))
+        y += a.size
+    if base:
+        ax.add_patch(Rectangle((0, 0), n, base, facecolor=colors["parameter"],
+                               alpha=0.5, edgecolor="none"))
+        ax.text(n * 0.01, base / 2, f"parameters {base/2**20:.1f} MiB",
+                va="center", fontsize=8)
+    ax.set_xlim(0, n)
+    ax.set_ylim(0, y * 1.05 if y else 1)
+    ax.set_ylabel("bytes (stacked by allocation)")
+    ax.set_title(title)
+
+    curve = live_curve(allocs, n)
+    peak = max(curve) if curve else 0
+    peak_at = curve.index(peak) if curve else 0
+    ax2.fill_between(range(n), curve, step="post", alpha=0.6,
+                     color="#4f81bd")
+    ax2.annotate(f"peak temp {peak/2**20:.1f} MiB",
+                 xy=(peak_at, peak), xytext=(min(peak_at + n * 0.05, n * 0.7),
+                                             peak),
+                 arrowprops=dict(arrowstyle="->"), fontsize=9)
+    ax2.set_xlabel("instruction (scheduled order)")
+    ax2.set_ylabel("live temp bytes")
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Plot per-buffer lifetimes from an XLA dump "
+                    "(reference tools/plot_mem.py equivalent)")
+    ap.add_argument("dump", help="dump dir or *buffer-assignment.txt file")
+    ap.add_argument("-o", "--out", default="mem.png")
+    ap.add_argument("--module", default=None,
+                    help="substring selecting the module (default: largest)")
+    args = ap.parse_args(argv)
+
+    ba_path, hlo_path = find_dump_files(args.dump, args.module)
+    text = open(ba_path).read()
+    allocs = parse_buffer_assignment(text)
+    uses = parse_uses(text)
+    order = parse_hlo_order(open(hlo_path).read()) if hlo_path else {}
+    n = assign_lifetimes(allocs, uses, order) if order else 1
+    s = summarize(allocs)
+    for k in ("parameter", "temp", "output", "constant"):
+        if k in s:
+            print(f"{k:>10}: {s[k]/2**20:10.2f} MiB")
+    print(f"{'total':>10}: {s['total']/2**20:10.2f} MiB  "
+          f"({len(allocs)} allocations; module {os.path.basename(ba_path)})")
+    render(allocs, max(n, 1), args.out,
+           title=os.path.basename(ba_path).split(".")[1])
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
