@@ -4,9 +4,12 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test bench lint dryrun
+.PHONY: test test-all bench lint dryrun
 
 test:
+	$(PYTEST) tests/ -q -m "not slow"
+
+test-all:
 	$(PYTEST) tests/ -q
 
 bench:

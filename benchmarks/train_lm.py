@@ -41,6 +41,8 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--pp_microbatches", type=int, default=None)
+    p.add_argument("--pp_schedule", default="gpipe",
+                   choices=["gpipe", "1f1b"])
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--sp_mode", default="ulysses",
                    choices=["ulysses", "ring", "2d"])
@@ -49,6 +51,10 @@ def parse_args(argv=None):
     # memory / numerics (reference: --gc/--fp16/--bf16)
     p.add_argument("--gc", action="store_true")
     p.add_argument("--gc_policy", default="nothing")
+    p.add_argument("--gc_cnt", type=int, default=None,
+                   help="remat only the first N layers")
+    p.add_argument("--offload_activations", action="store_true")
+    p.add_argument("--attn_dropout", type=float, default=0.0)
     p.add_argument("--fp16", action="store_true")
     p.add_argument("--fp32", action="store_true")
     p.add_argument("--no_flash", action="store_true")
@@ -66,14 +72,17 @@ def _config_from_flags(args, dtype):
     return ta.Config(
         compute=ta.ComputeConfig(dtype=dtype,
                                  flash_attention=not args.no_flash),
-        memory=ta.MemoryConfig(gc=args.gc, gc_policy=args.gc_policy),
+        memory=ta.MemoryConfig(gc=args.gc, gc_policy=args.gc_policy,
+                               gc_cnt=args.gc_cnt,
+                               offload_activations=args.offload_activations),
         dist=ta.DistConfig(
             dp=ta.DPConfig(size=args.dp),
             fsdp=ta.FSDPConfig(size=args.fsdp),
             tp=ta.TPConfig(size=args.tp),
             pp=ta.PPConfig(size=args.pp,
                            num_micro_batches=(args.pp_microbatches
-                                              or max(1, 2 * args.pp))),
+                                              or max(1, 2 * args.pp)),
+                           schedule=args.pp_schedule),
             sp=ta.SPConfig(size=args.sp, mode=args.sp_mode,
                            intra_size=args.sp_intra),
             ep=ta.EPConfig(size=args.ep),
@@ -102,7 +111,8 @@ def main(argv=None) -> int:
                  else ("float32" if args.fp32 else "bfloat16"))
         cfg = _config_from_flags(args, dtype)
 
-    mc = get_preset(args.model, max_seq_len=max(args.seq, 8))
+    mc = get_preset(args.model, max_seq_len=max(args.seq, 8),
+                    attn_dropout=args.attn_dropout)
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(args.lr))
     trainer.init()
 

@@ -95,10 +95,52 @@ def test_accuracy_parity_harness():
     import sys
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     r = subprocess.run(
         [sys.executable, os.path.join(root, "benchmarks",
                                       "accuracy_parity.py"), "--steps", "6"],
-        capture_output=True, text=True, timeout=480)
+        capture_output=True, text=True, timeout=480, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     verdict = json.loads(r.stdout.strip().splitlines()[-1])
     assert verdict["ok"] and verdict["max_rel_dev"] <= 0.02, verdict
+
+
+def test_hf_trainer_adapter(tmp_path, devices):
+    """The transformers.Trainer-shaped adapter (reference
+    accelerate_hf_trainer.py:21-78 analogue): an HF script's
+    model/args/dataset/collator train through the native Trainer."""
+    import torch.utils.data as tud
+
+    from torchacc_tpu.train import HFTrainerAdapter
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).float()
+
+    class Ds(tud.Dataset):
+        def __len__(self):
+            return 64
+        def __getitem__(self, i):
+            rng = np.random.default_rng(i)
+            ids = rng.integers(0, 128, 32).astype(np.int64)
+            return {"input_ids": ids, "labels": ids}
+
+    def collate(feats):
+        import torch
+        return {k: torch.tensor(np.stack([f[k] for f in feats]))
+                for k in feats[0]}
+
+    args = transformers.TrainingArguments(
+        output_dir=str(tmp_path / "out"), max_steps=3,
+        per_device_train_batch_size=2, learning_rate=1e-3,
+        logging_steps=1, save_steps=0, report_to=[])
+    tr = HFTrainerAdapter(model=hf_model, args=args, train_dataset=Ds(),
+                          eval_dataset=Ds(), data_collator=collate)
+    history = tr.train()
+    assert history and np.isfinite(history[-1]["loss"])
+    ev = tr.evaluate()
+    assert np.isfinite(ev["eval_loss"])
+    tr.save_model(str(tmp_path / "saved"))
+    assert (tmp_path / "saved").exists()

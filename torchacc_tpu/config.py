@@ -307,9 +307,10 @@ class DistConfig:
     def validate(self) -> None:
         for sub in (self.dp, self.tp, self.fsdp, self.pp, self.sp, self.ep):
             sub.validate()
-        _check(not (self.pp.size > 1 and self.sp.size > 1),
-               "pipeline parallelism composed with context parallelism is "
-               "not supported yet (nested shard_map regions)")
+        # PP×SP composes: the context-parallel attention opens its own
+        # shard_map over ('sp','spu') inside the pp-manual pipeline
+        # region (the reference composes CP orthogonally with the other
+        # strategies, init_group.py:42-91).  Tested pp×sp ≡ pp ≡ sp.
         _check(tuple(sorted(self.topology)) == tuple(sorted(MESH_AXES)),
                f"dist.topology must be a permutation of {MESH_AXES}, got {self.topology}")
         _check(self.num_slices >= 1, "dist.num_slices must be >= 1")

@@ -69,6 +69,7 @@ def cp_attention(
     data_axes: Tuple[str, ...] = ("dp", "fsdp"),
     tp_axis: str = "tp",
     impl: str = "auto",
+    check_vma: bool = False,
 ):
     """[b, s, h, d] attention with the sequence dim context-parallel over
     (ring_axis, a2a_axis).  Falls back to plain attention when both axes
@@ -147,9 +148,43 @@ def cp_attention(
     if has_seed:
         in_specs.append(P())
         args.append(jnp.asarray(dropout_seed, jnp.int32))
-    return jax.shard_map(
-        region, mesh=mesh,
-        in_specs=tuple(in_specs),
-        out_specs=qkv_spec,
-        check_vma=False,
-    )(*args)
+    in_specs = tuple(in_specs)
+
+    # The region is wrapped in a custom VJP whose backward opens a FRESH
+    # forward-only shard_map and differentiates the local computation
+    # inside it (jax.vjp of the per-shard function; the ring/ulysses
+    # collectives and the ring's own custom VJP transpose in-region).
+    # Rationale: letting autodiff transpose ACROSS the shard_map
+    # boundary mis-accumulates cotangents when this region is nested
+    # inside another manual region (the pp pipeline) — verified by
+    # pp×sp gradient divergence with the plain transpose path.  Cost:
+    # the backward re-runs the forward attention (the same price as the
+    # remat policies big-model configs already use).
+    @jax.custom_vjp
+    def core(q, k, v, *rest):
+        return jax.shard_map(
+            region, mesh=mesh, in_specs=in_specs,
+            out_specs=qkv_spec, check_vma=check_vma)(q, k, v, *rest)
+
+    def core_fwd(q, k, v, *rest):
+        return core(q, k, v, *rest), (q, k, v) + tuple(rest)
+
+    def core_bwd(res, do):
+        q, k, v = res[:3]
+        rest = res[3:]
+
+        def region_bwd(q_l, k_l, v_l, do_l, *rest_l):
+            def f(q_, k_, v_):
+                return region(q_, k_, v_, *rest_l)
+            _, vjpf = jax.vjp(f, q_l, k_l, v_l)
+            return vjpf(do_l)
+
+        dq, dk, dv = jax.shard_map(
+            region_bwd, mesh=mesh,
+            in_specs=in_specs[:3] + (qkv_spec,) + in_specs[3:],
+            out_specs=(qkv_spec, qkv_spec, qkv_spec),
+            check_vma=check_vma)(q, k, v, do, *rest)
+        return (dq, dk, dv) + tuple(None for _ in rest)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core(*args)

@@ -39,6 +39,15 @@ def _ambient_mesh():
     return None
 
 
+def _boundary_needs_f32(dtype) -> bool:
+    """True when the shard_map boundary must widen to f32: XLA:CPU
+    miscompiles sub-f32 psum-cotangents over manual axes ("Invalid
+    binary instruction opcode copy").  On TPU the boundary stays in the
+    compute dtype — half the interconnect bytes for bf16 models."""
+    from torchacc_tpu.ops._common import on_tpu
+    return dtype != jnp.float32 and not on_tpu()
+
+
 def pipeline_blocks(
     apply_block: Callable[[Any, Tuple], Tuple],
     stacked_params: Any,
@@ -78,9 +87,13 @@ def pipeline_blocks(
     # The activation crosses the shard_map boundary replicated over 'pp',
     # so its cotangent is a psum over the manual axis — which XLA:CPU
     # miscompiles for bf16 ("Invalid binary instruction opcode copy").
-    # Keep the boundary in f32 and restore the compute dtype inside.
+    # Gate the f32 widening on the CPU backend only: on TPU the boundary
+    # and every ppermute/psum stay in the compute dtype (half the
+    # interconnect bytes for bf16 models).
     compute_dtype = x.dtype
-    carry_in = (x.astype(jnp.float32),) + tuple(carry_in[1:])
+    wire_dtype = (jnp.float32 if _boundary_needs_f32(compute_dtype)
+                  else compute_dtype)
+    carry_in = (x.astype(wire_dtype),) + tuple(carry_in[1:])
     # batch -> micro-batches [M, mb, ...] for every rider in the carry
     micro = tuple(jax.tree.map(
         lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in)
@@ -104,7 +117,14 @@ def pipeline_blocks(
         # Feed micro-batches as scan xs (padded with P-1 dead ticks) and
         # bank outputs as scan ys — no dynamic indexing inside the loop.
         # Riders (positions/segment ids) travel the ring with their
-        # micro-batch via the same ppermute that moves the activation.
+        # micro-batch via the same ppermute that moves the activation:
+        # besides correctness this keeps ONE dependency-chained
+        # collective sequence per tick — replacing the rider ppermutes
+        # with local dynamic indexing let XLA:CPU's thunk executor
+        # reorder the pp permute against GSPMD's dp subgroup collectives
+        # on different devices and deadlock the in-process communicator.
+        # Rider bytes are h-times smaller than the activation; the real
+        # interconnect win is wire_dtype above.
         def _pad_ticks(c):
             return jax.tree.map(
                 lambda a: jnp.concatenate(
@@ -120,9 +140,9 @@ def pipeline_blocks(
             # previous stage handed over
             inj = jax.tree.map(lambda f, c: jnp.where(me == 0, f, c),
                                fed, cur)
-            inj = (inj[0].astype(compute_dtype),) + tuple(inj[1:])
-            out_carry = stage(inj)
-            handoff = (out_carry[0].astype(jnp.float32),) + tuple(inj[1:])
+            out_carry = stage((inj[0].astype(compute_dtype),)
+                              + tuple(inj[1:]))
+            handoff = (out_carry[0].astype(wire_dtype),) + tuple(inj[1:])
             nxt = jax.tree.map(
                 lambda a: jax.lax.ppermute(
                     a, pp_axis, [(j, (j + 1) % Pn) for j in range(Pn)]),
@@ -133,8 +153,8 @@ def pipeline_blocks(
         # ticks P-1 .. T-1 on the last stage hold micro-batches 0..M-1
         outs = ys[Pn - 1:]
         outs = jax.lax.psum(
-            jnp.where(me == Pn - 1, outs.astype(jnp.float32),
-                      jnp.zeros_like(outs, jnp.float32)), pp_axis)
+            jnp.where(me == Pn - 1, outs.astype(wire_dtype),
+                      jnp.zeros_like(outs, wire_dtype)), pp_axis)
         return outs.reshape((B,) + outs.shape[2:])
 
     out = jax.shard_map(
@@ -209,8 +229,12 @@ def pipeline_train_1f1b(
     staged = jax.tree.map(
         lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), stacked_params)
     compute_dtype = x.dtype
-    # f32 at the shard_map boundary (see pipeline_blocks note)
-    carry_in_f = (x.astype(jnp.float32),) + tuple(carry_in[1:])
+    # activation handoffs in the compute dtype on TPU (f32 only where
+    # the CPU backend requires it — see _boundary_needs_f32); gradient
+    # handoffs stay f32 for accumulation fidelity
+    wire_dtype = (jnp.float32 if _boundary_needs_f32(compute_dtype)
+                  else compute_dtype)
+    carry_in_f = (x.astype(wire_dtype),) + tuple(carry_in[1:])
     micro = tuple(jax.tree.map(
         lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in_f)
     labels_micro = labels.reshape((M, mb) + labels.shape[1:])
@@ -284,7 +308,7 @@ def pipeline_train_1f1b(
             # ---- F sub-tick (head+loss fused on the last stage) ----
             def do_f(_):
                 cin = (x_in[0].astype(compute_dtype),) + tuple(x_in[1:])
-                y = stage(params_me, cin)[0].astype(jnp.float32)
+                y = stage(params_me, cin)[0].astype(wire_dtype)
 
                 def last(_):
                     (ls, cnt), hvjp = jax.vjp(
@@ -299,9 +323,10 @@ def pipeline_train_1f1b(
                             dy.astype(jnp.float32))
 
                 def mid(_):
+                    # dy is f32 in both branches (gradient wire dtype)
                     return (jnp.zeros((), jnp.float32),
                             jnp.zeros((), jnp.float32), zero_head(),
-                            jnp.zeros_like(y))
+                            jnp.zeros(y.shape, jnp.float32))
 
                 ls, cnt, dhp, dy = jax.lax.cond(me == Pn - 1, last, mid,
                                                 None)
@@ -310,7 +335,7 @@ def pipeline_train_1f1b(
             def no_f(_):
                 return (jnp.zeros_like(x_in[0]), jnp.zeros((), jnp.float32),
                         jnp.zeros((), jnp.float32), zero_head(),
-                        jnp.zeros_like(x_in[0]))
+                        jnp.zeros(x_in[0].shape, jnp.float32))
 
             y, ls, cnt, dhp, dy_last = jax.lax.cond(f_on, do_f, no_f, None)
             loss_sum = loss_sum + ls

@@ -1,10 +1,11 @@
 from torchacc_tpu.train.accelerate import accelerate, apply_config_to_model
+from torchacc_tpu.train.hf_trainer import HFTrainerAdapter
 from torchacc_tpu.train.schedules import adamw, warmup_cosine, warmup_linear
 from torchacc_tpu.train.state import TrainState, state_logical_axes
 from torchacc_tpu.train.trainer import Trainer, shift_labels
 
 __all__ = [
-    "accelerate", "apply_config_to_model", "TrainState",
-    "state_logical_axes", "Trainer", "shift_labels",
+    "accelerate", "apply_config_to_model", "HFTrainerAdapter",
+    "TrainState", "state_logical_axes", "Trainer", "shift_labels",
     "adamw", "warmup_cosine", "warmup_linear",
 ]

@@ -1,0 +1,207 @@
+"""Drop-in adapter for ``transformers.Trainer`` scripts.
+
+Reference: ``accelerate_hf_trainer``/patches let an existing HF-Trainer
+torch script run on torchacc unchanged (core/accelerate_hf_trainer.py:
+21-78).  The TPU-native equivalent is an adapter with the SAME
+constructor surface — model, ``TrainingArguments``, datasets, collator —
+that converts the torch model once (models/hf.py) and then trains with
+this framework's sharded Trainer.  An HF script migrates by swapping
+
+    trainer = transformers.Trainer(model=model, args=args, ...)
+for
+    trainer = torchacc_tpu.train.HFTrainerAdapter(model=model, args=args,
+                                                  config=ta.Config(...))
+
+and keeps its dataset/collator/arguments code.
+
+Mapped TrainingArguments: per_device_train_batch_size (scaled by the
+mesh's data extent), learning_rate, weight_decay, adam betas/eps,
+max_grad_norm, warmup_steps/warmup_ratio, lr_scheduler_type
+(linear|cosine|constant), gradient_accumulation_steps, max_steps /
+num_train_epochs, logging_steps, save_steps, output_dir, bf16/fp16,
+seed.  Anything else is accepted and ignored (logged once).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Iterable, Optional
+
+import numpy as np
+
+from torchacc_tpu.config import Config
+from torchacc_tpu.utils.logger import logger
+
+
+def _to_numpy_batch(batch) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in batch.items():
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        out[k] = np.asarray(v)
+    # attention_mask is imposed by causal masking + -100 labels; the
+    # zoo model takes (input_ids, positions, segment_ids, labels)
+    out.pop("attention_mask", None)
+    return out
+
+
+class HFTrainerAdapter:
+    """transformers.Trainer-shaped front end over the native Trainer."""
+
+    def __init__(
+        self,
+        model=None,
+        args=None,
+        train_dataset=None,
+        eval_dataset=None,
+        data_collator=None,
+        tokenizer=None,
+        config: Optional[Config] = None,
+        optimizer=None,
+        **ignored,
+    ):
+        if model is None or args is None:
+            raise ValueError("model and args (TrainingArguments) required")
+        if ignored:
+            logger.info(f"HFTrainerAdapter ignoring kwargs: "
+                        f"{sorted(ignored)}")
+        import jax.numpy as jnp
+
+        from torchacc_tpu.models import load_hf_model
+        from torchacc_tpu.train.accelerate import accelerate
+        from torchacc_tpu.train.schedules import (
+            adamw,
+            warmup_cosine,
+            warmup_linear,
+        )
+
+        self.args = args
+        self.train_dataset = train_dataset
+        self.eval_dataset = eval_dataset
+        self.data_collator = data_collator
+        self.tokenizer = tokenizer
+
+        config = config or Config()
+        if getattr(args, "bf16", False):
+            config.compute.dtype = "bfloat16"
+        elif getattr(args, "fp16", False):
+            config.compute.dtype = "float16"
+        accum = int(getattr(args, "gradient_accumulation_steps", 1) or 1)
+        config.grad_accum = max(config.grad_accum, accum)
+
+        mc, params = load_hf_model(model)
+        self._hf_config = model.config
+
+        total = self._planned_steps()
+        warmup = int(getattr(args, "warmup_steps", 0) or 0)
+        if not warmup and getattr(args, "warmup_ratio", 0.0):
+            warmup = int(total * args.warmup_ratio)
+        kind = str(getattr(args, "lr_scheduler_type", "linear"))
+        lr = float(getattr(args, "learning_rate", 5e-5))
+        if "cosine" in kind:
+            sched = warmup_cosine(lr, total, warmup)
+        elif "constant" in kind:
+            sched = lr
+        else:
+            sched = warmup_linear(lr, total, warmup)
+        if optimizer is None:
+            optimizer = adamw(
+                sched,
+                weight_decay=float(getattr(args, "weight_decay", 0.0)),
+                b1=float(getattr(args, "adam_beta1", 0.9)),
+                b2=float(getattr(args, "adam_beta2", 0.999)),
+                eps=float(getattr(args, "adam_epsilon", 1e-8)),
+                grad_clip_norm=float(getattr(args, "max_grad_norm", 1.0))
+                or None)
+
+        self.trainer, _ = accelerate(mc, None, config, optimizer=optimizer)
+        self.trainer.init()
+        # graft the converted HF weights over the random init
+        self.trainer.state = self.trainer.state.replace(params=params)
+        self.model_config = mc
+        self._history = []
+
+    # -- data ---------------------------------------------------------------
+    def _global_batch_size(self) -> int:
+        per_dev = int(getattr(self.args, "per_device_train_batch_size", 8))
+        shape = dict(self.trainer.mesh.shape)
+        data_extent = shape.get("dp", 1) * shape.get("fsdp", 1)
+        return per_dev * max(data_extent, 1) \
+            * max(int(getattr(self.args, "gradient_accumulation_steps", 1)
+                      or 1), 1)
+
+    def _loader(self, dataset) -> Iterable[Dict[str, np.ndarray]]:
+        import torch.utils.data as tud
+
+        dl = tud.DataLoader(
+            dataset, batch_size=self._global_batch_size(),
+            shuffle=True, drop_last=True,
+            collate_fn=self.data_collator,
+            generator=self._torch_generator())
+        for batch in dl:
+            yield _to_numpy_batch(batch)
+
+    def _torch_generator(self):
+        import torch
+
+        g = torch.Generator()
+        g.manual_seed(int(getattr(self.args, "seed", 42)))
+        return g
+
+    def _planned_steps(self) -> int:
+        ms = int(getattr(self.args, "max_steps", -1) or -1)
+        if ms > 0:
+            return ms
+        epochs = float(getattr(self.args, "num_train_epochs", 1.0))
+        n = len(self.train_dataset) if self.train_dataset is not None else 0
+        per_step = max(self._planned_batch(), 1)
+        return max(int(epochs * (n // per_step)), 1)
+
+    def _planned_batch(self) -> int:
+        per_dev = int(getattr(self.args, "per_device_train_batch_size", 8))
+        return per_dev  # mesh unknown pre-init; refined in _global_batch_size
+
+    # -- the transformers.Trainer surface -----------------------------------
+    def train(self):
+        args = self.args
+        max_steps = int(getattr(args, "max_steps", -1) or -1)
+        epochs = (1 if max_steps > 0
+                  else max(int(math.ceil(
+                      float(getattr(args, "num_train_epochs", 1.0)))), 1))
+        out_dir = getattr(args, "output_dir", None)
+        save_steps = int(getattr(args, "save_steps", 0) or 0)
+        log_steps = int(getattr(args, "logging_steps", 50) or 50)
+        done = 0
+        for _ in range(epochs):
+            history = self.trainer.fit(
+                self._loader(self.train_dataset),
+                max_steps=(max_steps - done if max_steps > 0 else None),
+                checkpoint_dir=(out_dir if save_steps else None),
+                checkpoint_every=max(save_steps, 1),
+                log_every=log_steps)
+            self._history.extend(history)
+            done += history[-1]["step"] + 1 if history else 0
+            if max_steps > 0 and done >= max_steps:
+                break
+        return self._history
+
+    def evaluate(self, eval_dataset=None) -> Dict[str, float]:
+        ds = eval_dataset if eval_dataset is not None else self.eval_dataset
+        if ds is None:
+            raise ValueError("no eval_dataset")
+        losses = [float(self.trainer.eval_step(b))
+                  for b in self._loader(ds)]
+        return {"eval_loss": float(np.mean(losses)) if losses else float("nan")}
+
+    def save_model(self, output_dir: Optional[str] = None) -> None:
+        from torchacc_tpu.checkpoint.io import save_checkpoint
+
+        out = output_dir or getattr(self.args, "output_dir", None)
+        if not out:
+            raise ValueError("no output_dir")
+        save_checkpoint(out, self.trainer.state, force=True)
+
+    @property
+    def state(self):
+        return self.trainer.state
