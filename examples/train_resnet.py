@@ -1,0 +1,132 @@
+"""Vision quick-start: ResNet classification through the custom-model path.
+
+Reference parity: the reference's quick start trains torchvision
+ResNet-50 through `torchacc.accelerate` (docs/source/quick_start.md:
+119-134, ~+16% over native).  The TPU-native equivalent is the same
+promise through the custom-model path: any flax module following the
+``(inputs, positions=None, segment_ids=None)`` call convention trains
+under the sharded Trainer with a custom loss and per-model axes rules.
+
+This example builds a compact ResNet (GroupNorm instead of BatchNorm —
+stateless, so the functional train step needs no mutable batch stats,
+and it avoids BatchNorm's cross-replica stats traffic on pod slices)
+and trains it on synthetic CIFAR-shaped data:
+
+    python examples/train_resnet.py --steps 30 --dp -1
+
+Batches use the framework's generic keys: ``input_ids`` carries the
+NHWC image tensor, ``labels`` the class ids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ResBlock(nn.Module):
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.features, (3, 3), strides=(self.stride,) * 2,
+                    use_bias=False, name="conv1")(x)
+        y = nn.GroupNorm(num_groups=8, name="gn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False, name="conv2")(y)
+        y = nn.GroupNorm(num_groups=8, name="gn2")(y)
+        if x.shape[-1] != self.features or self.stride != 1:
+            x = nn.Conv(self.features, (1, 1), strides=(self.stride,) * 2,
+                        use_bias=False, name="proj")(x)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-scale ResNet (GroupNorm); stages (2,2,2) ~ ResNet-14."""
+    num_classes: int = 10
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, images, positions=None, segment_ids=None):
+        x = images.astype(jnp.float32)
+        x = nn.Conv(self.width, (3, 3), use_bias=False, name="stem")(x)
+        x = nn.relu(nn.GroupNorm(num_groups=8, name="gn0")(x))
+        for i, (feats, stride) in enumerate(
+                [(self.width, 1), (self.width * 2, 2), (self.width * 4, 2)]):
+            x = ResBlock(feats, stride, name=f"block{i}a")(x)
+            x = ResBlock(feats, 1, name=f"block{i}b")(x)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, name="head")(x)
+
+
+# data-parallel-only axes: convs replicate, the head splits over tp if set
+RESNET_AXES = (
+    (r"conv\d/kernel$|proj/kernel$|stem/kernel$", (None, None, None, "mlp")),
+    (r"gn\d/(scale|bias)$", (None,)),
+    (r"head/kernel$", ("embed", "mlp")),
+    (r"head/bias$", (None,)),
+)
+
+
+def xent(logits, batch):
+    onehot = jax.nn.one_hot(batch["labels"], logits.shape[-1])
+    return -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--size", type=int, default=32)
+    ap.add_argument("--classes", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--dp", type=int, default=-1)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import Trainer
+
+    cfg = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=args.dp)))
+    trainer = Trainer(ResNet(num_classes=args.classes), cfg,
+                      optimizer=optax.adamw(args.lr),
+                      axes_rules=RESNET_AXES, loss=xent)
+
+    rng = np.random.default_rng(0)
+    imgs = rng.normal(size=(args.batch, args.size, args.size, 3)
+                      ).astype(np.float32)
+    labels = rng.integers(0, args.classes, size=(args.batch,))
+    batch = {"input_ids": jnp.asarray(imgs),
+             "labels": jnp.asarray(labels, jnp.int32)}
+    trainer.init(sample_input=batch["input_ids"])
+
+    losses = []
+    t0 = None
+    for step in range(args.steps):
+        m = trainer.step(batch)
+        if step == 2:
+            float(m["loss"])           # sync, then time steady state
+            t0 = time.perf_counter()
+        losses.append(float(m["loss"]))
+    dt = (time.perf_counter() - t0) / max(args.steps - 3, 1)
+    out = {"loss_first": round(losses[0], 4), "loss_last": round(losses[-1], 4),
+           "samples_per_sec": round(args.batch / dt, 1)}
+    print(json.dumps(out) if args.json else out)
+    return 0 if losses[-1] < losses[0] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

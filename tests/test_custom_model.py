@@ -71,3 +71,23 @@ def test_custom_model_missing_rules_raises(devices):
     trainer = Trainer(TinyClassifier(), cfg, loss=_loss)
     with pytest.raises(ValueError, match="no logical-axes rule"):
         trainer.init()
+
+
+def test_resnet_example_trains(devices):
+    """Vision through the custom-model path (reference quick-start
+    parity: torchvision ResNet-50 via accelerate, quick_start.md:119-134)."""
+    import optax
+    from examples.train_resnet import RESNET_AXES, ResNet, xent
+    from torchacc_tpu.train import Trainer
+
+    cfg = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)))
+    trainer = Trainer(ResNet(num_classes=5, width=16), cfg,
+                      optimizer=optax.adamw(3e-3),
+                      axes_rules=RESNET_AXES, loss=xent)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+                 rng.normal(size=(16, 16, 16, 3)).astype(np.float32)),
+             "labels": jnp.asarray(rng.integers(0, 5, 16), jnp.int32)}
+    trainer.init(sample_input=batch["input_ids"])
+    losses = [float(trainer.step(batch)["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0], losses

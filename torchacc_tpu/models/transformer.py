@@ -56,6 +56,10 @@ class ModelConfig:
     remat_cnt: Optional[int] = None
     attention_impl: str = "auto"
     window: Tuple[int, int] = (-1, -1)      # sliding-window attention
+    # KV-cache decode mode (models/generate.py): __call__ consumes one
+    # token per step, appending rotated k / raw v into the 'cache'
+    # collection and attending over the filled prefix
+    decode: bool = False
     # post-softmax attention dropout (reference flash_attn.py:418-423);
     # active only when the caller passes deterministic=False + a seed
     attn_dropout: float = 0.0
@@ -207,6 +211,49 @@ class Attention(nn.Module):
             q, k = _rope(q, k, positions, cfg.rope_theta)
         slopes = (jnp.asarray(alibi_slopes(cfg.num_heads), jnp.float32)
                   if cfg.pos_emb == "alibi" else None)
+
+        # -- KV cache (prefill writes the prompt's k/v; decode appends
+        # one position and attends over the filled prefix).  Not created
+        # at init so checkpoints/params stay cache-free. ----------------
+        if self.has_variable("cache", "k") or (
+                self.is_mutable_collection("cache")
+                and not self.is_initializing()):
+            b, s = x.shape[0], x.shape[1]
+            max_len = cfg.max_seq_len
+            ck = self.variable("cache", "k", jnp.zeros,
+                               (b, max_len, cfg.kv_heads, d), cfg.dtype)
+            cv = self.variable("cache", "v", jnp.zeros,
+                               (b, max_len, cfg.kv_heads, d), cfg.dtype)
+            cidx = self.variable("cache", "idx",
+                                 lambda: jnp.zeros((), jnp.int32))
+            if cfg.decode:
+                pos = cidx.value
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(cfg.dtype), (0, pos, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(cfg.dtype), (0, pos, 0, 0))
+                cidx.value = pos + s
+                # attend over positions <= pos via segment ids (causal
+                # bottom-right alignment would misalign mid-cache)
+                valid = jnp.arange(max_len) <= pos
+                kseg = jnp.broadcast_to(
+                    jnp.where(valid, 0, -1)[None], (b, max_len))
+                qseg = jnp.zeros((b, s), jnp.int32)
+                out = attention(q, ck.value, cv.value, causal=False,
+                                q_segment_ids=qseg, kv_segment_ids=kseg,
+                                impl="xla")
+                return nn.DenseGeneral(
+                    features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                    name="o_proj", dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.initializers.normal(0.02))(out)
+            # prefill: bank the prompt's (rotated) k / v, then fall
+            # through to the normal attention below
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, 0, 0, 0))
+            cidx.value = jnp.asarray(s, jnp.int32)
         # per-layer decorrelation already happened in TransformerLM
         # (seeds_xs = _layer_seed(seed, arange(L)))
         dropout_p, seed = 0.0, None
@@ -362,7 +409,7 @@ class TransformerLM(nn.Module):
         if cfg.scan_layers:
             scan_mod = nn.scan(
                 block_cls,
-                variable_axes={"params": 0, "intermediates": 0},
+                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
                 split_rngs={"params": True},
                 length=cfg.num_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

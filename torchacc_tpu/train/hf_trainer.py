@@ -93,6 +93,10 @@ class HFTrainerAdapter:
         mc, params = load_hf_model(model)
         self._hf_config = model.config
 
+        # mesh first: the schedule horizon needs the real global batch
+        mesh_shape = dict(config.get_mesh().shape)
+        self._data_extent = max(
+            mesh_shape.get("dp", 1) * mesh_shape.get("fsdp", 1), 1)
         total = self._planned_steps()
         warmup = int(getattr(args, "warmup_steps", 0) or 0)
         if not warmup and getattr(args, "warmup_ratio", 0.0):
@@ -123,31 +127,31 @@ class HFTrainerAdapter:
         self._history = []
 
     # -- data ---------------------------------------------------------------
-    def _global_batch_size(self) -> int:
-        per_dev = int(getattr(self.args, "per_device_train_batch_size", 8))
-        shape = dict(self.trainer.mesh.shape)
-        data_extent = shape.get("dp", 1) * shape.get("fsdp", 1)
-        return per_dev * max(data_extent, 1) \
-            * max(int(getattr(self.args, "gradient_accumulation_steps", 1)
-                      or 1), 1)
+    def _global_batch_size(self, train: bool = True) -> int:
+        key = ("per_device_train_batch_size" if train
+               else "per_device_eval_batch_size")
+        per_dev = int(getattr(self.args, key, 8) or 8)
+        gbs = per_dev * self._data_extent
+        if train:
+            gbs *= max(int(getattr(self.args,
+                                   "gradient_accumulation_steps", 1) or 1), 1)
+        return gbs
 
-    def _loader(self, dataset) -> Iterable[Dict[str, np.ndarray]]:
+    def _loader(self, dataset, train: bool = True,
+                epoch: int = 0) -> Iterable[Dict[str, np.ndarray]]:
+        import torch
         import torch.utils.data as tud
 
+        g = torch.Generator()
+        # fold the epoch in so each epoch reshuffles (transformers
+        # set_epoch semantics)
+        g.manual_seed(int(getattr(self.args, "seed", 42)) + epoch)
         dl = tud.DataLoader(
-            dataset, batch_size=self._global_batch_size(),
-            shuffle=True, drop_last=True,
-            collate_fn=self.data_collator,
-            generator=self._torch_generator())
+            dataset, batch_size=self._global_batch_size(train),
+            shuffle=train, drop_last=train,
+            collate_fn=self.data_collator, generator=g)
         for batch in dl:
             yield _to_numpy_batch(batch)
-
-    def _torch_generator(self):
-        import torch
-
-        g = torch.Generator()
-        g.manual_seed(int(getattr(self.args, "seed", 42)))
-        return g
 
     def _planned_steps(self) -> int:
         ms = int(getattr(self.args, "max_steps", -1) or -1)
@@ -155,12 +159,8 @@ class HFTrainerAdapter:
             return ms
         epochs = float(getattr(self.args, "num_train_epochs", 1.0))
         n = len(self.train_dataset) if self.train_dataset is not None else 0
-        per_step = max(self._planned_batch(), 1)
+        per_step = max(self._global_batch_size(train=True), 1)
         return max(int(epochs * (n // per_step)), 1)
-
-    def _planned_batch(self) -> int:
-        per_dev = int(getattr(self.args, "per_device_train_batch_size", 8))
-        return per_dev  # mesh unknown pre-init; refined in _global_batch_size
 
     # -- the transformers.Trainer surface -----------------------------------
     def train(self):
@@ -173,9 +173,9 @@ class HFTrainerAdapter:
         save_steps = int(getattr(args, "save_steps", 0) or 0)
         log_steps = int(getattr(args, "logging_steps", 50) or 50)
         done = 0
-        for _ in range(epochs):
+        for epoch in range(epochs):
             history = self.trainer.fit(
-                self._loader(self.train_dataset),
+                self._loader(self.train_dataset, epoch=epoch),
                 max_steps=(max_steps - done if max_steps > 0 else None),
                 checkpoint_dir=(out_dir if save_steps else None),
                 checkpoint_every=max(save_steps, 1),
@@ -191,8 +191,11 @@ class HFTrainerAdapter:
         if ds is None:
             raise ValueError("no eval_dataset")
         losses = [float(self.trainer.eval_step(b))
-                  for b in self._loader(ds)]
-        return {"eval_loss": float(np.mean(losses)) if losses else float("nan")}
+                  for b in self._loader(ds, train=False)]
+        if not losses:
+            raise ValueError(
+                f"eval_dataset yielded no batches (len={len(ds)})")
+        return {"eval_loss": float(np.mean(losses))}
 
     def save_model(self, output_dir: Optional[str] = None) -> None:
         from torchacc_tpu.checkpoint.io import save_checkpoint
