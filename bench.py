@@ -237,7 +237,9 @@ def _bench(args, wd: Watchdog) -> int:
         )
     cfg = ta.Config()
     cfg.memory.gc = True
-    cfg.memory.gc_policy = "dots_with_no_batch_dims"
+    # best measured policy on v5e (docs/PERF.md): saves q/k/v + flash
+    # residuals + ffn projections, recompute is elementwise-only
+    cfg.memory.gc_policy = "save_attn_mlp"
 
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
     trainer.init()

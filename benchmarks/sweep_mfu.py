@@ -1,0 +1,58 @@
+"""Exploratory MFU sweep on the real chip (not the driver bench)."""
+import json, subprocess, sys, time, os
+os.makedirs(os.path.expanduser("~/.cache/torchacc_tpu_bench"), exist_ok=True)
+
+RUN = """
+import json, os, time, sys
+import jax
+jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/torchacc_tpu_bench"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+import jax.numpy as jnp, numpy as np, optax
+import torchacc_tpu as ta
+from torchacc_tpu.models import get_preset
+from torchacc_tpu.train import accelerate
+pol, batch = {pol!r}, {batch}
+seq = 2048
+mc = get_preset("llama-tiny", hidden_size=1024, num_layers=24, num_heads=16,
+                num_kv_heads=16, intermediate_size=4096, vocab_size=32000, max_seq_len=seq)
+cfg = ta.Config()
+cfg.memory.gc = pol != "none"
+if pol != "none":
+    cfg.memory.gc_policy = pol
+trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
+trainer.init()
+rng = np.random.default_rng(0)
+bd = {{"input_ids": jnp.asarray(rng.integers(0, 32000, size=(batch, seq)), jnp.int32)}}
+for _ in range(3):
+    m = trainer.step(bd)
+float(m["loss"])
+iters = 10
+t0 = time.perf_counter()
+for _ in range(iters):
+    m = trainer.step(bd)
+float(m["loss"])
+dt = (time.perf_counter() - t0) / iters
+n = mc.num_params()
+fpt = 6.0 * n + 6.0 * mc.num_layers * mc.hidden_size * seq
+mfu = fpt * batch * seq / dt / 197e12
+print(json.dumps({{"pol": pol, "batch": batch, "step_s": round(dt,4), "mfu": round(mfu,4),
+                   "tok_s": round(batch*seq/dt,1)}}))
+"""
+
+for pol, batch in [("save_attn", 4), ("save_attn_mlp", 4), ("save_attn", 8),
+                   ("save_attn_mlp", 8), ("save_attn", 16)]:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", RUN.format(pol=pol, batch=batch)],
+            capture_output=True, text=True, timeout=900)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"pol": pol, "batch": batch,
+                          "error": "timeout (900s)"}), flush=True)
+        continue
+    out = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    if out:
+        print(out[-1], flush=True)
+    else:
+        err = (r.stderr or "")
+        oom = "OOM" if "Ran out of memory" in err else err[-200:].replace("\n"," | ")
+        print(json.dumps({"pol": pol, "batch": batch, "error": oom}), flush=True)

@@ -107,14 +107,16 @@ class MemoryConfig:
     # (utils/checkpoint.py:67-81) mapped onto the zoo model's modules
     gc_cls: Optional[List[str]] = None
     gc_cnt: Optional[int] = None      # remat only the first N layers
-    gc_policy: str = "nothing"        # 'nothing' | 'dots' | 'dots_with_no_batch_dims' | 'offload_dots'
+    gc_policy: str = "nothing"        # see utils/remat.py remat_policy()
     # force the host-offload remat policy (overrides gc_policy, implies gc)
     offload_activations: bool = False
 
     _GC_CLS = ("Block", "Attention", "Mlp", "MoEMlp")
+    _GC_POLICIES = ("nothing", "dots", "dots_with_no_batch_dims",
+                    "save_attn", "save_attn_mlp", "offload_dots")
 
     def validate(self) -> None:
-        _check(self.gc_policy in ("nothing", "dots", "dots_with_no_batch_dims", "offload_dots"),
+        _check(self.gc_policy in self._GC_POLICIES,
                f"memory.gc_policy invalid: {self.gc_policy}")
         if self.gc_cnt is not None:
             _check(self.gc_cnt >= 0, "memory.gc_cnt must be >= 0")

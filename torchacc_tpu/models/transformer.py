@@ -209,6 +209,13 @@ class Attention(nn.Module):
         v = activation_constraint(v, ("batch", "seq", "heads", None), rules)
         if cfg.pos_emb == "rope":
             q, k = _rope(q, k, positions, cfg.rope_theta)
+        # names for the selective-remat policies (utils/remat.py): saving
+        # post-rope q/k/v means the backward recomputes only the cheap
+        # norms/elementwise ops, never the projections or the rope
+        from jax.ad_checkpoint import checkpoint_name
+        q = checkpoint_name(q, "qkv_proj")
+        k = checkpoint_name(k, "qkv_proj")
+        v = checkpoint_name(v, "qkv_proj")
         slopes = (jnp.asarray(alibi_slopes(cfg.num_heads), jnp.float32)
                   if cfg.pos_emb == "alibi" else None)
 
@@ -296,12 +303,19 @@ class Mlp(nn.Module):
             DEFAULT_RULES,
             activation_constraint,
         )
+        from jax.ad_checkpoint import checkpoint_name
         if cfg.activation == "swiglu":
-            gate = dense("gate_proj", cfg.ffn_size)(x)
-            up = dense("up_proj", cfg.ffn_size)(x)
+            # named so 'save_attn_mlp' can save the ffn-width projections
+            # (recompute becomes elementwise-only) while 'save_attn' leaves
+            # them unsaved — they are the dominant activation cost
+            gate = checkpoint_name(dense("gate_proj", cfg.ffn_size)(x),
+                                   "mlp_gate_up")
+            up = checkpoint_name(dense("up_proj", cfg.ffn_size)(x),
+                                 "mlp_gate_up")
             h = nn.silu(gate) * up
         else:
-            h = nn.gelu(dense("up_proj", cfg.ffn_size)(x))
+            h = nn.gelu(checkpoint_name(dense("up_proj", cfg.ffn_size)(x),
+                                        "mlp_gate_up"))
         # megatron TP: ffn hidden sharded on 'tp' (column-parallel out)
         h = activation_constraint(h, ("batch", "seq", "mlp"),
                                   cfg.logical_axis_rules or DEFAULT_RULES)

@@ -72,8 +72,17 @@ def _round_up(x: int, m: int) -> int:
 def _block_sizes(sq: int, sk: int) -> Tuple[int, int]:
     """TPU-legal defaults: block_q lands in sublane positions (multiple of
     8), block_k lands in lane positions of the kv-segment block (multiple
-    of 128); the wrapper pads sequences up to a block multiple."""
-    return min(512, _round_up(sq, 8)), min(512, _round_up(sk, _LANES))
+    of 128); the wrapper pads sequences up to a block multiple.  1024x1024
+    measured fastest on v5e at seq 2048 (docs/PERF.md) — fewer grid steps
+    amortise the per-tile mask/softmax VPU overhead.  A block that divides
+    the sequence is preferred over a larger one: padding fabricates
+    segment ids, which disables the interior-tile mask-skip fast path."""
+    def pick(s: int, unit: int) -> int:
+        for cand in (1024, 512):
+            if s % cand == 0:
+                return cand
+        return min(1024, _round_up(s, unit))
+    return pick(sq, 8), pick(sk, _LANES)
 
 
 def _band_mask(q_start, k_start, block_q, block_k, causal, window,
@@ -126,6 +135,43 @@ def _block_should_run(q_start, k_start, block_q, block_k, causal, window,
     return run
 
 
+def _block_fully_inside(q_start, k_start, block_q, block_k, causal, window,
+                        qk_shift=0):
+    """True when no (q, k) pair in the tile is positionally masked — the
+    kernels then skip the iota/compare/where mask work entirely (the
+    softmax VPU path dominates interior tiles otherwise)."""
+    left, right = window
+    q_hi = q_start + qk_shift + block_q - 1
+    q_lo = q_start + qk_shift
+    k_hi = k_start + block_k - 1
+    inside = True
+    if causal:
+        inside = jnp.logical_and(inside, k_hi <= q_lo)
+    if left >= 0:
+        inside = jnp.logical_and(inside, k_start >= q_hi - left)
+    if right >= 0:
+        inside = jnp.logical_and(inside, k_hi <= q_lo + right)
+    return inside
+
+
+def _dispatch_masked(compute, has_seg, q_start, k_start, block_q, block_k,
+                     causal, window, shift):
+    """Run ``compute(masked)`` for one tile: skipped entirely outside the
+    band, mask-free on fully-interior tiles (positional masks only — any
+    segment ids force the masked path), masked otherwise."""
+    run = _block_should_run(q_start, k_start, block_q, block_k,
+                            causal, window, shift)
+    if not has_seg and (causal or window[0] >= 0 or window[1] >= 0):
+        inside = _block_fully_inside(q_start, k_start, block_q, block_k,
+                                     causal, window, shift)
+        pl.when(jnp.logical_and(run, inside))(
+            functools.partial(compute, False))
+        pl.when(jnp.logical_and(run, jnp.logical_not(inside)))(
+            functools.partial(compute, True))
+    else:
+        pl.when(run)(functools.partial(compute, True))
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -155,12 +201,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
     if meta_ref is not None:
         shift = shift + meta_ref[1] - meta_ref[2]
 
-    @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window, shift))
-    def _compute():
-        q = q_ref[0, 0, :, :].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
-        v = v_ref[0, 0, :, :].astype(jnp.float32)          # [bk, d]
+    def _compute(masked):
+        # dots take the inputs' native dtype (bf16 in training) and
+        # accumulate in f32 — an f32 input cast here would knock the MXU
+        # off its native bf16 path (~8x slower on v5e); softmax math
+        # stays in f32 throughout
+        q = q_ref[0, 0, :, :]                              # [bq, d]
+        k = k_ref[0, 0, :, :]                              # [bk, d]
+        v = v_ref[0, 0, :, :]                              # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [bq, bk]
@@ -168,13 +216,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
             s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
                                 block_q, block_k, shift)
 
-        mask = _band_mask(q_start, k_start, block_q, block_k, causal, window,
-                          shift)
-        if qseg_ref is not None:
-            qs = qseg_ref[0, :, 0]                          # [bq]
-            ks = kseg_ref[0, 0, :]                          # [bk]
-            seg = qs[:, None] == ks[None, :]
-            mask = seg if mask is None else mask & seg
+        mask = None
+        if masked:
+            mask = _band_mask(q_start, k_start, block_q, block_k, causal,
+                              window, shift)
+            if qseg_ref is not None:
+                qs = qseg_ref[0, :, 0]                      # [bq]
+                ks = kseg_ref[0, 0, :]                      # [bk]
+                seg = qs[:, None] == ks[None, :]
+                mask = seg if mask is None else mask & seg
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
 
@@ -198,10 +248,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
                 block_q, block_k, dropout_p)
             p_v = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p_v, v, (((1,), (0,)), ((), ())),
+            p_v.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    _dispatch_masked(_compute, qseg_ref is not None, q_start, k_start,
+                     block_q, block_k, causal, window, shift)
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
@@ -325,7 +378,7 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta, scale,
 
 def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref, lse,
                  q_start, k_start, b_idx, h_idx, *, scale, causal, window,
-                 block_q, block_k, qk_shift=0, dropout_p=0.0):
+                 block_q, block_k, qk_shift=0, dropout_p=0.0, masked=True):
     """Rebuild (p, p_tilde, q, k) for one tile from the saved lse.
 
     ``p`` is the exact softmax tile; ``p_tilde`` is the dropout-scaled
@@ -337,18 +390,20 @@ def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref, lse,
     shift = qk_shift
     if meta_ref is not None:
         shift = shift + meta_ref[1] - meta_ref[2]
-    q = q_ref[0, 0, :, :].astype(jnp.float32)
-    k = k_ref[0, 0, :, :].astype(jnp.float32)
+    q = q_ref[0, 0, :, :]
+    k = k_ref[0, 0, :, :]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if alibi_ref is not None:
         s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
                             block_q, block_k, shift)
-    mask = _band_mask(q_start, k_start, block_q, block_k, causal, window,
-                      shift)
-    if qseg_ref is not None:
-        seg = qseg_ref[0, :, 0][:, None] == kseg_ref[0, 0, :][None, :]
-        mask = seg if mask is None else mask & seg
+    mask = None
+    if masked:
+        mask = _band_mask(q_start, k_start, block_q, block_k, causal,
+                          window, shift)
+        if qseg_ref is not None:
+            seg = qseg_ref[0, :, 0][:, None] == kseg_ref[0, 0, :][None, :]
+            mask = seg if mask is None else mask & seg
     p = jnp.exp(s - lse[:, None])
     if mask is not None:
         p = jnp.where(mask, p, 0.0)
@@ -381,24 +436,26 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
     if meta_ref is not None:
         shift = shift + meta_ref[1] - meta_ref[2]
 
-    @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window, shift))
-    def _compute():
+    def _compute(masked):
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
         p, p_tilde, q, k = _recompute_p(
             q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
             lse, q_start, k_start, bi, hi, scale=scale,
             causal=causal, window=window, block_q=block_q,
-            block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p)
+            block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p,
+            masked=masked)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p_tilde * dp - p * delta[:, None]) * scale
         dq_scr[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _dispatch_masked(_compute, qseg_ref is not None, q_start, k_start,
+                     block_q, block_k, causal, window, shift)
 
     @pl.when(ki == num_kv_blocks - 1)
     def _finalize():
@@ -434,27 +491,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
     if meta_ref is not None:
         shift = shift + meta_ref[1] - meta_ref[2]
 
-    @pl.when(_block_should_run(q_start, k_start, block_q, block_k,
-                               causal, window, shift))
-    def _compute():
+    def _compute(masked):
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
-        do = do_ref[0, 0, :, :].astype(jnp.float32)
-        v = v_ref[0, 0, :, :].astype(jnp.float32)
+        do = do_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
         p, p_tilde, q, k = _recompute_p(
             q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
             lse, q_start, k_start, bi, h_idx, scale=scale,
             causal=causal, window=window, block_q=block_q,
-            block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p)
+            block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p,
+            masked=masked)
         dv_scr[...] += jax.lax.dot_general(
-            p_tilde, do, (((0,), (0,)), ((), ())),
+            p_tilde.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = (p_tilde * dp - p * delta[:, None]) * scale        # [bq, bk]
         dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
+
+    _dispatch_masked(_compute, qseg_ref is not None, q_start, k_start,
+                     block_q, block_k, causal, window, shift)
 
     @pl.when(jnp.logical_and(g == group - 1, qi == num_q_blocks - 1))
     def _finalize():
@@ -628,11 +687,20 @@ def _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
 
 def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
                scale, causal, window, block_q, block_k, qk_shift, dropout_p):
+    from jax.ad_checkpoint import checkpoint_name
+
     o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
                   scale, causal, window, block_q, block_k, qk_shift,
                   dropout_p)
-    return o, (q, k, v, o, lse, q_segment_ids, kv_segment_ids,
-               alibi_slopes, meta)
+    # Named so the selective-remat policies (utils/remat.py 'save_attn*')
+    # can save the kernel's residuals and skip re-running the fwd kernel
+    # in the backward pass; identity outside jax.checkpoint.  The SAME
+    # named value must be both the primal output and the residual —
+    # naming only a residual copy leaves the primal path unsaved, and
+    # its recompute re-runs the forward kernel anyway.
+    o = checkpoint_name(o, "attn_ctx")
+    return o, (q, k, v, o, checkpoint_name(lse, "attn_lse"),
+               q_segment_ids, kv_segment_ids, alibi_slopes, meta)
 
 
 def _flash_bwd(scale, causal, window, block_q, block_k, qk_shift, dropout_p,

@@ -21,6 +21,12 @@ def remat_policy(name: str = "nothing") -> Optional[object]:
     'nothing'                  save nothing (recompute all)   — max memory win
     'dots'                     save matmul outputs            — cheap recompute
     'dots_with_no_batch_dims'  save contraction-only matmuls  — maxtext default
+    'save_attn'                save q/k/v + flash residuals (o, lse) +
+                               block outputs; recompute ffn-width tensors —
+                               the best memory/flops trade measured on v5e
+    'save_attn_mlp'            'save_attn' + the ffn-width gate/up
+                               projections; recompute is elementwise-only
+                               (near-no-remat speed at ~half its memory)
     'offload_dots'             offload matmul outputs to host — HBM relief with
                                no recompute (reference cpu_offload.py analogue)
     """
@@ -31,6 +37,13 @@ def remat_policy(name: str = "nothing") -> Optional[object]:
         return cp.checkpoint_dots
     if name == "dots_with_no_batch_dims":
         return cp.checkpoint_dots_with_no_batch_dims
+    if name == "save_attn":
+        return cp.save_only_these_names(
+            "qkv_proj", "attn_ctx", "attn_lse", "attn_out", "mlp_out")
+    if name == "save_attn_mlp":
+        return cp.save_only_these_names(
+            "qkv_proj", "attn_ctx", "attn_lse", "attn_out", "mlp_out",
+            "mlp_gate_up")
     if name == "offload_dots":
         from torchacc_tpu.ops._common import on_tpu
         if not on_tpu():
