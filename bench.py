@@ -223,16 +223,18 @@ def _bench(args, wd: Watchdog) -> int:
         seq, batch, iters = 512, 2, args.iters or 5
         mc = get_preset(
             "llama-tiny",
-            hidden_size=512, num_layers=4, num_heads=8, num_kv_heads=8,
+            hidden_size=512, num_layers=4, num_heads=4, num_kv_heads=4,
             intermediate_size=2048, vocab_size=32000, max_seq_len=seq,
         )
     else:
-        # ~350M-param Llama-architecture model: big enough for meaningful
+        # ~470M-param Llama-architecture model: big enough for meaningful
         # MXU utilisation, small enough for one v5e chip with Adam in f32.
+        # head_dim 128 (Llama-3 standard): d=64 wastes half the MXU lanes
+        # and costs ~16 MFU points on v5e (docs/PERF.md).
         seq, batch, iters = 2048, 4, args.iters or 10
         mc = get_preset(
             "llama-tiny",
-            hidden_size=1024, num_layers=24, num_heads=16, num_kv_heads=16,
+            hidden_size=1024, num_layers=24, num_heads=8, num_kv_heads=8,
             intermediate_size=4096, vocab_size=32000, max_seq_len=seq,
         )
     cfg = ta.Config()

@@ -153,3 +153,39 @@ def test_generate_kv_cache_gqa_and_learned_pos():
                         use_cache=False)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow),
                                       err_msg=preset)
+
+
+def test_metrics_writer_jsonl_and_fit_wiring(tmp_path):
+    """MetricsWriter streams JSONL (+ TB events when torch provides a
+    SummaryWriter) and Trainer.fit(metrics_dir=...) drives it."""
+    import json
+
+    from torchacc_tpu.utils.metrics import MetricsWriter
+
+    d = tmp_path / "m"
+    w = MetricsWriter(str(d))
+    w.log(0, {"train/loss": 2.5})
+    w.log(10, {"train/loss": 2.25, "train/tokens_per_sec": 123.0})
+    w.close()
+    recs = [json.loads(l) for l in (d / "metrics.jsonl").read_text().splitlines()]
+    assert [r["step"] for r in recs] == [0, 10]
+    assert recs[1]["train/tokens_per_sec"] == 123.0
+
+    # end-to-end through fit()
+    import optax
+
+    from torchacc_tpu.train import accelerate
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=1, num_heads=4, max_seq_len=16)
+    cfg = ta.Config()
+    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-3))
+    trainer.init()
+    rng = np.random.default_rng(0)
+    loader = ({"input_ids": jnp.asarray(
+        rng.integers(0, 64, (8, 16)), jnp.int32)} for _ in range(3))
+    hist = trainer.fit(loader, max_steps=3, log_every=1,
+                       metrics_dir=str(tmp_path / "fit"))
+    lines = (tmp_path / "fit" / "metrics.jsonl").read_text().splitlines()
+    assert len(lines) == len(hist) == 3
+    assert "train/tokens_per_sec" in json.loads(lines[-1])

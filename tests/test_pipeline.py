@@ -251,3 +251,38 @@ def test_1f1b_bf16_wire_traces(devices, monkeypatch):
         l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(stacked, head, xb)
     assert np.isfinite(float(l))
     assert all(np.isfinite(np.asarray(t)).all() for t in jax.tree.leaves(g))
+
+
+@pytest.mark.parametrize("pp,mb,vs", [(4, 4, 2), (2, 2, 2), (4, 4, 1)])
+def test_pp_interleaved_matches_single(devices, pp, mb, vs):
+    """Interleaved (virtual-stage) pipeline == pp=1 training: virtual
+    stages are a pure re-chunking of the same layer math (reference gap:
+    Megatron-style interleaved schedule, VERDICT missing-2)."""
+    import optax
+    batches = list(_batches(3))
+
+    cfg_pp = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=pp, num_micro_batches=mb, virtual_stages=vs)))
+    t_pp, _ = accelerate(_model(num_layers=8), None, cfg_pp,
+                         optimizer=optax.adam(1e-3))
+    t_pp.init()
+    losses_pp = [float(t_pp.step(b)["loss"]) for b in batches]
+
+    cfg_1 = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)))
+    t_1, _ = accelerate(_model(num_layers=8), None, cfg_1,
+                        optimizer=optax.adam(1e-3))
+    t_1.init()
+    losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
+
+    np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
+
+
+def test_pp_interleaved_rejects_bad_configs():
+    with pytest.raises(ValueError):
+        ta.Config(dist=ta.DistConfig(
+            pp=ta.PPConfig(size=2, num_micro_batches=4,
+                           virtual_stages=2))).validate()
+    with pytest.raises(ValueError):
+        ta.Config(dist=ta.DistConfig(
+            pp=ta.PPConfig(size=2, num_micro_batches=2, schedule="1f1b",
+                           virtual_stages=2))).validate()

@@ -223,15 +223,29 @@ class PPConfig:
     #          residual memory ~ min(2(P-1)+1, M) stage inputs.  Zoo-model
     #          train steps only (head+loss fused into the last stage).
     schedule: str = "gpipe"
+    # interleaved (Megatron virtual-pipeline) stages: each device holds
+    # this many non-adjacent layer chunks and micro-batches lap the
+    # ppermute ring that many times, shrinking the fill/drain bubble to
+    # (V*P-1)/V stage-times (parallel/pp.py pipeline_blocks docstring)
+    virtual_stages: int = 1
 
     def validate(self) -> None:
         _check(self.size >= 1, "pp.size must be >= 1")
         _check(self.num_micro_batches >= 1, "pp.num_micro_batches must be >= 1")
         _check(self.schedule in ("gpipe", "1f1b"),
                f"pp.schedule must be gpipe|1f1b, got {self.schedule}")
+        _check(self.virtual_stages >= 1, "pp.virtual_stages must be >= 1")
         if self.size > 1:
             _check(self.num_micro_batches % self.size == 0,
                    "pp.num_micro_batches must be a multiple of pp.size")
+        if self.virtual_stages > 1:
+            _check(self.schedule == "gpipe",
+                   "interleaved pipeline (virtual_stages > 1) runs under "
+                   "the gpipe schedule; 1f1b is contiguous-stage only")
+            _check(self.num_micro_batches <= self.size,
+                   "interleaved pipeline requires num_micro_batches <= "
+                   "pp.size (one resident micro-batch per device per "
+                   "tick in lockstep SPMD)")
 
 
 @dataclass

@@ -70,6 +70,9 @@ class ModelConfig:
     # batch pipeline over the 'pp' mesh axis — see parallel/pp.py
     pp_size: int = 1
     pp_num_micro: int = 1
+    # interleaved pipeline: V non-adjacent layer chunks per stage
+    # (Megatron virtual pipeline; parallel/pp.py virtual_stages)
+    pp_virtual: int = 1
     # logical-axis rule table for activation sharding constraints; None =
     # parallel.sharding.DEFAULT_RULES (accelerate() injects make_rules(cfg))
     logical_axis_rules: Optional[Tuple] = None
@@ -455,6 +458,7 @@ class TransformerLM(nn.Module):
                 x = pipeline_blocks(
                     apply_one, stacked, (x, positions, segment_ids),
                     pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
+                    virtual_stages=cfg.pp_virtual,
                     remat=cfg.remat,
                     remat_policy=(remat_policy(cfg.remat_policy)
                                   if cfg.remat else None))

