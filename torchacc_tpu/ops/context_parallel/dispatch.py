@@ -27,11 +27,20 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from torchacc_tpu.ops.attention import attention_reference
+from torchacc_tpu.ops.attention import (
+    attention_reference,
+    attention_reference_bwd,
+)
 from torchacc_tpu.ops.attn import attention
-from torchacc_tpu.ops.context_parallel.ring import ring_attention
+from torchacc_tpu.ops.context_parallel.ring import (
+    _ring_fwd_impl,
+    ring_attention_bwd,
+)
 from torchacc_tpu.ops.context_parallel.ulysses import ulysses_attention
-from torchacc_tpu.ops.flash_attention import flash_attention
+from torchacc_tpu.ops.flash_attention import (
+    flash_attention,
+    flash_attention_bwd,
+)
 
 
 def _ambient_mesh() -> Optional[Mesh]:
@@ -95,16 +104,18 @@ def cp_attention(
     qkv_spec = P(data_axes, seq_axes, tp_axis, None)
     seg_spec = P(data_axes, seq_axes)
 
-    def region(q, k, v, *rest):
+    def _unpack(rest):
         rest = list(rest)
         qseg = rest.pop(0) if has_seg else None
         kseg = rest.pop(0) if has_seg else None
         slopes_tp = rest.pop(0) if has_alibi else None  # [h_tp] local slice
         seed = rest.pop(0) if has_seed else None
-        scale = d ** -0.5
+        return qseg, kseg, slopes_tp, seed
 
-        # global offsets of this shard's rows: batch over the data axes,
-        # heads over tp (further split by the ulysses a2a below)
+    def _offsets(q, slopes_tp):
+        """Global offsets of this shard's rows (batch over the data axes,
+        heads over tp — further split by the ulysses a2a) and the
+        per-device slopes slice in the INNER (post-a2a) head layout."""
         b_loc = q.shape[0]
         b_pos = jnp.int32(0)
         for ax in data_axes:
@@ -113,29 +124,89 @@ def cp_attention(
         b_off = b_pos * b_loc
         h_tp_off = _axis_index(mesh, tp_axis) * q.shape[2]
 
-        def local_attn(q_, k_, v_, qs_, ks_):
-            h_inner = q_.shape[2]
-            # ulysses a2a gave this device head chunk [spu_idx*h_inner ...)
+        def inner_offsets(h_inner):
+            # ulysses a2a gave this device head chunk [spu_idx*h_inner ..)
             spu_idx = _axis_index(mesh, a2a_axis)
             h_off = h_tp_off + spu_idx * h_inner
             slopes = slopes_tp
             if slopes is not None and ul_n > 1:
                 slopes = jax.lax.dynamic_slice_in_dim(
                     slopes_tp, spu_idx * h_inner, h_inner)
-            if ring_n > 1:
-                return ring_attention(q_, k_, v_, qs_, ks_, slopes, seed,
-                                      h_off, b_off,
-                                      ring_axis, ring_n, causal, window,
-                                      dropout_p, inner_impl)
-            fn = (attention_reference if inner_impl == "xla"
-                  else flash_attention)
-            return fn(q_, k_, v_, causal=causal, window=window, scale=scale,
-                      q_segment_ids=qs_, kv_segment_ids=ks_,
-                      alibi_slopes=slopes, dropout_p=dropout_p,
-                      dropout_seed=seed, h_offset=h_off, b_offset=b_off)
+            return h_off, slopes
 
-        return ulysses_attention(q, k, v, qseg, kseg, a2a_axis, ul_n,
-                                 inner=local_attn)
+        return b_off, inner_offsets
+
+    scale = d ** -0.5
+
+    def region_fwd(q, k, v, *rest):
+        """Forward returning (out, o_inner, lse): the inner-layout
+        attention output and merged lse are the residuals the backward
+        consumes — no forward re-walk (the round-2 recompute debt)."""
+        qseg, kseg, slopes_tp, seed = _unpack(rest)
+        b_off, inner_offsets = _offsets(q, slopes_tp)
+
+        def local_attn(q_, k_, v_, qs_, ks_):
+            h_off, slopes = inner_offsets(q_.shape[2])
+            if ring_n > 1:
+                o, lse = _ring_fwd_impl(
+                    q_, k_, v_, qs_, ks_, slopes, seed, h_off, b_off,
+                    ring_axis, ring_n, causal, window, dropout_p,
+                    inner_impl)
+            else:
+                fn = (attention_reference if inner_impl == "xla"
+                      else flash_attention)
+                o, lse = fn(q_, k_, v_, causal=causal, window=window,
+                            scale=scale, q_segment_ids=qs_,
+                            kv_segment_ids=ks_, alibi_slopes=slopes,
+                            dropout_p=dropout_p, dropout_seed=seed,
+                            h_offset=h_off, b_offset=b_off,
+                            return_lse=True)
+            return o, (o, lse)
+
+        out, (o_in, lse) = ulysses_attention(
+            q, k, v, qseg, kseg, a2a_axis, ul_n, inner=local_attn,
+            with_aux=True)
+        return out, o_in, lse
+
+    def region_bwd(q, k, v, o_in, lse, do, *rest):
+        """Backward from saved (o_inner, lse): redo only the cheap a2a
+        layout moves, then the explicit ring/flash backward, then the
+        inverse a2a on the grads (the transpose of the forward's input
+        a2a is the forward's output a2a and vice versa)."""
+        qseg, kseg, slopes_tp, seed = _unpack(rest)
+        b_off, inner_offsets = _offsets(q, slopes_tp)
+        if ul_n > 1:
+            a2a_in = lambda x: jax.lax.all_to_all(
+                x, a2a_axis, split_axis=2, concat_axis=1, tiled=True)
+            q_, k_, v_, do_ = a2a_in(q), a2a_in(k), a2a_in(v), a2a_in(do)
+            qs_ = ks_ = None
+            if qseg is not None:
+                qs_ = jax.lax.all_gather(qseg, a2a_axis, axis=1, tiled=True)
+                ks_ = jax.lax.all_gather(kseg, a2a_axis, axis=1, tiled=True)
+        else:
+            q_, k_, v_, do_, qs_, ks_ = q, k, v, do, qseg, kseg
+
+        h_off, slopes = inner_offsets(q_.shape[2])
+        if ring_n > 1:
+            dq, dk, dv = ring_attention_bwd(
+                q_, k_, v_, qs_, ks_, slopes, seed, h_off, b_off,
+                o_in, lse, do_, axis_name=ring_axis, n=ring_n,
+                causal=causal, window=window, dropout_p=dropout_p,
+                impl=inner_impl)
+        else:
+            bwd = (attention_reference_bwd if inner_impl == "xla"
+                   else flash_attention_bwd)
+            dq, dk, dv = bwd(q_, k_, v_, o_in, lse, do_, causal=causal,
+                             window=window, scale=scale,
+                             q_segment_ids=qs_, kv_segment_ids=ks_,
+                             alibi_slopes=slopes, dropout_p=dropout_p,
+                             dropout_seed=seed, h_offset=h_off,
+                             b_offset=b_off)
+        if ul_n > 1:
+            a2a_out = lambda x: jax.lax.all_to_all(
+                x, a2a_axis, split_axis=1, concat_axis=2, tiled=True)
+            dq, dk, dv = a2a_out(dq), a2a_out(dk), a2a_out(dv)
+        return dq, dk, dv
 
     in_specs = [qkv_spec, qkv_spec, qkv_spec]
     args = [q, k, v]
@@ -151,39 +222,46 @@ def cp_attention(
     in_specs = tuple(in_specs)
 
     # The region is wrapped in a custom VJP whose backward opens a FRESH
-    # forward-only shard_map and differentiates the local computation
-    # inside it (jax.vjp of the per-shard function; the ring/ulysses
-    # collectives and the ring's own custom VJP transpose in-region).
-    # Rationale: letting autodiff transpose ACROSS the shard_map
-    # boundary mis-accumulates cotangents when this region is nested
-    # inside another manual region (the pp pipeline) — verified by
-    # pp×sp gradient divergence with the plain transpose path.  Cost:
-    # the backward re-runs the forward attention (the same price as the
-    # remat policies big-model configs already use).
+    # shard_map.  Rationale: letting autodiff transpose ACROSS the
+    # shard_map boundary mis-accumulates cotangents when this region is
+    # nested inside another manual region (the pp pipeline) — verified
+    # by pp×sp gradient divergence with the plain transpose path.  The
+    # forward saves the inner-layout (o, lse) so the backward runs the
+    # explicit ring/flash backward directly — no forward re-walk (the
+    # reference backward consumes the saved softmax_lse + out the same
+    # way, ring_attn.py:130-271).  The residuals carry the remat names
+    # (attn_ctx/attn_lse) so the save_attn* policies keep them across a
+    # jax.checkpoint boundary.
+    # o/lse cross the boundary in the INNER layout: seq sharded over the
+    # ring axis only (a2a gathered the ulysses part), heads over tp+spu.
+    o_spec = P(data_axes, ring_axis, (tp_axis, a2a_axis), None)
+    lse_spec = P(data_axes, (tp_axis, a2a_axis), ring_axis)
+
+    fwd_mapped = jax.shard_map(
+        region_fwd, mesh=mesh, in_specs=in_specs,
+        out_specs=(qkv_spec, o_spec, lse_spec), check_vma=check_vma)
+
     @jax.custom_vjp
     def core(q, k, v, *rest):
-        return jax.shard_map(
-            region, mesh=mesh, in_specs=in_specs,
-            out_specs=qkv_spec, check_vma=check_vma)(q, k, v, *rest)
+        return fwd_mapped(q, k, v, *rest)[0]
 
     def core_fwd(q, k, v, *rest):
-        return core(q, k, v, *rest), (q, k, v) + tuple(rest)
+        from jax.ad_checkpoint import checkpoint_name
+
+        out, o_in, lse = fwd_mapped(q, k, v, *rest)
+        o_in = checkpoint_name(o_in, "attn_ctx")
+        lse = checkpoint_name(lse, "attn_lse")
+        return out, (q, k, v, o_in, lse) + tuple(rest)
 
     def core_bwd(res, do):
-        q, k, v = res[:3]
-        rest = res[3:]
-
-        def region_bwd(q_l, k_l, v_l, do_l, *rest_l):
-            def f(q_, k_, v_):
-                return region(q_, k_, v_, *rest_l)
-            _, vjpf = jax.vjp(f, q_l, k_l, v_l)
-            return vjpf(do_l)
-
+        q, k, v, o_in, lse = res[:5]
+        rest = res[5:]
         dq, dk, dv = jax.shard_map(
             region_bwd, mesh=mesh,
-            in_specs=in_specs[:3] + (qkv_spec,) + in_specs[3:],
+            in_specs=in_specs[:3] + (o_spec, lse_spec, qkv_spec)
+            + in_specs[3:],
             out_specs=(qkv_spec, qkv_spec, qkv_spec),
-            check_vma=check_vma)(q, k, v, do, *rest)
+            check_vma=check_vma)(q, k, v, o_in, lse, do, *rest)
         return (dq, dk, dv) + tuple(None for _ in rest)
 
     core.defvjp(core_fwd, core_bwd)

@@ -138,6 +138,24 @@ def _ring_fwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
 def _ring_bwd(axis_name, n, causal, window, dropout_p, impl, res, do):
     (q, k, v, qseg, kseg, alibi_slopes, dropout_seed, h_offset, b_offset,
      o, lse) = res
+    dq, dk, dv = ring_attention_bwd(
+        q, k, v, qseg, kseg, alibi_slopes, dropout_seed, h_offset,
+        b_offset, o, lse, do, axis_name=axis_name, n=n, causal=causal,
+        window=window, dropout_p=dropout_p, impl=impl)
+    return dq, dk, dv, None, None, None, None, None, None
+
+
+def ring_attention_bwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
+                       h_offset, b_offset, o, lse, do, *,
+                       axis_name, n, causal, window=(-1, -1),
+                       dropout_p=0.0, impl="pallas"):
+    """Explicit ring backward from the saved merged (o, lse): (dq, dk, dv).
+
+    Exposed (like :func:`flash_attention_bwd`) so cp_attention's
+    dispatch-level custom VJP can run the backward WITHOUT re-walking
+    the forward ring — the reference backward has the same shape
+    (saved softmax_lse + out driving per-step flash bwd with reverse kv
+    rotation, ring_attn.py:130-271)."""
     b, sq, hq, d = q.shape
     me = jax.lax.axis_index(axis_name)
     scale = d ** -0.5
@@ -180,8 +198,7 @@ def _ring_bwd(axis_name, n, causal, window, dropout_p, impl, res, do):
 
     dq, dk, dv, _, _, _ = jax.lax.fori_loop(
         0, n, body, (dq0, dk0, dv0, k, v, kseg))
-    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            None, None, None, None, None, None)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 ring_attention.defvjp(_ring_fwd, _ring_bwd)

@@ -22,11 +22,15 @@ import jax.numpy as jnp
 
 def ulysses_attention(q, k, v, q_segment_ids, kv_segment_ids,
                       axis_name: str, n: int,
-                      inner: Callable):
+                      inner: Callable, with_aux: bool = False):
     """q/k/v local [b, s_loc, h, d]; returns [b, s_loc, h, d].
 
     GQA note: the all-to-all splits the head dim n ways, so kv heads must
     also be divisible by n (the reference has the same constraint).
+
+    ``with_aux``: inner returns ``(o, aux)`` and the aux (e.g. the lse
+    the dispatch-level VJP saves) is passed through in the INNER
+    (post-a2a) layout alongside the restored output.
     """
     if n == 1:
         return inner(q, k, v, q_segment_ids, kv_segment_ids)
@@ -43,7 +47,9 @@ def ulysses_attention(q, k, v, q_segment_ids, kv_segment_ids,
     if q_segment_ids is not None:
         qseg = jax.lax.all_gather(q_segment_ids, axis_name, axis=1, tiled=True)
         kseg = jax.lax.all_gather(kv_segment_ids, axis_name, axis=1, tiled=True)
-    out = inner(q_, k_, v_, qseg, kseg)
+    res = inner(q_, k_, v_, qseg, kseg)
+    out, aux = res if with_aux else (res, None)
     # inverse: scatter sequence, gather heads
-    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
+    out = jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                             tiled=True)
+    return (out, aux) if with_aux else out

@@ -25,7 +25,7 @@ def fused_linear_cross_entropy(
     w_head: jax.Array,
     labels: jax.Array,
     *,
-    chunk_rows: int = 1024,
+    chunk_rows: int = 2048,
 ) -> Tuple[jax.Array, jax.Array]:
     """(loss_sum, valid_count) of next-token CE without full logits.
 
@@ -33,6 +33,8 @@ def fused_linear_cross_entropy(
     -100 ignored.  Equivalent to ``loss_sum_count(hidden @ w_head,
     labels)`` but chunked over rows with rematerialised logits, so the
     [rows, V] buffer exists only one chunk at a time in fwd AND bwd.
+    chunk_rows=2048 measured best on v5e (1024 costs ~1.5 MFU points on
+    the 32k-vocab bench; 4096 is equal but doubles the chunk buffer).
     """
     b, s, h = hidden.shape
     v = w_head.shape[1]
