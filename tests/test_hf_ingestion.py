@@ -51,6 +51,30 @@ def test_qwen2_logits_match():
     _compare(hf_model, ids, atol=2e-4)
 
 
+def test_gemma_logits_match():
+    """Gemma v1: zero-centred (1+w) RMSNorm, tanh-GELU gated MLP,
+    sqrt(hidden)-scaled embeddings, explicit head_dim, tied head."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=1,
+        head_dim=32, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=True,
+        attn_implementation="eager")
+    torch.manual_seed(2)
+    hf_model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "gemma"
+    ids = np.random.default_rng(2).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_gemma2_rejected_with_clear_error():
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
+    with pytest.raises(NotImplementedError, match="gemma2"):
+        config_from_hf(hf_cfg)
+
+
 def test_converted_model_trains(devices):
     """Converted params drop straight into the sharded trainer."""
     import optax

@@ -59,6 +59,36 @@ def test_param_count_matches_analytic(tiny_cfg):
     assert actual == tiny_cfg.num_params()
 
 
+def test_gemma_style_model():
+    """Gemma variant features: (1+w) RMSNorm, geglu, scaled embeddings,
+    logit softcap, explicit head_dim; analytic param count stays exact
+    and the loss is finite + differentiable."""
+    cfg = get_preset("gemma-2b", dtype=jnp.float32, param_dtype=jnp.float32,
+                     vocab_size=128, hidden_size=64, num_layers=2,
+                     num_heads=4, num_kv_heads=1, head_dim=32,
+                     intermediate_size=128, max_seq_len=64,
+                     logit_softcap=30.0)
+    model = TransformerLM(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (2, 16)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    actual = sum(p.size for p in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+    # rmsnorm1p: fresh init must be zero-centred (effective scale 1)
+    assert float(jnp.abs(params["final_norm"]["scale"]).max()) == 0.0
+
+    def loss(p):
+        return loss_fn(model.apply({"params": p}, ids), ids)
+
+    l, g = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(l))
+    gnorm = sum(float(jnp.sum(x * x)) for x in jax.tree.leaves(g))
+    assert np.isfinite(gnorm) and gnorm > 0
+    # softcap bounds the logits
+    logits = model.apply({"params": params}, ids)
+    assert float(jnp.abs(logits).max()) <= 30.0
+
+
 def test_causality(tiny_cfg):
     """Changing a future token must not change past logits."""
     model = TransformerLM(tiny_cfg)

@@ -8,8 +8,10 @@ it shares torch's module system.  The TPU-native framework instead
 framework feature (FSDP/TP/PP/CP shardings, Pallas kernels, remat,
 checkpointing) applies with zero model-specific code.
 
-Supported families: Llama (1/2/3), Qwen2 (qkv bias), Mistral — the same
-set the reference patches.  GPT-2 uses the 'learned' position variant.
+Supported families: Llama (1/2/3), Qwen2 (qkv bias), Mistral (sliding
+window), Gemma v1 (1+w RMSNorm, geglu, scaled embeddings) — the
+reference's patched set (utils/patch.py:224-301) plus Gemma.  GPT-2
+uses the 'learned' position variant.
 """
 
 from __future__ import annotations
@@ -24,22 +26,36 @@ from torchacc_tpu.models.transformer import ModelConfig
 
 def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
     """ModelConfig from a transformers PretrainedConfig (llama/qwen2/
-    mistral family)."""
+    mistral/gemma family)."""
     get = lambda n, d=None: getattr(hf_config, n, d)
+    mt = get("model_type")
+    if mt in ("gemma2", "gemma3", "gemma3_text"):
+        raise NotImplementedError(
+            f"model_type {mt!r}: gemma2/3's per-layer alternation "
+            "(sliding/global attention, pre+post feedforward norms) does "
+            "not map onto the uniform scan-stacked block; gemma (v1) is "
+            "supported")
     kw = dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
         num_layers=get("num_hidden_layers"),
         num_heads=get("num_attention_heads"),
         num_kv_heads=get("num_key_value_heads", get("num_attention_heads")),
+        head_dim=get("head_dim"),
         intermediate_size=get("intermediate_size"),
         max_seq_len=get("max_position_embeddings", 4096),
         rope_theta=float(get("rope_theta", 10000.0)),
         norm_eps=float(get("rms_norm_eps", 1e-5)),
-        qkv_bias=bool(get("attention_bias", False)
-                      or get("model_type") == "qwen2"),
+        qkv_bias=bool(get("attention_bias", False) or mt == "qwen2"),
         tie_embeddings=bool(get("tie_word_embeddings", False)),
     )
+    if mt == "gemma":
+        # Gemma v1: zero-centred RMSNorm (1 + w), gated tanh-GELU MLP
+        # (gelu_pytorch_tanh), sqrt(hidden)-scaled embeddings, explicit
+        # head_dim (7b: 256 != hidden/heads), tied head
+        kw.update(norm="rmsnorm1p", activation="geglu", embed_scale=True)
+    if get("final_logit_softcapping"):
+        kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):
         kw["window"] = (int(get("sliding_window")), -1)
     kw.update(overrides)

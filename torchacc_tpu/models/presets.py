@@ -58,6 +58,26 @@ def qwen2_7b(**kw) -> ModelConfig:
     return ModelConfig(**defaults)
 
 
+def gemma_2b(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=256000, hidden_size=2048, num_layers=18,
+        num_heads=8, num_kv_heads=1, head_dim=256, intermediate_size=16384,
+        max_seq_len=8192, rope_theta=10000.0, norm="rmsnorm1p",
+        activation="geglu", embed_scale=True, tie_embeddings=True,
+        norm_eps=1e-6)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def gemma_7b(**kw) -> ModelConfig:
+    defaults = dict(vocab_size=256000, hidden_size=3072, num_layers=28,
+        num_heads=16, num_kv_heads=16, head_dim=256, intermediate_size=24576,
+        max_seq_len=8192, rope_theta=10000.0, norm="rmsnorm1p",
+        activation="geglu", embed_scale=True, tie_embeddings=True,
+        norm_eps=1e-6)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
 def mixtral_8x7b(**kw) -> ModelConfig:
     defaults = dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
@@ -73,6 +93,8 @@ PRESETS = {
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
     "qwen2-7b": qwen2_7b,
+    "gemma-2b": gemma_2b,
+    "gemma-7b": gemma_7b,
     "mixtral-8x7b": mixtral_8x7b,
 }
 

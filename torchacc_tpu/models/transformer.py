@@ -37,8 +37,17 @@ class ModelConfig:
     intermediate_size: Optional[int] = None  # None = 4x hidden (gelu) / llama rule
     max_seq_len: int = 2048
     pos_emb: str = "rope"                   # 'rope' | 'learned' | 'alibi'
-    norm: str = "rmsnorm"                   # 'rmsnorm' | 'layernorm'
-    activation: str = "swiglu"              # 'swiglu' | 'gelu'
+    # 'rmsnorm1p' is the Gemma variant: effective scale is (1 + w) with
+    # w zero-initialised (HF GemmaRMSNorm)
+    norm: str = "rmsnorm"                   # 'rmsnorm' | 'layernorm' | 'rmsnorm1p'
+    # 'geglu' is Gemma's gated tanh-GELU (gelu_pytorch_tanh on the gate)
+    activation: str = "swiglu"              # 'swiglu' | 'gelu' | 'geglu'
+    # Gemma multiplies token embeddings by sqrt(hidden_size)
+    embed_scale: bool = False
+    # Gemma2 final-logit soft-capping: logits = c * tanh(logits / c);
+    # 0 disables.  Applied in the plain head, the fused-CE head
+    # (ops/fused.py) and the 1F1B last-stage head alike.
+    logit_softcap: float = 0.0
     qkv_bias: bool = False                  # Qwen2 style
     tie_embeddings: bool = False
     rope_theta: float = 500000.0
@@ -93,7 +102,7 @@ class ModelConfig:
     def ffn_size(self) -> int:
         if self.intermediate_size is not None:
             return self.intermediate_size
-        if self.activation == "swiglu":
+        if self.activation in ("swiglu", "geglu"):
             # llama sizing: 2/3 * 4h, rounded up to a multiple of 256
             # (keeps the matmul dims MXU-tile friendly).  Pass
             # intermediate_size explicitly to pin an exact width.
@@ -109,7 +118,7 @@ class ModelConfig:
             + (self.num_heads * d) * h
         if self.qkv_bias:
             attn += (self.num_heads + 2 * self.kv_heads) * d
-        if self.activation == "swiglu":
+        if self.activation in ("swiglu", "geglu"):
             mlp = 3 * h * self.ffn_size
         else:
             mlp = 2 * h * self.ffn_size
@@ -119,6 +128,15 @@ class ModelConfig:
         norms = (2 * self.num_layers + 1) * norm_size
         out = 0 if self.tie_embeddings else v * h
         return emb + self.num_layers * (attn + mlp) + norms + out
+
+
+def softcap(logits: jax.Array, cap: float) -> jax.Array:
+    """Gemma2 logit soft-capping ``c * tanh(logits / c)``; cap <= 0 is a
+    no-op.  The single definition keeps the plain, fused-CE and 1F1B
+    heads bit-identical."""
+    if cap <= 0.0:
+        return logits
+    return jnp.tanh(logits / cap) * cap
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
@@ -146,12 +164,20 @@ class Norm(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         xf = x.astype(jnp.float32)
-        if cfg.norm == "rmsnorm":
-            scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
-                               cfg.param_dtype)
+        if cfg.norm in ("rmsnorm", "rmsnorm1p"):
+            one_p = cfg.norm == "rmsnorm1p"
+            # Gemma convention: weight stored as w, effective scale 1 + w,
+            # zero-initialised (HF GemmaRMSNorm)
+            scale = self.param(
+                "scale",
+                nn.initializers.zeros if one_p else nn.initializers.ones,
+                (x.shape[-1],), cfg.param_dtype)
             y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True)
                                    + cfg.norm_eps)
-            return (y * scale.astype(jnp.float32)).astype(cfg.dtype)
+            sf = scale.astype(jnp.float32)
+            if one_p:
+                sf = 1.0 + sf
+            return (y * sf).astype(cfg.dtype)
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
                            cfg.param_dtype)
         bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],),
@@ -307,7 +333,7 @@ class Mlp(nn.Module):
             activation_constraint,
         )
         from jax.ad_checkpoint import checkpoint_name
-        if cfg.activation == "swiglu":
+        if cfg.activation in ("swiglu", "geglu"):
             # named so 'save_attn_mlp' can save the ffn-width projections
             # (recompute becomes elementwise-only) while 'save_attn' leaves
             # them unsaved — they are the dominant activation cost
@@ -315,7 +341,10 @@ class Mlp(nn.Module):
                                    "mlp_gate_up")
             up = checkpoint_name(dense("up_proj", cfg.ffn_size)(x),
                                  "mlp_gate_up")
-            h = nn.silu(gate) * up
+            # geglu = Gemma's gelu_pytorch_tanh gate (nn.gelu default is
+            # the tanh approximation)
+            act = nn.silu if cfg.activation == "swiglu" else nn.gelu
+            h = act(gate) * up
         else:
             h = nn.gelu(checkpoint_name(dense("up_proj", cfg.ffn_size)(x),
                                         "mlp_gate_up"))
@@ -406,6 +435,10 @@ class TransformerLM(nn.Module):
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        embedding_init=nn.initializers.normal(0.02))
         x = emb(input_ids)
+        if cfg.embed_scale:
+            # Gemma: embeddings scaled by sqrt(hidden) in the compute
+            # dtype (HF casts the normalizer to the hidden dtype)
+            x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
         if cfg.pos_emb == "learned":
             pos_table = self.param(
                 "pos_embed", nn.initializers.normal(0.02),
@@ -545,7 +578,7 @@ class TransformerLM(nn.Module):
             logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               kernel_init=nn.initializers.normal(0.02))(x)
-        return logits.astype(jnp.float32)
+        return softcap(logits.astype(jnp.float32), cfg.logit_softcap)
 
 
 def loss_sum_count(logits: jax.Array, labels: jax.Array,
@@ -601,6 +634,8 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     emb_table = params["embed_tokens"]["embedding"]
     x = emb_table[input_ids].astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
     if cfg.pos_emb == "learned":
         x = x + params["pos_embed"].astype(cfg.dtype)[positions]
     if labels is None:
@@ -626,7 +661,7 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             logits = jnp.einsum(
                 "bsh,hv->bsv", xn.astype(jnp.float32),
                 hp["lm_head"]["kernel"].astype(jnp.float32))
-        return loss_sum_count(logits, lab)
+        return loss_sum_count(softcap(logits, cfg.logit_softcap), lab)
 
     riders = (positions, segment_ids)
     return pipeline_loss_1f1b(

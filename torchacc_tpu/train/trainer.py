@@ -212,8 +212,12 @@ class Trainer:
                 w_head = params["embed_tokens"]["embedding"].T
             labels = batch.get("labels", shift_labels(
                 batch["input_ids"], batch.get("segment_ids")))
+            # _use_fused_ce is gated on isinstance(model, TransformerLM),
+            # so .cfg is always present here — no defensive default that
+            # could silently drop the cap
             l_sum, count = fused_linear_cross_entropy(
-                hidden, w_head, labels)
+                hidden, w_head, labels,
+                logit_softcap=self.model.cfg.logit_softcap)
         else:
             out = self.model.apply(
                 {"params": params}, batch["input_ids"],
