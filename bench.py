@@ -230,12 +230,17 @@ def _bench(args, wd: Watchdog) -> int:
         # ~470M-param Llama-architecture model: big enough for meaningful
         # MXU utilisation, small enough for one v5e chip with Adam in f32.
         # head_dim 128 (Llama-3 standard): d=64 wastes half the MXU lanes
-        # and costs ~16 MFU points on v5e (docs/PERF.md).
+        # and costs ~16 MFU points on v5e.  scan_layers=False: unrolling
+        # the 24 layers removes the scan's saved-residual stacking
+        # (dynamic-update-slice fusions, ~21% of the scan step) — 56.2%
+        # -> 63.4% MFU measured; costs ~2 min first compile, amortised
+        # by the persistent cache (docs/PERF.md).
         seq, batch, iters = 2048, 4, args.iters or 10
         mc = get_preset(
             "llama-tiny",
             hidden_size=1024, num_layers=24, num_heads=8, num_kv_heads=8,
             intermediate_size=4096, vocab_size=32000, max_seq_len=seq,
+            scan_layers=False,
         )
     cfg = ta.Config()
     cfg.memory.gc = True

@@ -1,0 +1,75 @@
+"""One-off: does unrolling the layer loop beat scan-over-layers on the
+real chip?  The 56% profile shows ~49 ms of the 229 ms step in saved-
+residual stacking (dynamic-update-slice fusions) that only exist because
+nn.scan stacks each layer's saved residuals into [L, ...] buffers;
+an unrolled loop keeps residuals as separate buffers.
+"""
+import json, subprocess, sys, os
+os.makedirs(os.path.expanduser("~/.cache/torchacc_tpu_bench"), exist_ok=True)
+
+RUN = """
+import json, os, time
+import jax
+jax.config.update("jax_compilation_cache_dir", os.path.expanduser("~/.cache/torchacc_tpu_bench"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+import jax.numpy as jnp, numpy as np, optax
+import torchacc_tpu as ta
+from torchacc_tpu.models import get_preset
+from torchacc_tpu.train import accelerate
+pol, batch, scan = {pol!r}, {batch}, {scan}
+seq = 2048
+mc = get_preset("llama-tiny", hidden_size=1024, num_layers=24, num_heads=8,
+                num_kv_heads=8, intermediate_size=4096, vocab_size=32000,
+                max_seq_len=seq, scan_layers=scan)
+cfg = ta.Config()
+cfg.memory.gc = pol != "none"
+if pol != "none":
+    cfg.memory.gc_policy = pol
+trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
+trainer.init()
+rng = np.random.default_rng(0)
+bd = {{"input_ids": jnp.asarray(rng.integers(0, 32000, size=(batch, seq)), jnp.int32)}}
+t_c0 = time.perf_counter()
+for _ in range(3):
+    m = trainer.step(bd)
+float(m["loss"])
+compile_s = time.perf_counter() - t_c0
+iters = 10
+t0 = time.perf_counter()
+for _ in range(iters):
+    m = trainer.step(bd)
+float(m["loss"])
+dt = (time.perf_counter() - t0) / iters
+n = mc.num_params()
+fpt = 6.0 * n + 6.0 * mc.num_layers * mc.hidden_size * seq
+mfu = fpt * batch * seq / dt / 197e12
+print(json.dumps({{"pol": pol, "batch": batch, "scan": scan,
+                   "step_s": round(dt,4), "mfu": round(mfu,4),
+                   "compile_s": round(compile_s,1),
+                   "tok_s": round(batch*seq/dt,1)}}))
+"""
+
+GRID = [
+    ("save_attn_mlp", 4, True),    # baseline: 0.229 s / 56.5%
+    ("save_attn_mlp", 4, False),
+    ("save_attn", 4, False),
+    ("none", 4, False),
+    ("save_attn", 8, False),
+]
+for pol, batch, scan in GRID:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", RUN.format(pol=pol, batch=batch, scan=scan)],
+            capture_output=True, text=True, timeout=1500)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"pol": pol, "batch": batch, "scan": scan,
+                          "error": "timeout (1500s)"}), flush=True)
+        continue
+    out = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    if out:
+        print(out[-1], flush=True)
+    else:
+        err = (r.stderr or "")
+        oom = "OOM" if "Ran out of memory" in err else err[-200:].replace("\n", " | ")
+        print(json.dumps({"pol": pol, "batch": batch, "scan": scan,
+                          "error": oom}), flush=True)

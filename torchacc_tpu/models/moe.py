@@ -17,12 +17,16 @@ import jax.numpy as jnp
 
 
 class MoEMlp(nn.Module):
-    """Top-k token-choice MoE with capacity-free dense dispatch.
+    """Top-k token-choice MoE: capacity-free dense dispatch, or
+    switch-transformer capacity dispatch (``cfg.moe_capacity_factor``).
 
     For modest expert counts the dense formulation (every token scored
     against every expert, weighted-combined with a top-k mask) is both
-    exactly correct (no token dropping) and MXU-friendly.  A capacity-
-    based sparse path can replace it without changing the interface.
+    exactly correct (no token dropping) and MXU-friendly; its FLOPs
+    scale with e.  The capacity path computes only
+    ``C = ceil(cf * k * tokens / e)`` slots per expert — FLOPs
+    independent of e (the mixtral-8x7b regime) — at the cost of
+    dropping over-capacity tokens (standard switch behaviour).
     """
     cfg: object  # ModelConfig
 
@@ -41,24 +45,62 @@ class MoEMlp(nn.Module):
         logits = router(x.astype(jnp.float32))            # [b, s, e]
         weights, sel = jax.lax.top_k(logits, k)           # [b, s, k]
         weights = jax.nn.softmax(weights, axis=-1)
-        # [b, s, e] combine weights (zero for unselected experts)
-        combine = jnp.sum(
-            jax.nn.one_hot(sel, e, dtype=jnp.float32) * weights[..., None],
-            axis=-2)
 
         init = nn.initializers.normal(0.02)
         w_gate = self.param("experts/gate", init, (e, h, f), cfg.param_dtype)
         w_up = self.param("experts/up", init, (e, h, f), cfg.param_dtype)
         w_down = self.param("experts/down", init, (e, f, h), cfg.param_dtype)
-
         xd = x.astype(cfg.dtype)
-        # Dense per-expert compute; GSPMD shards the 'e' dim over the ep
-        # mesh axis, turning these einsums into expert-parallel work.
-        gate = jnp.einsum("bsh,ehf->ebsf", xd, w_gate.astype(cfg.dtype))
-        up = jnp.einsum("bsh,ehf->ebsf", xd, w_up.astype(cfg.dtype))
-        act = nn.silu(gate) * up
-        out = jnp.einsum("ebsf,efh->ebsh", act, w_down.astype(cfg.dtype))
-        y = jnp.einsum("ebsh,bse->bsh", out.astype(jnp.float32), combine)
+
+        def experts(gi, ui):
+            # shared expert FFN body: silu(gate) * up -> down
+            return jnp.einsum(
+                "e...f,efh->e...h", nn.silu(gi) * ui,
+                w_down.astype(cfg.dtype))
+
+        if cfg.moe_capacity_factor is None:
+            # -- dense dispatch: every token through every expert -------
+            combine = jnp.sum(
+                jax.nn.one_hot(sel, e, dtype=jnp.float32)
+                * weights[..., None], axis=-2)            # [b, s, e]
+            gate = jnp.einsum("bsh,ehf->ebsf", xd, w_gate.astype(cfg.dtype))
+            up = jnp.einsum("bsh,ehf->ebsf", xd, w_up.astype(cfg.dtype))
+            out = experts(gate, up)                       # [e, b, s, h]
+            y = jnp.einsum("ebsh,bse->bsh", out.astype(jnp.float32),
+                           combine)
+        else:
+            # -- capacity dispatch (switch-transformer; GSPMD lowers the
+            # dispatch/combine einsums to all-to-alls over 'ep') --------
+            n = b * s
+            cap = max(int(cfg.moe_capacity_factor * k * n / e + 0.999), 1)
+            sel_f = sel.reshape(n, k)
+            w_f = weights.reshape(n, k)
+            # position of each (token, slot) inside its expert's buffer:
+            # slots claim positions in (slot-major, token-order) priority
+            sel_1h = jax.nn.one_hot(sel_f, e, dtype=jnp.int32)  # [n, k, e]
+            # tokens assigned to expert ahead of (t, j): all slots of
+            # earlier tokens + earlier slots of this token
+            prev_tokens = jnp.cumsum(
+                jnp.sum(sel_1h, axis=1), axis=0) - jnp.sum(sel_1h, axis=1)
+            prev_slots = jnp.cumsum(sel_1h, axis=1) - sel_1h    # [n, k, e]
+            pos = jnp.sum(
+                (prev_tokens[:, None, :] + prev_slots) * sel_1h,
+                axis=-1)                                        # [n, k]
+            keep = pos < cap
+            # [n, k, e, cap] slot one-hots -> summed over k to [n, e, cap]
+            slot_1h = (jax.nn.one_hot(sel_f, e, dtype=jnp.float32)[..., None]
+                       * jax.nn.one_hot(jnp.where(keep, pos, 0), cap,
+                                        dtype=jnp.float32)[:, :, None, :]
+                       * keep[..., None, None])
+            disp = jnp.sum(slot_1h, axis=1).astype(xd.dtype)   # [n, e, cap]
+            comb = jnp.sum(slot_1h * w_f[..., None, None], axis=1)
+            ex_in = jnp.einsum("nec,nh->ech", disp, xd.reshape(n, h))
+            gate = jnp.einsum("ech,ehf->ecf", ex_in,
+                              w_gate.astype(cfg.dtype))
+            up = jnp.einsum("ech,ehf->ecf", ex_in, w_up.astype(cfg.dtype))
+            out = experts(gate, up)                            # [e, cap, h]
+            y = jnp.einsum("ech,nec->nh", out.astype(jnp.float32),
+                           comb).reshape(b, s, h)
 
         # Load-balancing auxiliary loss (switch/mixtral-style top-k)
         # exposed via sow: count all k selections per token, divided by

@@ -89,6 +89,12 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     router_aux_weight: float = 0.01   # switch-style load-balance loss weight
+    # None = exact capacity-free dense dispatch (every token through
+    # every expert — right for small e).  A float (e.g. 1.25) switches
+    # to switch-transformer capacity dispatch: per-expert buffers of
+    # ceil(cf * k * tokens / e) slots, FLOPs independent of e; tokens
+    # over capacity are dropped (combine weight 0).
+    moe_capacity_factor: Optional[float] = None
 
     @property
     def kv_heads(self) -> int:
@@ -434,16 +440,11 @@ class TransformerLM(nn.Module):
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, name="embed_tokens",
                        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                        embedding_init=nn.initializers.normal(0.02))
-        x = emb(input_ids)
-        if cfg.embed_scale:
-            # Gemma: embeddings scaled by sqrt(hidden) in the compute
-            # dtype (HF casts the normalizer to the hidden dtype)
-            x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
-        if cfg.pos_emb == "learned":
-            pos_table = self.param(
-                "pos_embed", nn.initializers.normal(0.02),
-                (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
-            x = x + pos_table.astype(cfg.dtype)[positions]
+        pos_table = (self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype)
+            if cfg.pos_emb == "learned" else None)
+        x = _embed_extras(cfg, emb(input_ids), positions, pos_table)
 
         block_cls = ScanBlock
         if _block_remat(cfg):
@@ -470,31 +471,48 @@ class TransformerLM(nn.Module):
                 # exist with the stacked layout)
                 from torchacc_tpu.parallel.pp import pipeline_blocks
                 layer_params = self.variables["params"]["layers"]
+                moe_on = cfg.num_experts > 0
                 if seeds_xs is not None:
                     # per-layer seeds ride the stacked pytree so each
                     # pp stage sees its own layers' seeds
                     stacked = {"p": layer_params, "s": seeds_xs}
-
-                    def apply_one(ps, carry):
-                        new_carry, _ = ScanBlock(cfg).apply(
-                            {"params": ps["p"]}, carry, ps["s"])
-                        return new_carry
+                    unpack = lambda ps: (ps["p"], ps["s"])
                 else:
                     stacked = layer_params
+                    unpack = lambda p: (p, None)
 
-                    def apply_one(p, carry):
-                        new_carry, _ = ScanBlock(cfg).apply({"params": p},
-                                                            carry, None)
-                        return new_carry
+                def apply_one(ps, carry):
+                    p, s = unpack(ps)
+                    if moe_on:
+                        # raw .apply drops sown intermediates unless the
+                        # collection is mutable — collect the MoE router
+                        # aux explicitly (aux_from_block below)
+                        (new_carry, _), vs = ScanBlock(cfg).apply(
+                            {"params": p}, carry, s,
+                            mutable=["intermediates"])
+                        return new_carry, _sown_aux_sum(vs)
+                    new_carry, _ = ScanBlock(cfg).apply({"params": p},
+                                                        carry, s)
+                    return new_carry
 
                 from torchacc_tpu.utils.remat import remat_policy
-                x = pipeline_blocks(
+                res = pipeline_blocks(
                     apply_one, stacked, (x, positions, segment_ids),
                     pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
                     virtual_stages=cfg.pp_virtual,
                     remat=cfg.remat,
                     remat_policy=(remat_policy(cfg.remat_policy)
-                                  if cfg.remat else None))
+                                  if cfg.remat else None),
+                    aux_from_block=moe_on)
+                if moe_on:
+                    x, aux_total = res
+                    # mean over micro-batches: the same scale a pp=1
+                    # full-batch forward sows, so the trainer's
+                    # aux_weight * aux * count term matches
+                    self.sow("intermediates", "moe_aux_loss",
+                             aux_total / cfg.pp_num_micro)
+                else:
+                    x = res
             elif split_n is not None and not self.is_initializing():
                 # split the stacked params: first remat_cnt layers run
                 # with remat semantics, the rest without (init still
@@ -505,24 +523,15 @@ class TransformerLM(nn.Module):
                 tail = jax.tree.map(lambda p: p[split_n:], layer_params)
                 cfg_off = dataclasses.replace(cfg, remat=False)
 
-                def _aux_sum(vs):
-                    # keep sow'd aux losses flowing through the raw
-                    # .apply (they would otherwise be dropped); filter by
-                    # name to match the trainer's 'aux_loss' contract
-                    paths = jax.tree_util.tree_flatten_with_path(
-                        vs.get("intermediates", {}))[0]
-                    vals = [jnp.sum(v) for path, v in paths
-                            if "aux_loss" in jax.tree_util.keystr(path)]
-                    return (sum(vals) if vals
-                            else jnp.zeros((), jnp.float32))
-
                 def apply_block(block_cfg):
                     def fn(ps, carry):
                         p, s = ps
+                        # keep sow'd aux losses flowing through the raw
+                        # .apply (they would otherwise be dropped)
                         (new_carry, _), vs = ScanBlock(block_cfg).apply(
                             {"params": p}, carry, s,
                             mutable=["intermediates"])
-                        return new_carry, _aux_sum(vs)
+                        return new_carry, _sown_aux_sum(vs)
                     return fn
 
                 apply_gc, apply_plain = apply_block(cfg), apply_block(cfg_off)
@@ -609,9 +618,40 @@ def loss_fn(logits: jax.Array, labels: jax.Array,
     return total / jnp.maximum(count, 1.0)
 
 
+def _embed_extras(cfg: ModelConfig, x: jax.Array, positions: jax.Array,
+                  pos_table) -> jax.Array:
+    """Shared embedding front-end conventions (Gemma sqrt(hidden) scale
+    in the compute dtype, learned position add) — one definition so the
+    1F1B raw-params path cannot drift from TransformerLM.__call__."""
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + pos_table.astype(cfg.dtype)[positions]
+    return x
+
+
+def _micro_seed(base, micro_idx):
+    """Decorrelate dropout across pipeline micro-batches (a different odd
+    constant than _layer_seed's so layer/micro mixes cannot collide)."""
+    b = jnp.asarray(base, jnp.int32).astype(jnp.uint32)
+    m = jnp.asarray(micro_idx, jnp.int32).astype(jnp.uint32)
+    return (b + m * jnp.uint32(0x85EBCA6B)).astype(jnp.int32)
+
+
+def _sown_aux_sum(vs) -> jax.Array:
+    """Sum every sown '*aux_loss*' intermediate (MoE router load-balance,
+    models/moe.py) out of a raw .apply's mutated variables."""
+    paths = jax.tree_util.tree_flatten_with_path(
+        vs.get("intermediates", {}))[0]
+    vals = [jnp.sum(v) for path, v in paths
+            if "aux_loss" in jax.tree_util.keystr(path)]
+    return sum(vals) if vals else jnp.zeros((), jnp.float32)
+
+
 def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
                               positions=None, segment_ids=None,
-                              labels=None, pp_axis: str = "pp"):
+                              labels=None, pp_axis: str = "pp",
+                              dropout_seed=None, use_fused_ce=False):
     """(loss_sum, count) for a zoo model under the 1F1B pipeline schedule.
 
     The 1F1B schedule (parallel/pp.py pipeline_loss_1f1b; reference
@@ -623,8 +663,19 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     runs outside the region, replicated over 'pp', exactly like the
     GPipe path; gradients flow into it through the pipeline's dx.
 
-    Not yet composed with attention dropout or MoE aux losses (both
-    raise at config validation).
+    Compositions:
+
+    - ``use_fused_ce``: the last-stage head runs the chunked fused
+      linear+CE (ops/fused.py) instead of materialising [mb, s, V] f32
+      logits — the same memory win the non-PP trainer gets.
+    - ``dropout_seed``: attention dropout inside the schedule.  Each
+      micro-batch's seed rides the ppermute ring with its activations
+      (so the B sub-tick's recompute regenerates the identical mask),
+      mixed per micro (_micro_seed) and per layer (_layer_seed).
+    - MoE: per-stage router aux losses fold into the loss with
+      per-micro weights ``router_aux_weight * count_m`` — the same
+      convention as the trainer's gradient-accumulation loop (each
+      micro weighted by its valid-token count).
     """
     from torchacc_tpu.parallel.pp import pipeline_loss_1f1b
     from torchacc_tpu.train.trainer import shift_labels
@@ -633,11 +684,8 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     emb_table = params["embed_tokens"]["embedding"]
-    x = emb_table[input_ids].astype(cfg.dtype)
-    if cfg.embed_scale:
-        x = x * jnp.asarray(cfg.hidden_size ** 0.5, cfg.dtype)
-    if cfg.pos_emb == "learned":
-        x = x + params["pos_embed"].astype(cfg.dtype)[positions]
+    x = _embed_extras(cfg, emb_table[input_ids].astype(cfg.dtype),
+                      positions, params.get("pos_embed"))
     if labels is None:
         labels = shift_labels(input_ids, segment_ids)
 
@@ -648,22 +696,58 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     else:
         head_params["lm_head"] = params["lm_head"]
 
-    def apply_block(p, carry):
-        new_carry, _ = ScanBlock(cfg).apply({"params": p}, carry, None)
-        return new_carry
+    M = cfg.pp_num_micro
+    dropout_on = cfg.attn_dropout > 0.0 and dropout_seed is not None
+    moe_on = cfg.num_experts > 0
+
+    riders = (positions, segment_ids)
+    layer_xs = None
+    if dropout_on:
+        # seed rider: every row of micro-batch m carries _micro_seed(m);
+        # the pipeline's [B] -> [M, mb] reshape makes it per-micro
+        micro_of_row = jnp.arange(b, dtype=jnp.int32) // max(b // M, 1)
+        riders = riders + (_micro_seed(dropout_seed, micro_of_row),)
+        layer_xs = jnp.arange(cfg.num_layers, dtype=jnp.int32)
+
+    aux_scale = None
+    if moe_on:
+        labels_m = labels.reshape((M, b // M) + labels.shape[1:])
+        count_m = jnp.sum(labels_m != -100, axis=(1, 2)).astype(jnp.float32)
+        aux_scale = cfg.router_aux_weight * count_m
+
+    def apply_block(p, carry, layer_idx=None):
+        if dropout_on:
+            inner, seed_row = carry[:-1], carry[-1]
+            seed = _layer_seed(seed_row[0], layer_idx)
+        else:
+            inner, seed = carry, None
+        if moe_on:
+            (new_c, _), vs = ScanBlock(cfg).apply(
+                {"params": p}, inner, seed, mutable=["intermediates"])
+            aux = _sown_aux_sum(vs)
+        else:
+            new_c, _ = ScanBlock(cfg).apply({"params": p}, inner, seed)
+            aux = None
+        if dropout_on:
+            new_c = tuple(new_c) + (seed_row,)
+        return (new_c, aux) if moe_on else new_c
 
     def head_loss(hp, y, lab):
         xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bsh,vh->bsv", xn.astype(jnp.float32),
-                                hp["embed"].astype(jnp.float32))
-        else:
-            logits = jnp.einsum(
-                "bsh,hv->bsv", xn.astype(jnp.float32),
-                hp["lm_head"]["kernel"].astype(jnp.float32))
+        w = (hp["embed"].T if cfg.tie_embeddings
+             else hp["lm_head"]["kernel"])
+        if use_fused_ce:
+            from torchacc_tpu.ops.fused import fused_linear_cross_entropy
+            # scan_free: this runs inside the last-stage lax.cond, where
+            # a lax.scan's WhileThunk would desynchronize XLA:CPU's
+            # collective rendezvous (see ops/fused.py docstring)
+            return fused_linear_cross_entropy(
+                xn, w, lab, logit_softcap=cfg.logit_softcap,
+                scan_free=True)
+        logits = jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
+                            w.astype(jnp.float32))
         return loss_sum_count(softcap(logits, cfg.logit_softcap), lab)
 
-    riders = (positions, segment_ids)
     return pipeline_loss_1f1b(
         apply_block, head_loss, stacked, head_params, x, riders, labels,
-        cfg.pp_size, cfg.pp_num_micro, pp_axis)
+        layer_xs, aux_scale, cfg.pp_size, M, pp_axis, moe_on)

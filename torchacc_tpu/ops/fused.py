@@ -27,6 +27,7 @@ def fused_linear_cross_entropy(
     *,
     chunk_rows: int = 2048,
     logit_softcap: float = 0.0,
+    scan_free: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """(loss_sum, valid_count) of next-token CE without full logits.
 
@@ -38,6 +39,13 @@ def fused_linear_cross_entropy(
     the 32k-vocab bench; 4096 is equal but doubles the chunk buffer).
     ``logit_softcap`` > 0 applies Gemma2's c * tanh(logits / c) before
     the loss.
+
+    ``scan_free=True`` unrolls the chunk loop (python loop over
+    ``jax.checkpoint``-ed chunks instead of ``lax.scan``).  Required
+    when this runs inside a branch only SOME devices take — the 1F1B
+    last-stage ``lax.cond`` — because the scan's WhileThunk
+    desynchronizes XLA:CPU's in-process collective rendezvous.  Same
+    math, same per-chunk memory profile; only the loop is unrolled.
     """
     b, s, h = hidden.shape
     v = w_head.shape[1]
@@ -45,6 +53,23 @@ def fused_linear_cross_entropy(
     x = hidden.reshape(n, h)
     y = labels.reshape(n)
 
+    if scan_free:
+        # no pad either: the pad+concat of a data-sharded array inside
+        # the cond is another resharding-collective source.  Pick the
+        # largest chunk size <= chunk_rows that divides n exactly (n =
+        # micro_batch * seq is essentially always highly composite).
+        import math
+        chunks_needed = -(-n // chunk_rows)
+        for c in range(chunks_needed, 4 * chunks_needed + 1):
+            if n % c == 0:
+                chunk_rows = n // c
+                break
+        else:
+            raise ValueError(
+                f"fused CE scan_free: no divisor of n={n} rows gives "
+                f"chunks in [{chunks_needed}, {4 * chunks_needed}] — pick "
+                f"a micro-batch-rows count divisible near chunk_rows="
+                f"{chunk_rows}")
     pad = (-n) % chunk_rows
     if pad:
         x = jnp.concatenate(
@@ -74,6 +99,14 @@ def fused_linear_cross_entropy(
     # remat: backward recomputes each chunk's logits instead of saving them
     one_chunk = jax.checkpoint(one_chunk,
                                policy=jax.checkpoint_policies.nothing_saveable)
+
+    if scan_free:
+        loss_sum = jnp.zeros((), jnp.float32)
+        count = jnp.zeros((), jnp.float32)
+        for i in range(chunks):
+            l, c = one_chunk(xc[i], yc[i])
+            loss_sum, count = loss_sum + l, count + c
+        return loss_sum, count
 
     def body(carry, xy):
         l_acc, c_acc = carry

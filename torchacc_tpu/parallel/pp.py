@@ -60,7 +60,8 @@ def pipeline_blocks(
     remat: bool = True,
     remat_policy: Optional[Any] = None,
     virtual_stages: int = 1,
-) -> jax.Array:
+    aux_from_block: bool = False,
+):
     """Run a stacked layer stack as a pp-stage pipeline.
 
     apply_block(layer_params, carry) -> carry applies ONE layer; carry is
@@ -68,6 +69,12 @@ def pipeline_blocks(
     remaining elements (positions, segment ids, ...) ride along unchanged.
     stacked_params leaves have leading dim num_layers (sharded over 'pp').
     Returns the final activation [B, S, H].
+
+    ``aux_from_block=True``: apply_block returns ``(carry, aux_scalar)``
+    (MoE router aux losses, which a raw in-region ``.apply`` would
+    otherwise silently drop); bubble-tick garbage is masked out and the
+    function returns ``(activation, aux_total)`` with aux_total the sum
+    over every (layer, micro-batch) pair.
 
     ``virtual_stages=V > 1`` is the interleaved schedule (reference
     gap: Megatron-style virtual pipeline, VERDICT missing-2): device d
@@ -133,11 +140,13 @@ def pipeline_blocks(
 
         def stage(chunk_params, carry):
             def one(c, p):
-                return apply_block(p, c), None
+                if aux_from_block:
+                    return apply_block(p, c)
+                return apply_block(p, c), jnp.zeros((), jnp.float32)
             body = (jax.checkpoint(one, policy=remat_policy)
                     if remat else one)
-            carry, _ = jax.lax.scan(body, carry, chunk_params)
-            return carry
+            carry, auxs = jax.lax.scan(body, carry, chunk_params)
+            return carry, jnp.sum(auxs)
 
         # Feed micro-batches as scan xs (padded with T-M dead ticks) and
         # bank outputs as scan ys.  Riders (positions/segment ids)
@@ -162,7 +171,8 @@ def pipeline_blocks(
                                                              a.dtype), c)
                             for c in micro_local)
 
-        def tick(cur, xs):
+        def tick(state, xs):
+            cur, aux_acc = state
             t, fed = xs
             # stage 0 ingests the fresh micro-batch while any remain;
             # others (and device 0 on later ring laps, when V > 1) use
@@ -179,47 +189,61 @@ def pipeline_blocks(
             # permute against other subgroup collectives and abort the
             # in-process communicator (see the rider note above).
             if V == 1:
+                c_idx = jnp.zeros((), jnp.int32)
                 chunk_params = jax.tree.map(lambda a: a[0], params_me)
             else:
                 c_idx = jnp.clip((t - me) // Pn, 0, V - 1)
                 chunk_params = jax.tree.map(
                     lambda a: jax.lax.dynamic_index_in_dim(
                         a, c_idx, 0, keepdims=False), params_me)
-            out_carry = stage(chunk_params,
-                              (inj[0].astype(compute_dtype),)
-                              + tuple(inj[1:]))
+            out_carry, aux = stage(chunk_params,
+                                   (inj[0].astype(compute_dtype),)
+                                   + tuple(inj[1:]))
+            # bubble ticks compute garbage that is never collected — the
+            # same must hold for aux: the resident micro m = t - me -
+            # c*P is real iff it lands in [0, M)
+            m_resident = t - me - c_idx * Pn
+            live = jnp.logical_and(t - me >= 0,
+                                   jnp.logical_and(m_resident >= 0,
+                                                   m_resident < M))
+            aux_acc = aux_acc + jnp.where(live, aux, 0.0)
             handoff = (out_carry[0].astype(wire_dtype),) + tuple(inj[1:])
             nxt = jax.tree.map(
                 lambda a: jax.lax.ppermute(
                     a, pp_axis, [(j, (j + 1) % Pn) for j in range(Pn)]),
                 handoff)
-            return nxt, out_carry[0]
+            return (nxt, aux_acc), out_carry[0]
 
-        _, ys = jax.lax.scan(tick, zeros_carry, (jnp.arange(T), feed),
-                             length=T)
+        (_, aux_local), ys = jax.lax.scan(
+            tick, (zeros_carry, jnp.zeros((), jnp.float32)),
+            (jnp.arange(T), feed), length=T)
         # ticks V*P-1 .. T-1 on the last stage's last chunk hold
         # micro-batches 0..M-1
         outs = ys[V * Pn - 1:]
         outs = jax.lax.psum(
             jnp.where(me == Pn - 1, outs.astype(wire_dtype),
                       jnp.zeros_like(outs, wire_dtype)), pp_axis)
-        return outs.reshape((B,) + outs.shape[2:])
+        return (outs.reshape((B,) + outs.shape[2:]),
+                jax.lax.psum(aux_local, pp_axis))
 
-    out = jax.shard_map(
+    out, aux_total = jax.shard_map(
         region, mesh=mesh,
         in_specs=(param_spec,) + data_spec,
-        out_specs=P(),
+        out_specs=(P(), P()),
         check_vma=False,
         axis_names=frozenset({pp_axis}),
     )(staged, *micro)
-    return out.astype(x.dtype)
+    out = out.astype(x.dtype)
+    if aux_from_block:
+        return out, aux_total
+    return out
 
 # ---------------------------------------------------------------------------
 # 1F1B (PipeDreamFlush) schedule
 # ---------------------------------------------------------------------------
 
 def pipeline_train_1f1b(
-    apply_block: Callable[[Any, Tuple], Tuple],
+    apply_block: Callable[..., Tuple],
     head_loss: Callable[[Any, jax.Array, jax.Array], Tuple[jax.Array, jax.Array]],
     stacked_params: Any,
     head_params: Any,
@@ -231,6 +255,9 @@ def pipeline_train_1f1b(
     pp_axis: str = "pp",
     mesh: Optional[Mesh] = None,
     remat_policy: Optional[Any] = None,
+    layer_xs: Any = None,
+    aux_from_block: bool = False,
+    aux_scale: Optional[jax.Array] = None,
 ):
     """One-forward-one-backward pipeline TRAIN step (loss + grads).
 
@@ -258,6 +285,19 @@ def pipeline_train_1f1b(
     Returns ``(loss_sum, count), (d_stacked, d_head, d_x)`` where d_x is
     the cotangent of ``carry_in[0]``.  Use :func:`pipeline_loss_1f1b`
     for a differentiable loss.
+
+    Composition hooks (all optional, default = the plain schedule):
+
+    - ``layer_xs``: pytree with leading dim num_layers of NON-DIFF
+      per-layer inputs (e.g. attention-dropout layer seeds).  When given,
+      ``apply_block(p, carry, xs_l)`` receives its layer's slice.
+    - ``aux_from_block=True``: ``apply_block`` returns ``(carry, aux)``
+      with ``aux`` a scalar auxiliary loss (MoE router load-balance).
+      Each micro-batch's per-stage aux sum is folded into ``loss_sum``
+      weighted by ``aux_scale[m]`` (caller precomputes e.g.
+      ``router_aux_weight * valid_token_count(micro m)`` — computable
+      upfront because it depends only on labels), and the same weight is
+      the aux cotangent in the B sub-tick so gradients stay exact.
     """
     mesh = mesh or _ambient_mesh()
     x = carry_in[0]
@@ -276,6 +316,12 @@ def pipeline_train_1f1b(
 
     staged = jax.tree.map(
         lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), stacked_params)
+    staged_xs = (None if layer_xs is None else jax.tree.map(
+        lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), layer_xs))
+    # per-micro aux weights (see docstring); zeros when aux is off so the
+    # traced structure is uniform
+    scale_m = (jnp.zeros((M,), jnp.float32) if aux_scale is None
+               else aux_scale.astype(jnp.float32))
     compute_dtype = x.dtype
     # activation handoffs in the compute dtype on TPU (f32 only where
     # the CPU backend requires it — see _boundary_needs_f32); gradient
@@ -287,29 +333,64 @@ def pipeline_train_1f1b(
         lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in_f)
     labels_micro = labels.reshape((M, mb) + labels.shape[1:])
 
+    # Pin the data-axis sharding to the MICRO dim (or replicate): if
+    # GSPMD instead shards the per-micro ROW dim (it does when M is not
+    # divisible by the data extent, e.g. M=2 on a dp=4 mesh), every
+    # cross-row reduction in the last-stage head lands INSIDE the
+    # me-dependent lax.cond, and collectives inside a branch only some
+    # pp ranks take deadlock the runtime (XLA:CPU aborts its in-process
+    # communicator; a real TPU would stall the same way).  Lockstep
+    # SPMD means each tick's micro-batch is gathered to every data
+    # replica anyway, so this costs nothing extra.
+    data_axes = tuple(a for a in ("dp", "fsdp")
+                      if mesh is not None and a in mesh.shape)
+    ext = 1
+    for a in data_axes:
+        ext *= mesh.shape[a]
+    if ext > 1:
+        dim0 = data_axes if M % ext == 0 else None
+
+        def _pin(a):
+            return jax.lax.with_sharding_constraint(
+                a, P(dim0, *([None] * (a.ndim - 1))))
+
+        micro = jax.tree.map(_pin, micro)
+        labels_micro = _pin(labels_micro)
+
     param_spec = jax.tree.map(lambda _: P(pp_axis), staged)
     data_spec = tuple(P() for _ in micro)
     head_spec = jax.tree.map(lambda _: P(), head_params)
 
-    def region(params_local, head_p, labels_m, *micro_local):
+    def region(params_local, head_p, xs_local, labels_m, *micro_local):
         params_me = jax.tree.map(lambda a: a[0], params_local)  # [L/P, ...]
         me = jax.lax.axis_index(pp_axis)
+        xs_me = (jnp.zeros((per_stage,), jnp.int32) if xs_local is None
+                 else jax.tree.map(lambda a: a[0], xs_local))
+
+        def call_block(pl, c, xl):
+            out = (apply_block(pl, c, xl) if layer_xs is not None
+                   else apply_block(pl, c))
+            if aux_from_block:
+                return out
+            return out, jnp.zeros((), jnp.float32)
+
+        def one(c, pxs):
+            pl, xl = pxs
+            return call_block(pl, c, xl)
 
         def stage(p, carry):
-            def one(c, pl):
-                return apply_block(pl, c), None
-            return jax.lax.scan(one, carry, p)[0]
+            carry, auxs = jax.lax.scan(one, carry, (p, xs_me))
+            return carry, jnp.sum(auxs)
 
         def stage_remat(p, carry):
             # B sub-tick: per-LAYER remat, so the vjp's scan residuals
             # are the small inter-layer carries, not every layer's
             # attention internals stacked [L/P, ...] at once (that stack
             # is what would erase 1F1B's memory win)
-            def one(c, pl):
-                return apply_block(pl, c), None
             body = jax.checkpoint(one, policy=remat_policy,
                                   prevent_cse=False)
-            return jax.lax.scan(body, carry, p)[0]
+            carry, auxs = jax.lax.scan(body, carry, (p, xs_me))
+            return carry, jnp.sum(auxs)
 
         def _pad_to_T(c):
             return jax.tree.map(
@@ -353,10 +434,17 @@ def pipeline_train_1f1b(
             x_in = jax.tree.map(
                 lambda f, h: jnp.where(me == 0, f, h), fed, f_hand)
 
+            # per-micro aux weight for this tick's F and B micro indices
+            f_scale = jax.lax.dynamic_index_in_dim(
+                scale_m, jnp.clip(f_idx, 0, M - 1), 0, keepdims=False)
+            b_scale = jax.lax.dynamic_index_in_dim(
+                scale_m, jnp.clip(b_idx, 0, M - 1), 0, keepdims=False)
+
             # ---- F sub-tick (head+loss fused on the last stage) ----
             def do_f(_):
                 cin = (x_in[0].astype(compute_dtype),) + tuple(x_in[1:])
-                y = stage(params_me, cin)[0].astype(wire_dtype)
+                carry_out, aux = stage(params_me, cin)
+                y = carry_out[0].astype(wire_dtype)
 
                 def last(_):
                     (ls, cnt), hvjp = jax.vjp(
@@ -378,7 +466,7 @@ def pipeline_train_1f1b(
 
                 ls, cnt, dhp, dy = jax.lax.cond(me == Pn - 1, last, mid,
                                                 None)
-                return y, ls, cnt, dhp, dy
+                return y, ls + f_scale * aux, cnt, dhp, dy
 
             def no_f(_):
                 return (jnp.zeros_like(x_in[0]), jnp.zeros((), jnp.float32),
@@ -416,10 +504,13 @@ def pipeline_train_1f1b(
 
                 def f_of(p, xact):
                     cin = (xact.astype(compute_dtype),) + riders
-                    return stage_remat(p, cin)[0].astype(jnp.float32)
+                    carry_out, aux = stage_remat(p, cin)
+                    return carry_out[0].astype(jnp.float32), aux
 
                 _, vjp = jax.vjp(f_of, params_me, saved[0])
-                dpl, dxl = vjp(dy_in)
+                # the aux cotangent is the same per-micro weight the F
+                # sub-tick folded into loss_sum — grads stay exact
+                dpl, dxl = vjp((dy_in, b_scale))
                 return (jax.tree.map(lambda a: a.astype(jnp.float32), dpl),
                         dxl.astype(jnp.float32))
 
@@ -469,13 +560,14 @@ def pipeline_train_1f1b(
                  jax.tree.map(lambda _: P(pp_axis), staged),
                  jax.tree.map(lambda _: P(), head_params),
                  P())
+    xs_spec = jax.tree.map(lambda _: P(pp_axis), staged_xs)
     loss_sum, count, dstaged, dhead, dx_micro = jax.shard_map(
         region, mesh=mesh,
-        in_specs=(param_spec, head_spec, P()) + data_spec,
+        in_specs=(param_spec, head_spec, xs_spec, P()) + data_spec,
         out_specs=out_specs,
         check_vma=False,
         axis_names=frozenset({pp_axis}),
-    )(staged, head_params, labels_micro, *micro)
+    )(staged, head_params, staged_xs, labels_micro, *micro)
 
     # cotangent dtypes must match the primals' (custom_vjp contract)
     d_stacked = jax.tree.map(
@@ -487,37 +579,44 @@ def pipeline_train_1f1b(
     return (loss_sum, count), (d_stacked, dhead, dx)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 9, 10, 11, 12))
 def pipeline_loss_1f1b(apply_block, head_loss, stacked_params, head_params,
-                       x, riders, labels, pp_size, num_micro, pp_axis="pp"):
+                       x, riders, labels, layer_xs, aux_scale,
+                       pp_size, num_micro, pp_axis="pp",
+                       aux_from_block=False):
     """Differentiable (loss_sum, count) via the 1F1B schedule: the
     schedule already computed the grads during the forward, so the VJP
     just scales them by the loss cotangent (they are linear in it).
-    ``riders`` (positions, segment ids, ...) are non-differentiable."""
+    ``riders`` (positions, segment ids, ...), ``layer_xs`` (per-layer
+    seeds) and ``aux_scale`` (per-micro aux weights) are
+    non-differentiable."""
     (loss_sum, count), _ = pipeline_train_1f1b(
         apply_block, head_loss, stacked_params, head_params,
         (x,) + tuple(riders), labels, pp_size=pp_size,
-        num_micro=num_micro, pp_axis=pp_axis)
+        num_micro=num_micro, pp_axis=pp_axis, layer_xs=layer_xs,
+        aux_from_block=aux_from_block, aux_scale=aux_scale)
     return loss_sum, count
 
 
 def _pl1f1b_fwd(apply_block, head_loss, stacked_params, head_params,
-                x, riders, labels, pp_size, num_micro, pp_axis="pp"):
+                x, riders, labels, layer_xs, aux_scale,
+                pp_size, num_micro, pp_axis="pp", aux_from_block=False):
     (loss_sum, count), grads = pipeline_train_1f1b(
         apply_block, head_loss, stacked_params, head_params,
         (x,) + tuple(riders), labels, pp_size=pp_size,
-        num_micro=num_micro, pp_axis=pp_axis)
+        num_micro=num_micro, pp_axis=pp_axis, layer_xs=layer_xs,
+        aux_from_block=aux_from_block, aux_scale=aux_scale)
     return (loss_sum, count), grads
 
 
 def _pl1f1b_bwd(apply_block, head_loss, pp_size, num_micro, pp_axis,
-                res, ct):
+                aux_from_block, res, ct):
     d_stacked, dhead, dx = res
     dls = ct[0]  # count is parameter-independent
     scale = lambda tree: jax.tree.map(
         lambda a: a * dls.astype(a.dtype), tree)
     return (scale(d_stacked), scale(dhead), dx * dls.astype(dx.dtype),
-            None, None)
+            None, None, None, None)
 
 
 pipeline_loss_1f1b.defvjp(_pl1f1b_fwd, _pl1f1b_bwd)

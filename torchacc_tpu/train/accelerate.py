@@ -78,16 +78,6 @@ def accelerate(
         else config.compute.matmul_precision)
     if isinstance(model, ModelConfig):
         model = TransformerLM(apply_config_to_model(model, config))
-        mc = model.cfg
-        if config.dist.pp.size > 1 and config.dist.pp.schedule == "1f1b":
-            if mc.attn_dropout:
-                raise ValueError(
-                    "pp.schedule='1f1b' does not compose with "
-                    "attn_dropout yet — use the gpipe schedule")
-            if mc.num_experts:
-                raise ValueError(
-                    "pp.schedule='1f1b' does not propagate MoE aux "
-                    "losses yet — use the gpipe schedule")
     trainer = Trainer(model, config, optimizer=optimizer, **trainer_kwargs)
     loader = None
     if dataloader is not None:

@@ -29,13 +29,6 @@ from torchacc_tpu.train.state import TrainState, init_train_state, state_logical
 from torchacc_tpu.utils.logger import logger
 
 
-def _flatten_with_names(tree):
-    from jax.tree_util import tree_flatten_with_path
-    flat, _ = tree_flatten_with_path(tree)
-    return [("/".join(str(getattr(k, "key", k)) for k in path), v)
-            for path, v in flat]
-
-
 def shift_labels(input_ids: jax.Array,
                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Next-token labels from input_ids (last position ignored).
@@ -194,7 +187,10 @@ class Trainer:
                 self.model.cfg, params, batch["input_ids"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
-                labels=batch.get("labels"))
+                labels=batch.get("labels"),
+                dropout_seed=(dropout_seed if self._attn_dropout_on
+                              else None),
+                use_fused_ce=self._use_fused_ce)
         extra = {}
         if dropout_seed is not None and self._attn_dropout_on:
             extra["dropout_seed"] = dropout_seed
@@ -231,10 +227,8 @@ class Trainer:
             else:
                 l_sum, count = res, jnp.asarray(1.0, jnp.float32)
         if self._aux_weight:
-            aux = sum(jnp.sum(jnp.asarray(v)) for path, v in
-                      _flatten_with_names(mutated.get("intermediates", {}))
-                      if "aux_loss" in path)
-            l_sum = l_sum + self._aux_weight * aux * count
+            from torchacc_tpu.models.transformer import _sown_aux_sum
+            l_sum = l_sum + self._aux_weight * _sown_aux_sum(mutated) * count
         return l_sum, count
 
     def _build_train_step(self, sample_batch):
