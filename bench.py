@@ -45,6 +45,13 @@ _PEAK = {
 _METRIC = "llama350m_train_mfu"
 _T0 = time.monotonic()
 
+# Last-known-good cache: every successful run rewrites this file; a failed
+# run (e.g. TPU transport outage, the round-1/round-2 failure mode) surfaces
+# its contents — clearly labeled as a cached prior result — inside the error
+# JSON so the driver still records a verifiable number + profile pointer.
+_LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "docs", "last_good_bench.json")
+
 
 def _emit(result: dict) -> None:
     """The one stdout JSON line the driver records."""
@@ -52,13 +59,64 @@ def _emit(result: dict) -> None:
     sys.stdout.flush()
 
 
+def _read_last_good() -> dict | None:
+    try:
+        with open(_LAST_GOOD) as f:
+            return json.load(f)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _write_last_good(result: dict) -> None:
+    import datetime
+    import subprocess
+    rec = dict(result)
+    rec["captured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    try:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        r = subprocess.run(["git", "rev-parse", "HEAD"], capture_output=True,
+                           text=True, cwd=repo, timeout=10)
+        if r.returncode == 0 and r.stdout.strip():
+            commit = r.stdout.strip()
+            d = subprocess.run(["git", "status", "--porcelain"],
+                               capture_output=True, text=True, cwd=repo,
+                               timeout=10)
+            if d.returncode == 0 and d.stdout.strip():
+                commit += "-dirty"
+            rec["git_commit"] = commit
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        with open(_LAST_GOOD, "w") as f:
+            json.dump(rec, f, indent=1)
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] could not write last_good cache: {e}",
+              file=sys.stderr)
+
+
 def _fail(error: str, stage: str) -> None:
-    _emit({
+    out = {
         "metric": _METRIC, "value": 0.0, "unit": "mfu_fraction",
         "vs_baseline": 0.0,
         "error": error, "stage": stage,
         "elapsed_s": round(time.monotonic() - _T0, 1),
-    })
+    }
+    lg = _read_last_good()
+    if lg is not None:
+        # NOT this run's measurement: a prior successful capture on the same
+        # hardware, kept because the remote-TPU transport is flaky.
+        out["last_good"] = {
+            "note": ("cached prior successful run — NOT this invocation; "
+                     "see docs/last_good_bench.json in-repo"),
+            "value": lg.get("value"),
+            "unit": lg.get("unit"),
+            "vs_baseline": lg.get("vs_baseline"),
+            "captured_at": lg.get("captured_at"),
+            "git_commit": lg.get("git_commit"),
+            "detail": lg.get("detail"),
+        }
+    _emit(out)
 
 
 class Watchdog:
@@ -130,25 +188,32 @@ def _discover_devices(wd: Watchdog, retries: int, platform: str | None):
     succeeds does the parent initialise its own backend (watchdogged; a
     hang at that point exits loudly via the watchdog).
     """
+    import random
     import subprocess
 
     force = (f"jax.config.update('jax_platforms', {platform!r})"
              if platform else "")
     last = "unknown"
+    # Short probes, many retries: a flaky transport is likelier to be caught
+    # by ten ~25s windows spread over ~4 min than by three 120s windows
+    # back-to-back (the round-2 capture burned its whole budget on 3 hangs).
+    # The first attempt gets a longer window for cold import + remote client
+    # handshake; a hung transport fails it just as loudly.
     for attempt in range(retries):
-        wd.stage(f"device_probe[{attempt}]", 150)
+        probe_timeout = 60 if attempt == 0 else 25
+        wd.stage(f"device_probe[{attempt}]", probe_timeout + 20)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SRC.format(force=force)],
-                capture_output=True, text=True, timeout=120)
+                capture_output=True, text=True, timeout=probe_timeout)
             if r.returncode == 0:
                 break
             last = (r.stderr or r.stdout).strip()[-300:]
         except subprocess.TimeoutExpired:
-            last = "probe subprocess hung (120s) — transport down"
+            last = f"probe subprocess hung ({probe_timeout}s) — transport down"
         print(f"[bench] device attempt {attempt} failed: {last}",
               file=sys.stderr)
-        time.sleep(min(10 * (attempt + 1), 30))
+        time.sleep(random.uniform(2.0, 4.0 + attempt))
     else:
         raise RuntimeError(
             f"backend unavailable after {retries} attempts: {last}")
@@ -179,7 +244,7 @@ def main() -> int:
                     help="seconds allowed for jit compile + first step")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) for debugging")
-    ap.add_argument("--retries", type=int, default=3)
+    ap.add_argument("--retries", type=int, default=10)
     ap.add_argument("--profile", default=None,
                     help="directory to write a jax.profiler trace of the "
                          "timed iterations")
@@ -284,7 +349,7 @@ def _bench(args, wd: Watchdog) -> int:
     flops_per_token = 6.0 * n_params + 6.0 * mc.num_layers * mc.hidden_size * seq
     mfu = flops_per_token * tokens / dt / (peak_flops(dev) * n_chips)
 
-    _emit({
+    result = {
         "metric": _METRIC,
         "value": round(float(mfu), 4),
         "unit": "mfu_fraction",
@@ -298,9 +363,15 @@ def _bench(args, wd: Watchdog) -> int:
             "chip": getattr(dev, "device_kind", str(dev)),
             "n_chips": n_chips,
             "fast": bool(args.fast),
+            "profile": args.profile,
             "wall_s": round(time.monotonic() - _T0, 1),
         },
-    })
+    }
+    # cache as last-known-good so a later transport outage can still surface
+    # a verifiable number (full runs only: --fast shapes aren't the headline)
+    if not args.fast and (args.platform in (None, "tpu")):
+        _write_last_good(result)
+    _emit(result)
     return 0
 
 
