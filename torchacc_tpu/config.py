@@ -66,7 +66,11 @@ class ComputeConfig:
 
     dtype: str = "bfloat16"          # activation/compute dtype
     param_dtype: str = "float32"     # master parameter dtype
-    accum_dtype: str = "float32"     # matmul/softmax accumulation dtype
+    # gradient-accumulation buffer dtype (grad_accum > 1): bfloat16 halves
+    # the accumulator memory at some summation precision cost.  (Matmul/
+    # softmax accumulation is not a knob on TPU: the MXU accumulates f32
+    # for bf16 inputs by construction.)
+    accum_dtype: str = "float32"
     flash_attention: bool = True     # use the Pallas flash-attention kernel
     # 'auto': pallas on TPU, interpreter elsewhere; 'xla': plain jnp reference
     attention_impl: str = "auto"     # 'auto' | 'pallas' | 'xla'
@@ -85,6 +89,8 @@ class ComputeConfig:
                f"compute.dtype must be bfloat16|float16|float32, got {self.dtype}")
         _check(self.param_dtype in ("bfloat16", "float32"),
                f"compute.param_dtype must be bfloat16|float32, got {self.param_dtype}")
+        _check(self.accum_dtype in ("bfloat16", "float32"),
+               f"compute.accum_dtype must be bfloat16|float32, got {self.accum_dtype}")
         _check(self.attention_impl in ("auto", "pallas", "xla"),
                f"compute.attention_impl invalid: {self.attention_impl}")
         _check(self.matmul_precision in ("default", "high", "highest"),
@@ -215,7 +221,11 @@ class PPConfig:
     """
     size: int = 1
     num_micro_batches: int = 1
-    broadcast_loss: bool = True
+    # (the reference's ``broadcast_loss`` knob — a torch.distributed
+    # broadcast of the last stage's loss to the other ranks,
+    # config.py:164-221 — dissolves here: the schedule's own psum over the
+    # 'pp' axis already lands the loss on every device of the one SPMD
+    # program; there is no optional host-side step to toggle)
     # 'gpipe': autodiff through the circulating-microbatch scan (simple,
     #          composes with any loss; memory ~ M in-flight carries).
     # '1f1b':  PipeDreamFlush interleaved schedule (pp/schedule.py:156-227)
@@ -292,11 +302,16 @@ class EPConfig:
     the reference has no EP; the all-to-all primitive cp/utils.py:262-299 is
     the building block it would use)."""
     size: int = 1
-    capacity_factor: float = 1.25
+    # switch-style expert capacity factor: None = dense grouped dispatch
+    # (no token dropping).  Folded into the zoo model's
+    # ``moe_capacity_factor`` by accelerate() unless the model config sets
+    # its own value explicitly.
+    capacity_factor: Optional[float] = None
 
     def validate(self) -> None:
         _check(self.size >= 1, "ep.size must be >= 1")
-        _check(self.capacity_factor > 0, "ep.capacity_factor must be > 0")
+        if self.capacity_factor is not None:
+            _check(self.capacity_factor > 0, "ep.capacity_factor must be > 0")
 
 
 @dataclass

@@ -71,20 +71,22 @@ class MoEMlp(nn.Module):
         else:
             # -- capacity dispatch (switch-transformer; GSPMD lowers the
             # dispatch/combine einsums to all-to-alls over 'ep') --------
+            import math
             n = b * s
-            cap = max(int(cfg.moe_capacity_factor * k * n / e + 0.999), 1)
+            cap = max(math.ceil(cfg.moe_capacity_factor * k * n / e), 1)
             sel_f = sel.reshape(n, k)
             w_f = weights.reshape(n, k)
-            # position of each (token, slot) inside its expert's buffer:
-            # slots claim positions in (slot-major, token-order) priority
+            # position of each (token, slot) inside its expert's buffer,
+            # slot-major priority (switch/GShard convention): every
+            # token's top-1 claim fills before any token's top-2, so
+            # tight capacity drops secondary routes first
             sel_1h = jax.nn.one_hot(sel_f, e, dtype=jnp.int32)  # [n, k, e]
-            # tokens assigned to expert ahead of (t, j): all slots of
-            # earlier tokens + earlier slots of this token
-            prev_tokens = jnp.cumsum(
-                jnp.sum(sel_1h, axis=1), axis=0) - jnp.sum(sel_1h, axis=1)
-            prev_slots = jnp.cumsum(sel_1h, axis=1) - sel_1h    # [n, k, e]
+            slot_totals = jnp.sum(sel_1h, axis=0)               # [k, e]
+            prev_slots = (jnp.cumsum(slot_totals, axis=0)
+                          - slot_totals)                        # [k, e]
+            prev_tokens = jnp.cumsum(sel_1h, axis=0) - sel_1h   # [n, k, e]
             pos = jnp.sum(
-                (prev_tokens[:, None, :] + prev_slots) * sel_1h,
+                (prev_slots[None, :, :] + prev_tokens) * sel_1h,
                 axis=-1)                                        # [n, k]
             keep = pos < cap
             # [n, k, e, cap] slot one-hots -> summed over k to [n, e, cap]

@@ -508,7 +508,16 @@ class TransformerLM(nn.Module):
                     x, aux_total = res
                     # mean over micro-batches: the same scale a pp=1
                     # full-batch forward sows, so the trainer's
-                    # aux_weight * aux * count term matches
+                    # aux_weight * aux * count term matches.
+                    # CONVENTION NOTE: this is the UNWEIGHTED mean — the
+                    # 1F1B schedule (and the grad-accum loop) instead
+                    # weight each micro's aux by its valid-token count.
+                    # The two agree exactly when micro-batches carry equal
+                    # valid-token counts (packed/full batches, the normal
+                    # case) and diverge only under uneven padding; the
+                    # gpipe pipeline never sees labels, so per-micro
+                    # counts are not available here without plumbing them
+                    # through the schedule.
                     self.sow("intermediates", "moe_aux_loss",
                              aux_total / cfg.pp_num_micro)
                 else:

@@ -92,9 +92,16 @@ def cp_attention(
                          kv_segment_ids=kv_segment_ids,
                          alibi_slopes=alibi_slopes, dropout_p=dropout_p,
                          dropout_seed=dropout_seed, impl=impl)
-    # 'auto' resolves to the Pallas kernel (interpret mode off-TPU);
-    # an explicit 'xla' request is honoured down the whole CP stack.
-    inner_impl = "pallas" if impl == "auto" else impl
+    # 'auto' matches plain attention's semantics (ops/attn.py): the Pallas
+    # kernel on TPU, plain-XLA elsewhere — the interpret-mode kernel is
+    # orders of magnitude slower and only worth running when a test
+    # explicitly requests impl='pallas'.  Either way the two backends are
+    # bit-identical per the parity tests in tests/test_flash_attention.py.
+    if impl == "auto":
+        from torchacc_tpu.ops._common import on_tpu
+        inner_impl = "pallas" if on_tpu() else "xla"
+    else:
+        inner_impl = impl
 
     d = q.shape[-1]
     has_seg = q_segment_ids is not None

@@ -58,18 +58,21 @@ def fused_linear_cross_entropy(
         # the cond is another resharding-collective source.  Pick the
         # largest chunk size <= chunk_rows that divides n exactly (n =
         # micro_batch * seq is essentially always highly composite).
-        import math
-        chunks_needed = -(-n // chunk_rows)
-        for c in range(chunks_needed, 4 * chunks_needed + 1):
-            if n % c == 0:
-                chunk_rows = n // c
-                break
-        else:
-            raise ValueError(
-                f"fused CE scan_free: no divisor of n={n} rows gives "
-                f"chunks in [{chunks_needed}, {4 * chunks_needed}] — pick "
-                f"a micro-batch-rows count divisible near chunk_rows="
-                f"{chunk_rows}")
+        # Any divisor of n works; pick the chunk size nearest the tuned
+        # chunk_rows.  Awkward token counts (n = 2 * prime, or prime)
+        # degrade smoothly — worst case one chunk of n rows, which IS the
+        # plain materialized-logits head — instead of failing at trace
+        # time (the old bounded search raised for e.g. n=4106).
+        divisors = [d for d in range(1, int(n ** 0.5) + 1) if n % d == 0]
+        divisors += [n // d for d in divisors]
+        best = min(divisors, key=lambda d: (abs(d - chunk_rows), d))
+        if best > 4 * chunk_rows:
+            from torchacc_tpu.utils.logger import logger
+            logger.warning(
+                f"fused CE scan_free: n={n} rows has no divisor near "
+                f"chunk_rows={chunk_rows}; using {best}-row chunks "
+                f"(memory approaches the unchunked head)")
+        chunk_rows = best
     pad = (-n) % chunk_rows
     if pad:
         x = jnp.concatenate(

@@ -51,6 +51,11 @@ def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
         pp_virtual=config.dist.pp.virtual_stages,
         logical_axis_rules=tuple(make_rules(config)),
     )
+    # expert capacity: the dist-level knob feeds the model's dispatcher;
+    # an explicit model-config value wins
+    if (config.dist.ep.capacity_factor is not None
+            and mc.num_experts > 0 and mc.moe_capacity_factor is None):
+        updates["moe_capacity_factor"] = config.dist.ep.capacity_factor
     return dataclasses.replace(mc, **updates)
 
 

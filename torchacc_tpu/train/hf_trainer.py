@@ -119,6 +119,7 @@ class HFTrainerAdapter:
                 grad_clip_norm=float(getattr(args, "max_grad_norm", 1.0))
                 or None)
 
+        self.config = config
         self.trainer, _ = accelerate(mc, None, config, optimizer=optimizer)
         self.trainer.init()
         # graft the converted HF weights over the random init
@@ -146,9 +147,12 @@ class HFTrainerAdapter:
         # fold the epoch in so each epoch reshuffles (transformers
         # set_epoch semantics)
         g.manual_seed(int(getattr(self.args, "seed", 42)) + epoch)
+        # drop_last honours the framework data config for training (a
+        # ragged final batch would recompile the step); eval always keeps
+        # the tail so metrics cover the whole set
         dl = tud.DataLoader(
             dataset, batch_size=self._global_batch_size(train),
-            shuffle=train, drop_last=train,
+            shuffle=train, drop_last=train and self.config.data.drop_last,
             collate_fn=self.data_collator, generator=g)
         for batch in dl:
             yield _to_numpy_batch(batch)

@@ -266,11 +266,18 @@ class Trainer:
 
                 grad_sum = jax.value_and_grad(scaled_sum, has_aux=True)
 
+                # grad accumulators in compute.accum_dtype (bfloat16 halves
+                # the buffer memory; f32 default keeps exact summation)
+                acc_dt = jnp.bfloat16 \
+                    if self.config.compute.accum_dtype == "bfloat16" \
+                    else jnp.float32
+
                 def micro(carry, xs):
                     mb, mi = xs
                     g_acc, l_acc, c_acc = carry
                     (l, c), g = grad_sum(state.params, mb, mi)
-                    return (jax.tree.map(jnp.add, g_acc, g),
+                    return (jax.tree.map(
+                                lambda a, b: a + b.astype(acc_dt), g_acc, g),
                             l_acc + l, c_acc + c), None
                 def to_micro(x):
                     if getattr(x, "ndim", 0) == 0:
@@ -280,13 +287,14 @@ class Trainer:
                                      + x.shape[1:])
                 mbs = jax.tree.map(to_micro, batch)
                 zeros = jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                    lambda p: jnp.zeros(p.shape, acc_dt), state.params)
                 (grads, loss_sum, count), _ = jax.lax.scan(
                     micro, (zeros, jnp.zeros((), jnp.float32),
                             jnp.zeros((), jnp.float32)),
                     (mbs, jnp.arange(accum, dtype=jnp.int32)))
                 denom = jnp.maximum(count, 1.0) * scale
-                grads = jax.tree.map(lambda g: g / denom, grads)
+                grads = jax.tree.map(
+                    lambda g: g.astype(jnp.float32) / denom, grads)
                 loss_val = loss_sum / denom
             else:
                 def scalar(p):
