@@ -91,3 +91,91 @@ def test_resnet_example_trains(devices):
     trainer.init(sample_input=batch["input_ids"])
     losses = [float(trainer.step(batch)["loss"]) for _ in range(6)]
     assert losses[-1] < losses[0], losses
+
+
+class StackedResidualLM(nn.Module):
+    """A NON-zoo model implementing the custom-model pipeline protocol
+    (round-2 VERDICT next-9; reference capability: fx-split pipelines any
+    traceable module, pp/pipeline.py:44-92):
+
+    1. keep the repeated trunk as STACKED params with leading dim
+       num_layers, annotated with the 'layers' logical axis (the pp rule
+       table shards it over 'pp');
+    2. when pp is on, run the trunk through
+       ``ta.parallel.pipeline_blocks(apply_block, stacked, (x,), ...)``
+       where ``apply_block(layer_params, carry) -> carry`` applies ONE
+       layer;
+    3. anything outside the trunk (embed/head) runs replicated over 'pp'.
+    """
+    vocab: int = 128
+    hidden: int = 32
+    layers: int = 4
+    pp_size: int = 1
+    pp_num_micro: int = 1
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, segment_ids=None):
+        init = nn.initializers.normal(0.02)
+        emb = self.param("embed", init, (self.vocab, self.hidden))
+        x = emb[input_ids]
+        w_in = self.param("w_in", init,
+                          (self.layers, self.hidden, 2 * self.hidden))
+        w_out = self.param("w_out", init,
+                           (self.layers, 2 * self.hidden, self.hidden))
+        stacked = {"w_in": w_in, "w_out": w_out}
+
+        def apply_block(p, carry):
+            h = carry[0]
+            h = h + jnp.tanh(h @ p["w_in"]) @ p["w_out"]
+            return (h,) + tuple(carry[1:])
+
+        if self.pp_size > 1 and not self.is_initializing():
+            x = ta.parallel.pipeline_blocks(
+                apply_block, stacked, (x,),
+                pp_size=self.pp_size, num_micro=self.pp_num_micro)
+        else:
+            def one(c, p):
+                return apply_block({"w_in": p[0], "w_out": p[1]}, (c,))[0], \
+                    None
+            x, _ = jax.lax.scan(one, x, (w_in, w_out))
+        return x @ emb.T
+
+
+STACKED_AXES = (
+    (r"embed$", ("vocab", "embed")),
+    (r"w_in$", ("layers", "embed", "mlp")),
+    (r"w_out$", ("layers", "mlp", "embed")),
+)
+
+
+def test_custom_model_pipeline_matches_single(devices):
+    """Custom-model pp=2 == dp=8: the pipeline protocol gives any
+    stack-of-uniform-blocks flax model real pipeline parallelism."""
+    import optax
+    from torchacc_tpu.models import loss_sum_count
+    from torchacc_tpu.train.trainer import shift_labels
+
+    def lm_loss(logits, batch):
+        return loss_sum_count(
+            logits, batch.get("labels", shift_labels(batch["input_ids"])))
+
+    rng = np.random.default_rng(0)
+    batches = [{"input_ids": rng.integers(0, 128, size=(8, 16))
+                .astype(np.int32)} for _ in range(4)]
+
+    losses = {}
+    for pp in (2, 1):
+        cfg = ta.Config(dist=ta.DistConfig(
+            pp=ta.PPConfig(size=pp, num_micro_batches=4 if pp > 1 else 1),
+            dp=ta.DPConfig(size=-1)))
+        model = StackedResidualLM(pp_size=pp,
+                                  pp_num_micro=4 if pp > 1 else 1)
+        tr = Trainer(model, cfg, optimizer=optax.adam(1e-3),
+                     axes_rules=STACKED_AXES, loss=lm_loss)
+        tr.init()
+        losses[pp] = [float(tr.step(b)["loss"]) for b in batches]
+        if pp > 1:
+            # trunk params really are stage-sharded
+            spec = str(tr.state.params["w_in"].sharding.spec)
+            assert "pp" in spec, spec
+    np.testing.assert_allclose(losses[2], losses[1], rtol=2e-4)

@@ -236,7 +236,8 @@ class PPConfig:
     # interleaved (Megatron virtual-pipeline) stages: each device holds
     # this many non-adjacent layer chunks and micro-batches lap the
     # ppermute ring that many times, shrinking the fill/drain bubble to
-    # (V*P-1)/V stage-times (parallel/pp.py pipeline_blocks docstring)
+    # (P-1)/V stage-times; supports the Megatron M = k*P regime via an
+    # M-periodic schedule (parallel/pp.py pipeline_blocks docstring)
     virtual_stages: int = 1
 
     def validate(self) -> None:
@@ -252,10 +253,6 @@ class PPConfig:
             _check(self.schedule == "gpipe",
                    "interleaved pipeline (virtual_stages > 1) runs under "
                    "the gpipe schedule; 1f1b is contiguous-stage only")
-            _check(self.num_micro_batches <= self.size,
-                   "interleaved pipeline requires num_micro_batches <= "
-                   "pp.size (one resident micro-batch per device per "
-                   "tick in lockstep SPMD)")
 
 
 @dataclass

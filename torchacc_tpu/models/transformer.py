@@ -657,10 +657,26 @@ def _sown_aux_sum(vs) -> jax.Array:
     return sum(vals) if vals else jnp.zeros((), jnp.float32)
 
 
+class _MicroBatchView(dict):
+    """Batch view handed to a custom Trainer loss inside the 1F1B last
+    stage.  Only ``labels`` exists there — the other batch leaves never
+    enter the pipeline region — so turn an unknown-key lookup into an
+    actionable error instead of a bare trace-time KeyError."""
+
+    def __missing__(self, key):
+        raise KeyError(
+            f"batch[{key!r}] is not available inside the 1f1b pipeline "
+            "region: a custom loss under pp.schedule='1f1b' runs in the "
+            "last stage and sees {'labels': ...} only.  Losses needing "
+            "other batch leaves should use pp.schedule='gpipe', whose "
+            "loss runs outside the region.")
+
+
 def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
                               positions=None, segment_ids=None,
                               labels=None, pp_axis: str = "pp",
-                              dropout_seed=None, use_fused_ce=False):
+                              dropout_seed=None, use_fused_ce=False,
+                              custom_loss=None):
     """(loss_sum, count) for a zoo model under the 1F1B pipeline schedule.
 
     The 1F1B schedule (parallel/pp.py pipeline_loss_1f1b; reference
@@ -745,6 +761,20 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
         xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
         w = (hp["embed"].T if cfg.tie_embeddings
              else hp["lm_head"]["kernel"])
+        if custom_loss is not None:
+            # user loss(logits, batch) -> (sum, count) | scalar, applied
+            # per micro-batch in the last stage (reference: the PP
+            # executor aggregates any stage-computed loss,
+            # pp/executor.py:283-321).  The batch view here carries the
+            # micro's labels; losses needing other batch leaves should
+            # use the gpipe schedule, whose loss runs outside the region.
+            logits = jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
+                                w.astype(jnp.float32))
+            res = custom_loss(softcap(logits, cfg.logit_softcap),
+                              _MicroBatchView(labels=lab))
+            if isinstance(res, tuple):
+                return res
+            return res, jnp.ones((), jnp.float32)
         if use_fused_ce:
             from torchacc_tpu.ops.fused import fused_linear_cross_entropy
             # scan_free: this runs inside the last-stage lax.cond, where

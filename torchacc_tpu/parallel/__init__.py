@@ -7,6 +7,7 @@ context parallelism have real algorithmic modules (pp.py, ops/context_parallel).
 
 from torchacc_tpu.parallel.distributed import initialize_distributed, is_primary
 from torchacc_tpu.parallel.mesh import build_mesh, describe_mesh, mesh_axis_size
+from torchacc_tpu.parallel.pp import pipeline_blocks, pipeline_loss_1f1b
 from torchacc_tpu.parallel.sharding import (
     DEFAULT_RULES,
     batch_spec,
@@ -22,6 +23,8 @@ __all__ = [
     "build_mesh",
     "describe_mesh",
     "mesh_axis_size",
+    "pipeline_blocks",
+    "pipeline_loss_1f1b",
     "DEFAULT_RULES",
     "batch_spec",
     "constraint",

@@ -174,12 +174,10 @@ class Trainer:
                 and hasattr(self.model, "cfg")):
             # 1F1B fuses head+loss into the last pipeline stage, so the
             # whole forward+loss goes through the schedule (the GPipe
-            # path below instead autodiffs through model.apply)
-            if self._custom_loss:
-                raise ValueError(
-                    "pp.schedule='1f1b' fuses the built-in CE loss into "
-                    "the last pipeline stage; a custom Trainer loss is "
-                    "not applied there — use the gpipe schedule")
+            # path below instead autodiffs through model.apply).  A
+            # custom Trainer loss runs inside that last stage per
+            # micro-batch; it sees {"labels": ...} only (losses needing
+            # other batch leaves should use gpipe).
             from torchacc_tpu.models.transformer import (
                 pp_1f1b_forward_sum_count,
             )
@@ -190,7 +188,8 @@ class Trainer:
                 labels=batch.get("labels"),
                 dropout_seed=(dropout_seed if self._attn_dropout_on
                               else None),
-                use_fused_ce=self._use_fused_ce)
+                use_fused_ce=self._use_fused_ce,
+                custom_loss=(self.loss if self._custom_loss else None))
         extra = {}
         if dropout_seed is not None and self._attn_dropout_on:
             extra["dropout_seed"] = dropout_seed
