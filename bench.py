@@ -299,7 +299,10 @@ def _bench(args, wd: Watchdog) -> int:
         # the 24 layers removes the scan's saved-residual stacking
         # (dynamic-update-slice fusions, ~21% of the scan step) — 56.2%
         # -> 63.4% MFU measured; costs ~2 min first compile, amortised
-        # by the persistent cache (docs/PERF.md).
+        # by the persistent cache (docs/PERF.md).  Since round 3 the
+        # unrolled path shares the stacked param layout and composes
+        # with PP (per-stage static unroll), so this IS the config
+        # users run, not a bench-only special case.
         seq, batch, iters = 2048, 4, args.iters or 10
         mc = get_preset(
             "llama-tiny",

@@ -29,8 +29,11 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def torch_curve(hf_model, ids, steps, lr):
-    """Plain torch fine-tune loop: next-token CE, SGD, f32."""
+def torch_curve(hf_model, ids, steps, lr, heldout):
+    """Plain torch fine-tune loop: next-token CE, SGD, f32.  Returns the
+    loss curve plus heldout perplexity of the TUNED model (the
+    downstream-eval leg — reference scores the tuned model too,
+    benchmarks/accuracy/README.md:103-105)."""
     import torch
 
     model = hf_model.train()
@@ -44,11 +47,17 @@ def torch_curve(hf_model, ids, steps, lr):
         out.loss.backward()
         opt.step()
         losses.append(float(out.loss.detach()))
-    return losses
+    model.eval()
+    with torch.no_grad():
+        ev = [float(model(input_ids=torch.from_numpy(b),
+                          labels=torch.from_numpy(b)).loss)
+              for b in heldout]
+    return losses, sum(ev) / len(ev)
 
 
-def converted_curve(hf_model, ids, steps, lr):
-    """Same initial weights via models/hf.py, trained by the Trainer."""
+def converted_curve(hf_model, ids, steps, lr, heldout):
+    """Same initial weights via models/hf.py, trained by the Trainer;
+    returns the curve plus heldout perplexity of the tuned model."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -69,7 +78,9 @@ def converted_curve(hf_model, ids, steps, lr):
     for step in range(steps):
         m = trainer.step({"input_ids": jnp.asarray(ids[step])})
         losses.append(float(m["loss"]))
-    return losses
+    ev = [float(trainer.eval_step({"input_ids": jnp.asarray(b)}))
+          for b in heldout]
+    return losses, sum(ev) / len(ev)
 
 
 def main(argv=None) -> int:
@@ -83,8 +94,13 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import numpy as np
+    import torch
     import transformers
 
+    # the HF init draws from torch's GLOBAL rng: seed it or every run
+    # trains a different model (and the `improved` gate on a short run
+    # becomes a coin flip)
+    torch.manual_seed(0)
     hf_cfg = transformers.LlamaConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
@@ -92,16 +108,34 @@ def main(argv=None) -> int:
     hf_model = transformers.LlamaForCausalLM(hf_cfg).float()
 
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, 256, size=(args.steps, args.batch, args.seq)
+    # tokens from a quarter of the vocab: LEARNABLE data (the model
+    # shifts mass onto the live tokens, loss falls toward log(64)), so
+    # the `improved` gate checks that training actually trains instead
+    # of flipping a coin on uniform noise
+    ids = rng.integers(0, 64, size=(args.steps, args.batch, args.seq)
                        ).astype(np.int64)
+    # heldout set for the downstream-eval leg: same distribution, never
+    # trained on (reference also scores the tuned model,
+    # benchmarks/accuracy/README.md:103-105; MT-bench itself needs
+    # serving infra — heldout perplexity is the self-contained analogue)
+    heldout = rng.integers(0, 64, size=(4, args.batch, args.seq)
+                           ).astype(np.int64)
 
-    ours = converted_curve(hf_model, ids, args.steps, args.lr)
-    theirs = torch_curve(hf_model, ids, args.steps, args.lr)
+    ours, ev_ours = converted_curve(hf_model, ids, args.steps, args.lr,
+                                    heldout)
+    theirs, ev_torch = torch_curve(hf_model, ids, args.steps, args.lr,
+                                   heldout)
 
     devs = [abs(a - b) / max(abs(b), 1e-6) for a, b in zip(ours, theirs)]
     max_dev = max(devs)
+    # gate the downstream leg on heldout LOSS deviation (the same scale
+    # as --tol); perplexity is exp(loss), so a rel-ppl gate would be
+    # ~loss-magnitude-fold stricter than the curve gate next to it
+    ev_dev = abs(ev_ours - ev_torch) / max(abs(ev_torch), 1e-6)
+    import math
+    ppl_ours, ppl_torch = math.exp(ev_ours), math.exp(ev_torch)
     improved = ours[-1] < ours[0]
-    ok = bool(max_dev <= args.tol and improved)
+    ok = bool(max_dev <= args.tol and ev_dev <= args.tol and improved)
     print(json.dumps({
         "metric": "accuracy_parity_llama_sft",
         "ok": ok,
@@ -111,6 +145,11 @@ def main(argv=None) -> int:
                        "torchacc_tpu": round(ours[0], 5)},
         "loss_last": {"torch": round(theirs[-1], 5),
                       "torchacc_tpu": round(ours[-1], 5)},
+        "heldout": {"loss_torch": round(ev_torch, 5),
+                    "loss_torchacc_tpu": round(ev_ours, 5),
+                    "loss_rel_dev": round(ev_dev, 5),
+                    "ppl_torch": round(ppl_torch, 4),
+                    "ppl_torchacc_tpu": round(ppl_ours, 4)},
         "steps": args.steps,
     }))
     return 0 if ok else 1

@@ -20,6 +20,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: the suite's wall time is dominated by XLA
+# compiles of 8-device trainers (measured 102s -> 26s on one pipeline
+# test with a warm cache).  Keyed on HLO + platform, so source changes
+# that alter the computation recompile; stale entries are harmless.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".cache", "jax")
+try:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:  # unwritable FS — run uncached
+    pass
+
 import pytest  # noqa: E402
 
 

@@ -189,3 +189,35 @@ def test_metrics_writer_jsonl_and_fit_wiring(tmp_path):
     lines = (tmp_path / "fit" / "metrics.jsonl").read_text().splitlines()
     assert len(lines) == len(hist) == 3
     assert "train/tokens_per_sec" in json.loads(lines[-1])
+
+
+def test_generate_kv_cache_under_remat_variants():
+    """Prefill must populate the KV cache whatever remat config the model
+    carries: the remat_cnt split path and the unrolled (scan_layers=
+    False) path apply layers via raw .apply, which would silently drop
+    cache writes — cache-mutable calls must route through plain scan
+    (regression: empty prefill cache meant decode read zeros)."""
+    import dataclasses
+
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+
+    base = get_preset("llama-tiny", vocab_size=97, hidden_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      intermediate_size=128, max_seq_len=64,
+                      dtype=jnp.float32)
+    prompt = jnp.asarray(np.random.default_rng(2).integers(1, 97, (2, 7)),
+                         jnp.int32)
+    for variant in (dict(remat=True, remat_cnt=1, remat_policy="dots"),
+                    dict(scan_layers=False)):
+        mc = dataclasses.replace(base, **variant)
+        model = TransformerLM(mc)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        # prefill cache must be non-empty
+        _, vars_ = model.apply({"params": params}, prompt,
+                               mutable=["cache"])
+        assert jax.tree.leaves(vars_.get("cache", {})), variant
+        fast = generate(model, params, prompt, max_new_tokens=8)
+        slow = generate(model, params, prompt, max_new_tokens=8,
+                        use_cache=False)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow),
+                                      err_msg=str(variant))

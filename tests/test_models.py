@@ -1,5 +1,6 @@
 """Model-zoo tests: forward shapes, axes resolution, param counts."""
 
+import chex
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -109,6 +110,9 @@ def test_loss_fn_ignores_minus_100():
 
 
 def test_scan_vs_loop_equivalence():
+    """scan_layers only picks the APPLICATION style; the param layout is
+    the stacked [L, ...] tree either way, so the same params drive both
+    paths and checkpoints are layout-portable."""
     cfg = get_preset("llama-tiny", dtype=jnp.float32, num_layers=2)
     ids = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0, 100)
     m_scan = TransformerLM(cfg)
@@ -119,12 +123,17 @@ def test_scan_vs_loop_equivalence():
     cfg_loop = dataclasses.replace(cfg, scan_layers=False)
     m_loop = TransformerLM(cfg_loop)
     loop_params = m_loop.init(jax.random.PRNGKey(0), ids)["params"]
-    # copy scanned params (leading layer dim) into per-layer trees
-    for i in range(cfg.num_layers):
-        loop_params[f"layers_{i}"] = jax.tree.map(
-            lambda x: x[i], params["layers"])
-    out_loop = m_loop.apply({"params": loop_params}, ids)
+    chex.assert_trees_all_equal_shapes(params, loop_params)
+    out_loop = m_loop.apply({"params": params}, ids)
     assert jnp.allclose(out_scan, out_loop, atol=1e-5)
+    # gradients agree too (the unrolled path autodiffs per layer)
+    def l(m):
+        def f(p):
+            return jnp.mean(m.apply({"params": p}, ids) ** 2)
+        return f
+    g_scan = jax.grad(l(m_scan))(params)
+    g_loop = jax.grad(l(m_loop))(params)
+    chex.assert_trees_all_close(g_scan, g_loop, atol=2e-4, rtol=2e-4)
 
 
 def test_alibi_pos_emb_model():

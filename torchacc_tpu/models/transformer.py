@@ -411,6 +411,19 @@ class ScanBlock(nn.Module):
         return (x, positions, segment_ids), None
 
 
+def _raw_block_fn(block_cfg):
+    """``fn(p, carry, seed) -> (carry, aux)`` applying ONE block via raw
+    ``ScanBlock.apply``.  The raw apply drops sown intermediates unless
+    the collection is mutable, so the MoE router aux is collected
+    explicitly and returned — the single place this subtlety lives (the
+    pp / unrolled / split-remat paths all build on it)."""
+    def fn(p, carry, s):
+        (new_carry, _), vs = ScanBlock(block_cfg).apply(
+            {"params": p}, carry, s, mutable=["intermediates"])
+        return new_carry, _sown_aux_sum(vs)
+    return fn
+
+
 class TransformerLM(nn.Module):
     """The LM.  ``__call__(input_ids, positions?, segment_ids?) -> logits``.
 
@@ -430,10 +443,6 @@ class TransformerLM(nn.Module):
         if cfg.attn_dropout > 0.0 and dropout_seed is not None:
             seeds_xs = _layer_seed(
                 dropout_seed, jnp.arange(cfg.num_layers, dtype=jnp.int32))
-        if cfg.pp_size > 1 and not cfg.scan_layers:
-            raise ValueError(
-                "pipeline parallelism (pp_size > 1) requires scan_layers="
-                "True — the pipeline operates on the stacked layer params")
         b, s = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -457,133 +466,159 @@ class TransformerLM(nn.Module):
         if (cfg.remat and cfg.remat_cnt is not None
                 and 0 <= cfg.remat_cnt < cfg.num_layers and cfg.pp_size == 1):
             split_n = cfg.remat_cnt
-        if cfg.scan_layers:
-            scan_mod = nn.scan(
-                block_cls,
-                variable_axes={"params": 0, "intermediates": 0, "cache": 0},
-                split_rngs={"params": True},
-                length=cfg.num_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")
-            if cfg.pp_size > 1 and not self.is_initializing():
-                # pipeline path: drive the stacked layer params through the
-                # pp-stage pipeline (init still traces scan_mod so params
-                # exist with the stacked layout)
-                from torchacc_tpu.parallel.pp import pipeline_blocks
-                layer_params = self.variables["params"]["layers"]
-                moe_on = cfg.num_experts > 0
-                if seeds_xs is not None:
-                    # per-layer seeds ride the stacked pytree so each
-                    # pp stage sees its own layers' seeds
-                    stacked = {"p": layer_params, "s": seeds_xs}
-                    unpack = lambda ps: (ps["p"], ps["s"])
-                else:
-                    stacked = layer_params
-                    unpack = lambda p: (p, None)
-
-                def apply_one(ps, carry):
-                    p, s = unpack(ps)
-                    if moe_on:
-                        # raw .apply drops sown intermediates unless the
-                        # collection is mutable — collect the MoE router
-                        # aux explicitly (aux_from_block below)
-                        (new_carry, _), vs = ScanBlock(cfg).apply(
-                            {"params": p}, carry, s,
-                            mutable=["intermediates"])
-                        return new_carry, _sown_aux_sum(vs)
-                    new_carry, _ = ScanBlock(cfg).apply({"params": p},
-                                                        carry, s)
-                    return new_carry
-
-                from torchacc_tpu.utils.remat import remat_policy
-                res = pipeline_blocks(
-                    apply_one, stacked, (x, positions, segment_ids),
-                    pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
-                    virtual_stages=cfg.pp_virtual,
-                    remat=cfg.remat,
-                    remat_policy=(remat_policy(cfg.remat_policy)
-                                  if cfg.remat else None),
-                    aux_from_block=moe_on)
-                if moe_on:
-                    x, aux_total = res
-                    # mean over micro-batches: the same scale a pp=1
-                    # full-batch forward sows, so the trainer's
-                    # aux_weight * aux * count term matches.
-                    # CONVENTION NOTE: this is the UNWEIGHTED mean — the
-                    # 1F1B schedule (and the grad-accum loop) instead
-                    # weight each micro's aux by its valid-token count.
-                    # The two agree exactly when micro-batches carry equal
-                    # valid-token counts (packed/full batches, the normal
-                    # case) and diverge only under uneven padding; the
-                    # gpipe pipeline never sees labels, so per-micro
-                    # counts are not available here without plumbing them
-                    # through the schedule.
-                    self.sow("intermediates", "moe_aux_loss",
-                             aux_total / cfg.pp_num_micro)
-                else:
-                    x = res
-            elif split_n is not None and not self.is_initializing():
-                # split the stacked params: first remat_cnt layers run
-                # with remat semantics, the rest without (init still
-                # traces scan_mod so the stacked layout exists)
-                from torchacc_tpu.utils.remat import remat_policy
-                layer_params = self.variables["params"]["layers"]
-                head = jax.tree.map(lambda p: p[:split_n], layer_params)
-                tail = jax.tree.map(lambda p: p[split_n:], layer_params)
-                cfg_off = dataclasses.replace(cfg, remat=False)
-
-                def apply_block(block_cfg):
-                    def fn(ps, carry):
-                        p, s = ps
-                        # keep sow'd aux losses flowing through the raw
-                        # .apply (they would otherwise be dropped)
-                        (new_carry, _), vs = ScanBlock(block_cfg).apply(
-                            {"params": p}, carry, s,
-                            mutable=["intermediates"])
-                        return new_carry, _sown_aux_sum(vs)
-                    return fn
-
-                apply_gc, apply_plain = apply_block(cfg), apply_block(cfg_off)
-                if _block_remat(cfg):
-                    apply_gc = jax.checkpoint(
-                        apply_gc, policy=remat_policy(cfg.remat_policy),
-                        prevent_cse=False)
-
-                def seg(fn, stack, lo, hi, carry):
-                    if seeds_xs is None:
-                        return jax.lax.scan(
-                            lambda c, p: fn((p, None), c), carry, stack)
-                    return jax.lax.scan(
-                        lambda c, ps: fn(ps, c), carry,
-                        (stack, seeds_xs[lo:hi]))
-
-                carry = (x, positions, segment_ids)
-                aux_total = jnp.zeros((), jnp.float32)
-                if split_n > 0:
-                    carry, aux = seg(apply_gc, head, 0, split_n, carry)
-                    aux_total = aux_total + jnp.sum(aux)
-                if split_n < cfg.num_layers:
-                    carry, aux = seg(apply_plain, tail, split_n,
-                                     cfg.num_layers, carry)
-                    aux_total = aux_total + jnp.sum(aux)
-                if cfg.num_experts > 0:
-                    self.sow("intermediates", "moe_aux_loss", aux_total)
-                x = carry[0]
+        # ONE canonical param layout: layers are always initialised via
+        # nn.scan, so the stacked [L, ...] tree (partitioned over the
+        # 'layers' logical axis) is the layout regardless of scan_layers
+        # — checkpoints are portable between the two execution paths.
+        # scan_layers picks how the layers are APPLIED: True = lax.scan
+        # over the stack (fast compiles; policy-saved residuals stack
+        # [L, ...] via dynamic-update-slice — the scan-stacking tax,
+        # docs/PERF.md), False = Python-unrolled loop over static slices
+        # (separate per-layer residual buffers; slower compiles,
+        # amortised by the persistent compile cache).  The decode/cache
+        # path ALWAYS applies via plain scan — the cache collection only
+        # flows through scan_mod's variable_axes (raw .apply in the
+        # unrolled/split paths would silently drop prefill cache
+        # writes), and decode compute is trivial either way.
+        cache_live = cfg.decode or self.is_mutable_collection("cache")
+        use_scan_apply = cfg.scan_layers or cache_live
+        scan_mod = nn.scan(
+            block_cls,
+            variable_axes={"params": 0, "intermediates": 0, "cache": 0},
+            split_rngs={"params": True},
+            length=cfg.num_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(cfg, name="layers")
+        if self.is_initializing():
+            (x, _, _), _ = scan_mod((x, positions, segment_ids), seeds_xs)
+        elif cfg.pp_size > 1:
+            # pipeline path: drive the stacked layer params through the
+            # pp-stage pipeline (init traced scan_mod so params exist
+            # with the stacked layout); scan_layers picks whether each
+            # stage scans or unrolls its layer chunk
+            from torchacc_tpu.parallel.pp import pipeline_blocks
+            layer_params = self.variables["params"]["layers"]
+            moe_on = cfg.num_experts > 0
+            if seeds_xs is not None:
+                # per-layer seeds ride the stacked pytree so each
+                # pp stage sees its own layers' seeds
+                stacked = {"p": layer_params, "s": seeds_xs}
+                unpack = lambda ps: (ps["p"], ps["s"])
             else:
-                (x, _, _), _ = scan_mod((x, positions, segment_ids),
-                                        seeds_xs)
-        else:
+                stacked = layer_params
+                unpack = lambda p: (p, None)
+
+            _block = _raw_block_fn(cfg)
+
+            def apply_one(ps, carry):
+                p, s = unpack(ps)
+                new_carry, aux = _block(p, carry, s)
+                # aux_from_block=moe_on below: only then does the
+                # pipeline expect (carry, aux)
+                return (new_carry, aux) if moe_on else new_carry
+
+            from torchacc_tpu.utils.remat import remat_policy
+            res = pipeline_blocks(
+                apply_one, stacked, (x, positions, segment_ids),
+                pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
+                virtual_stages=cfg.pp_virtual,
+                remat=cfg.remat,
+                remat_policy=(remat_policy(cfg.remat_policy)
+                              if cfg.remat else None),
+                aux_from_block=moe_on,
+                unroll_stage=not cfg.scan_layers)
+            if moe_on:
+                x, aux_total = res
+                # mean over micro-batches: the same scale a pp=1
+                # full-batch forward sows, so the trainer's
+                # aux_weight * aux * count term matches.
+                # CONVENTION NOTE: this is the UNWEIGHTED mean — the
+                # 1F1B schedule (and the grad-accum loop) instead
+                # weight each micro's aux by its valid-token count.
+                # The two agree exactly when micro-batches carry equal
+                # valid-token counts (packed/full batches, the normal
+                # case) and diverge only under uneven padding; the
+                # gpipe pipeline never sees labels, so per-micro
+                # counts are not available here without plumbing them
+                # through the schedule.
+                self.sow("intermediates", "moe_aux_loss",
+                         aux_total / cfg.pp_num_micro)
+            else:
+                x = res
+        elif not use_scan_apply:
+            # unrolled application from the stacked layout: static
+            # per-layer slices keep each layer's policy-saved residuals
+            # as SEPARATE buffers, so the step's autodiff carries no
+            # [L, ...] DUS stacking (the scan-stacking tax — measured
+            # ~7 MFU points on the v5e bench, docs/PERF.md).  Honors
+            # remat_cnt: layers past split_n run without remat.
+            from torchacc_tpu.utils.remat import remat_policy
+            layer_params = self.variables["params"]["layers"]
+            cfg_off = dataclasses.replace(cfg, remat=False)
+
+            apply_gc = _raw_block_fn(cfg)
+            apply_plain = _raw_block_fn(cfg_off)
+            if _block_remat(cfg):
+                apply_gc = jax.checkpoint(
+                    apply_gc, policy=remat_policy(cfg.remat_policy),
+                    prevent_cse=False)
+
+            carry = (x, positions, segment_ids)
+            aux_total = jnp.zeros((), jnp.float32)
+            n_gc = cfg.num_layers if split_n is None else split_n
             for i in range(cfg.num_layers):
-                past = split_n is not None and i >= split_n
-                cls_i = ScanBlock if past else block_cls
-                # submodule remat is driven by cfg inside Block; switch
-                # it off for layers past remat_cnt
-                cfg_i = (dataclasses.replace(cfg, remat=False)
-                         if past and _sub_remat(cfg) else cfg)
+                fn = apply_gc if (i < n_gc and cfg.remat) else apply_plain
+                p_i = jax.tree.map(lambda a, i=i: a[i], layer_params)
                 seed_i = None if seeds_xs is None else seeds_xs[i]
-                (x, positions, segment_ids), _ = cls_i(
-                    cfg_i, name=f"layers_{i}")((x, positions, segment_ids),
-                                               seed_i)
+                carry, aux = fn(p_i, carry, seed_i)
+                aux_total = aux_total + aux
+            if cfg.num_experts > 0:
+                self.sow("intermediates", "moe_aux_loss", aux_total)
+            x = carry[0]
+        elif split_n is not None and not cache_live:
+            # split the stacked params: first remat_cnt layers run with
+            # remat semantics, the rest without.  cache_live falls
+            # through to plain scan below: this path's raw .apply would
+            # drop prefill cache writes (remat does not change values,
+            # so eval/prefill under scan is correct regardless of
+            # remat_cnt).
+            from torchacc_tpu.utils.remat import remat_policy
+            layer_params = self.variables["params"]["layers"]
+            head = jax.tree.map(lambda p: p[:split_n], layer_params)
+            tail = jax.tree.map(lambda p: p[split_n:], layer_params)
+            cfg_off = dataclasses.replace(cfg, remat=False)
+
+            _gc, _plain = _raw_block_fn(cfg), _raw_block_fn(cfg_off)
+            apply_gc = lambda ps, carry: _gc(ps[0], carry, ps[1])
+            apply_plain = lambda ps, carry: _plain(ps[0], carry, ps[1])
+            if _block_remat(cfg):
+                apply_gc = jax.checkpoint(
+                    apply_gc, policy=remat_policy(cfg.remat_policy),
+                    prevent_cse=False)
+
+            def seg(fn, stack, lo, hi, carry):
+                if seeds_xs is None:
+                    return jax.lax.scan(
+                        lambda c, p: fn((p, None), c), carry, stack)
+                return jax.lax.scan(
+                    lambda c, ps: fn(ps, c), carry,
+                    (stack, seeds_xs[lo:hi]))
+
+            carry = (x, positions, segment_ids)
+            aux_total = jnp.zeros((), jnp.float32)
+            if split_n > 0:
+                carry, aux = seg(apply_gc, head, 0, split_n, carry)
+                aux_total = aux_total + jnp.sum(aux)
+            if split_n < cfg.num_layers:
+                carry, aux = seg(apply_plain, tail, split_n,
+                                 cfg.num_layers, carry)
+                aux_total = aux_total + jnp.sum(aux)
+            if cfg.num_experts > 0:
+                self.sow("intermediates", "moe_aux_loss", aux_total)
+            x = carry[0]
+        else:
+            (x, _, _), _ = scan_mod((x, positions, segment_ids),
+                                    seeds_xs)
 
         x = Norm(cfg, name="final_norm")(x)
         if return_hidden:
@@ -789,4 +824,5 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
 
     return pipeline_loss_1f1b(
         apply_block, head_loss, stacked, head_params, x, riders, labels,
-        layer_xs, aux_scale, cfg.pp_size, M, pp_axis, moe_on)
+        layer_xs, aux_scale, cfg.pp_size, M, pp_axis, moe_on,
+        not cfg.scan_layers)

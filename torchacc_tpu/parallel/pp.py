@@ -61,6 +61,7 @@ def pipeline_blocks(
     remat_policy: Optional[Any] = None,
     virtual_stages: int = 1,
     aux_from_block: bool = False,
+    unroll_stage: bool = False,
 ):
     """Run a stacked layer stack as a pp-stage pipeline.
 
@@ -154,6 +155,19 @@ def pipeline_blocks(
                 return apply_block(p, c), jnp.zeros((), jnp.float32)
             body = (jax.checkpoint(one, policy=remat_policy)
                     if remat else one)
+            if unroll_stage:
+                # unrolled layer application (scan_layers=False): static
+                # per-layer slices keep each layer's policy-saved
+                # residuals as separate buffers — no [L/P, ...] DUS
+                # stacking in the stage's autodiff (docs/PERF.md, the
+                # scan-stacking tax)
+                aux_total = jnp.zeros((), jnp.float32)
+                for j in range(per_stage):
+                    carry, aux = body(
+                        carry,
+                        jax.tree.map(lambda a, j=j: a[j], chunk_params))
+                    aux_total = aux_total + aux
+                return carry, aux_total
             carry, auxs = jax.lax.scan(body, carry, chunk_params)
             return carry, jnp.sum(auxs)
 
@@ -289,6 +303,7 @@ def pipeline_train_1f1b(
     layer_xs: Any = None,
     aux_from_block: bool = False,
     aux_scale: Optional[jax.Array] = None,
+    unroll_stage: bool = False,
 ):
     """One-forward-one-backward pipeline TRAIN step (loss + grads).
 
@@ -423,7 +438,21 @@ def pipeline_train_1f1b(
             pl, xl = pxs
             return call_block(pl, c, xl)
 
+        def _stage_unrolled(body, p, carry):
+            # unrolled layer application (scan_layers=False): static
+            # slices keep per-layer saved residuals as separate buffers
+            # (no [L/P, ...] DUS stacking — docs/PERF.md)
+            aux_total = jnp.zeros((), jnp.float32)
+            for j in range(per_stage):
+                pj = jax.tree.map(lambda a, j=j: a[j], p)
+                xj = jax.tree.map(lambda a, j=j: a[j], xs_me)
+                carry, aux = body(carry, (pj, xj))
+                aux_total = aux_total + aux
+            return carry, aux_total
+
         def stage(p, carry):
+            if unroll_stage:
+                return _stage_unrolled(one, p, carry)
             carry, auxs = jax.lax.scan(one, carry, (p, xs_me))
             return carry, jnp.sum(auxs)
 
@@ -434,6 +463,8 @@ def pipeline_train_1f1b(
             # is what would erase 1F1B's memory win)
             body = jax.checkpoint(one, policy=remat_policy,
                                   prevent_cse=False)
+            if unroll_stage:
+                return _stage_unrolled(body, p, carry)
             carry, auxs = jax.lax.scan(body, carry, (p, xs_me))
             return carry, jnp.sum(auxs)
 
@@ -654,11 +685,11 @@ def pipeline_train_1f1b(
     return (loss_sum, count), (d_stacked, dhead, dx)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 9, 10, 11, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 9, 10, 11, 12, 13))
 def pipeline_loss_1f1b(apply_block, head_loss, stacked_params, head_params,
                        x, riders, labels, layer_xs, aux_scale,
                        pp_size, num_micro, pp_axis="pp",
-                       aux_from_block=False):
+                       aux_from_block=False, unroll_stage=False):
     """Differentiable (loss_sum, count) via the 1F1B schedule: the
     schedule already computed the grads during the forward, so the VJP
     just scales them by the loss cotangent (they are linear in it).
@@ -669,23 +700,26 @@ def pipeline_loss_1f1b(apply_block, head_loss, stacked_params, head_params,
         apply_block, head_loss, stacked_params, head_params,
         (x,) + tuple(riders), labels, pp_size=pp_size,
         num_micro=num_micro, pp_axis=pp_axis, layer_xs=layer_xs,
-        aux_from_block=aux_from_block, aux_scale=aux_scale)
+        aux_from_block=aux_from_block, aux_scale=aux_scale,
+        unroll_stage=unroll_stage)
     return loss_sum, count
 
 
 def _pl1f1b_fwd(apply_block, head_loss, stacked_params, head_params,
                 x, riders, labels, layer_xs, aux_scale,
-                pp_size, num_micro, pp_axis="pp", aux_from_block=False):
+                pp_size, num_micro, pp_axis="pp", aux_from_block=False,
+                unroll_stage=False):
     (loss_sum, count), grads = pipeline_train_1f1b(
         apply_block, head_loss, stacked_params, head_params,
         (x,) + tuple(riders), labels, pp_size=pp_size,
         num_micro=num_micro, pp_axis=pp_axis, layer_xs=layer_xs,
-        aux_from_block=aux_from_block, aux_scale=aux_scale)
+        aux_from_block=aux_from_block, aux_scale=aux_scale,
+        unroll_stage=unroll_stage)
     return (loss_sum, count), grads
 
 
 def _pl1f1b_bwd(apply_block, head_loss, pp_size, num_micro, pp_axis,
-                aux_from_block, res, ct):
+                aux_from_block, unroll_stage, res, ct):
     d_stacked, dhead, dx = res
     dls = ct[0]  # count is parameter-independent
     scale = lambda tree: jax.tree.map(
