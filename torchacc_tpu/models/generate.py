@@ -88,24 +88,33 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     cfg = getattr(model, "cfg", None)
-    # alibi/window decode geometry is not wired through the cache branch
-    # (the fallback's full forward handles both); pp/cp decode likewise
+    # window/ALiBi decode runs through the cache branch (q_offset aligns
+    # the decode-row geometry); pp/cp decode uses the full-forward
+    # fallback (distributed decode is out of the reference's scope too —
+    # TorchAcc is training-only and shells out to vLLM for inference)
     can_cache = (use_cache and cfg is not None
                  and getattr(cfg, "pp_size", 1) == 1
-                 and not getattr(cfg, "context_parallel", False)
-                 and getattr(cfg, "pos_emb", "rope") != "alibi"
-                 and tuple(getattr(cfg, "window", (-1, -1))) == (-1, -1))
+                 and not getattr(cfg, "context_parallel", False))
+    if max_new_tokens <= 0:
+        return prompt_ids
     if can_cache:
         total = p + max_new_tokens
-        if total > cfg.max_seq_len:
+        # only a learned position table genuinely caps the length: the
+        # cache itself is sized to `total`, and rope/ALiBi extrapolate
+        # (max_seq_len is the trained context, not a hard limit)
+        if cfg.pos_emb == "learned" and total > cfg.max_seq_len:
             raise ValueError(
-                f"prompt + max_new_tokens = {total} exceeds "
-                f"max_seq_len {cfg.max_seq_len}")
+                f"prompt + max_new_tokens = {total} exceeds the learned "
+                f"position table max_seq_len {cfg.max_seq_len}")
         from torchacc_tpu.models.transformer import TransformerLM
-        dec_model = TransformerLM(dataclasses.replace(cfg, decode=True))
-        return _generate_cached(model, dec_model, params, prompt_ids, rng,
-                                float(temperature), int(max_new_tokens),
-                                eos_id)
+        # cache_len=total: short generations allocate (and attend over)
+        # prompt+new positions, not a max_seq_len-sized cache
+        pre_model = TransformerLM(dataclasses.replace(cfg, cache_len=total))
+        dec_model = TransformerLM(dataclasses.replace(cfg, decode=True,
+                                                      cache_len=total))
+        return _generate_cached(pre_model, dec_model, params, prompt_ids,
+                                rng, float(temperature),
+                                int(max_new_tokens), eos_id)
     return _generate_recompute(model, params, prompt_ids,
                                max_new_tokens=max_new_tokens,
                                temperature=temperature, rng=rng,

@@ -69,6 +69,10 @@ class ModelConfig:
     # token per step, appending rotated k / raw v into the 'cache'
     # collection and attending over the filled prefix
     decode: bool = False
+    # KV-cache length; None = max_seq_len.  generate() sets it to
+    # prompt_len + max_new_tokens so short generations do not allocate
+    # (or attend over) a max_seq_len-sized cache
+    cache_len: Optional[int] = None
     # post-softmax attention dropout (reference flash_attn.py:418-423);
     # active only when the caller passes deterministic=False + a seed
     attn_dropout: float = 0.0
@@ -261,7 +265,7 @@ class Attention(nn.Module):
                 self.is_mutable_collection("cache")
                 and not self.is_initializing()):
             b, s = x.shape[0], x.shape[1]
-            max_len = cfg.max_seq_len
+            max_len = cfg.cache_len or cfg.max_seq_len
             ck = self.variable("cache", "k", jnp.zeros,
                                (b, max_len, cfg.kv_heads, d), cfg.dtype)
             cv = self.variable("cache", "v", jnp.zeros,
@@ -275,15 +279,20 @@ class Attention(nn.Module):
                 cv.value = jax.lax.dynamic_update_slice(
                     cv.value, v.astype(cfg.dtype), (0, pos, 0, 0))
                 cidx.value = pos + s
-                # attend over positions <= pos via segment ids (causal
-                # bottom-right alignment would misalign mid-cache)
-                valid = jnp.arange(max_len) <= pos
-                kseg = jnp.broadcast_to(
-                    jnp.where(valid, 0, -1)[None], (b, max_len))
-                qseg = jnp.zeros((b, s), jnp.int32)
-                out = attention(q, ck.value, cv.value, causal=False,
-                                q_segment_ids=qseg, kv_segment_ids=kseg,
-                                impl="xla")
+                # the query's TRUE position is pos while it sits at row 0
+                # of a [1, kv_len] score matrix: q_offset re-aligns the
+                # geometry so the shared mask/bias machinery gives exact
+                # causal (<= pos), sliding-window, and ALiBi behavior over
+                # the filled prefix (positions > pos hold zeros and fall
+                # outside the causal mask).  kv_len comes from the LIVE
+                # cache (a pre-existing cache may be sized differently
+                # than this cfg's cache_len).
+                from torchacc_tpu.ops.attention import attention_reference
+                kv_len = ck.value.shape[1]
+                out = attention_reference(
+                    q, ck.value, cv.value, causal=True, window=cfg.window,
+                    alibi_slopes=slopes,
+                    q_offset=pos - (kv_len - s))
                 return nn.DenseGeneral(
                     features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
                     name="o_proj", dtype=cfg.dtype,
