@@ -249,10 +249,9 @@ class PPConfig:
         if self.size > 1:
             _check(self.num_micro_batches % self.size == 0,
                    "pp.num_micro_batches must be a multiple of pp.size")
-        if self.virtual_stages > 1:
-            _check(self.schedule == "gpipe",
-                   "interleaved pipeline (virtual_stages > 1) runs under "
-                   "the gpipe schedule; 1f1b is contiguous-stage only")
+        # virtual_stages > 1 composes with BOTH schedules: gpipe uses the
+        # M-periodic interleave, 1f1b the Megatron group schedule (which
+        # needs M % P == 0 — already enforced above)
 
 
 @dataclass

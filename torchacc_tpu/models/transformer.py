@@ -834,4 +834,4 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     return pipeline_loss_1f1b(
         apply_block, head_loss, stacked, head_params, x, riders, labels,
         layer_xs, aux_scale, cfg.pp_size, M, pp_axis, moe_on,
-        not cfg.scan_layers)
+        not cfg.scan_layers, cfg.pp_virtual)

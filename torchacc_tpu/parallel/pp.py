@@ -304,6 +304,7 @@ def pipeline_train_1f1b(
     aux_from_block: bool = False,
     aux_scale: Optional[jax.Array] = None,
     unroll_stage: bool = False,
+    virtual_stages: int = 1,
 ):
     """One-forward-one-backward pipeline TRAIN step (loss + grads).
 
@@ -344,26 +345,52 @@ def pipeline_train_1f1b(
       ``router_aux_weight * valid_token_count(micro m)`` — computable
       upfront because it depends only on labels), and the same weight is
       the aux cotangent in the B sub-tick so gradients stay exact.
+    - ``virtual_stages=V > 1``: INTERLEAVED 1F1B (Megatron's virtual
+      pipeline under the 1F1B memory profile; requires ``M % P == 0``).
+      Device d holds V non-adjacent layer chunks (virtual stage
+      s = c*P + d).  The schedule is the Megatron group order: micro
+      m = g*P + r runs chunk c forward at tick ``t = g*V*P + c*P + d +
+      r`` and chunk c backward at ``t = (V*P-1) + g*V*P + (V-1-c)*P +
+      (P-1-d) + r``.  Both orders are collision-free and dense, every
+      chunk hop lands exactly one ppermute tick later (no wait queues),
+      and the last virtual stage's head dy is consumed the same tick it
+      is produced — all the V=1 invariants, with the fill/drain bubble
+      shrunk by 1/V.  Setting V=1 in these formulas reproduces the plain
+      schedule exactly (same ticks, same ring size).
     """
     mesh = mesh or _ambient_mesh()
     x = carry_in[0]
     B = x.shape[0]
     L = jax.tree.leaves(stacked_params)[0].shape[0]
+    V = virtual_stages
     if B % num_micro:
         raise ValueError(f"batch {B} not divisible by num_micro_batches "
                          f"{num_micro}")
-    if L % pp_size:
-        raise ValueError(f"num_layers {L} not divisible by pp size {pp_size}")
-    per_stage = L // pp_size
+    if L % (pp_size * V):
+        raise ValueError(f"num_layers {L} not divisible by pp size "
+                         f"{pp_size} x virtual_stages {V}")
+    if V > 1 and num_micro % pp_size:
+        raise ValueError(
+            f"interleaved 1f1b requires num_micro_batches ({num_micro}) "
+            f"divisible by pp size ({pp_size}) — the Megatron group "
+            "schedule runs micro groups of P through the V chunks")
+    per_stage = L // (pp_size * V)
     M, Pn = num_micro, pp_size
     mb = B // M
-    T = M + 2 * (Pn - 1)
-    S = min(2 * (Pn - 1) + 1, M)          # residual ring slots
+    VP = V * Pn
+    # total ticks: last backward is (g=M/P-1, c=0, r=P-1, d=0) at
+    # (VP-1) + (V*M - VP) + (V-1)*P + (P-1) + (P-1); V=1 -> M + 2(P-1)
+    T = V * M + VP + Pn - 2
+    # residual ring: F input of (m, c) banked at its F tick, consumed at
+    # most 2*V*P - 2 ticks later; bank order is dense so strides of
+    # 2*V*P - 1 never overlap.  V=1 -> min(2(P-1)+1, M), the plain size.
+    S = min(2 * VP - 1, V * M)
 
     staged = jax.tree.map(
-        lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), stacked_params)
+        lambda a: a.reshape((V, Pn, per_stage) + a.shape[1:]),
+        stacked_params)
     staged_xs = (None if layer_xs is None else jax.tree.map(
-        lambda a: a.reshape((Pn, per_stage) + a.shape[1:]), layer_xs))
+        lambda a: a.reshape((V, Pn, per_stage) + a.shape[1:]), layer_xs))
     # per-micro aux weights (see docstring); zeros when aux is off so the
     # traced structure is uniform
     scale_m = (jnp.zeros((M,), jnp.float32) if aux_scale is None
@@ -417,15 +444,24 @@ def pipeline_train_1f1b(
     uniform = any(int(v) > 1 for k, v in dict(mesh.shape).items()
                   if k != pp_axis) if mesh is not None else False
 
-    param_spec = jax.tree.map(lambda _: P(pp_axis), staged)
+    param_spec = jax.tree.map(lambda _: P(None, pp_axis), staged)
     data_spec = tuple(P() for _ in micro)
     head_spec = jax.tree.map(lambda _: P(), head_params)
 
     def region(params_local, head_p, xs_local, labels_m, *micro_local):
-        params_me = jax.tree.map(lambda a: a[0], params_local)  # [L/P, ...]
+        # [V, 1, L/(V*P), ...] -> [V, L/(V*P), ...]
+        params_me = jax.tree.map(lambda a: a[:, 0], params_local)
         me = jax.lax.axis_index(pp_axis)
-        xs_me = (jnp.zeros((per_stage,), jnp.int32) if xs_local is None
-                 else jax.tree.map(lambda a: a[0], xs_local))
+        xs_me = (jnp.zeros((V, per_stage), jnp.int32) if xs_local is None
+                 else jax.tree.map(lambda a: a[:, 0], xs_local))
+
+        def chunk_of(tree, c_idx):
+            # V == 1 keeps a fully static body (no gather per tick)
+            if V == 1:
+                return jax.tree.map(lambda a: a[0], tree)
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, c_idx, 0, keepdims=False), tree)
 
         def call_block(pl, c, xl):
             out = (apply_block(pl, c, xl) if layer_xs is not None
@@ -438,25 +474,25 @@ def pipeline_train_1f1b(
             pl, xl = pxs
             return call_block(pl, c, xl)
 
-        def _stage_unrolled(body, p, carry):
+        def _stage_unrolled(body, p, xs_c, carry):
             # unrolled layer application (scan_layers=False): static
             # slices keep per-layer saved residuals as separate buffers
             # (no [L/P, ...] DUS stacking — docs/PERF.md)
             aux_total = jnp.zeros((), jnp.float32)
             for j in range(per_stage):
                 pj = jax.tree.map(lambda a, j=j: a[j], p)
-                xj = jax.tree.map(lambda a, j=j: a[j], xs_me)
+                xj = jax.tree.map(lambda a, j=j: a[j], xs_c)
                 carry, aux = body(carry, (pj, xj))
                 aux_total = aux_total + aux
             return carry, aux_total
 
-        def stage(p, carry):
+        def stage(p, xs_c, carry):
             if unroll_stage:
-                return _stage_unrolled(one, p, carry)
-            carry, auxs = jax.lax.scan(one, carry, (p, xs_me))
+                return _stage_unrolled(one, p, xs_c, carry)
+            carry, auxs = jax.lax.scan(one, carry, (p, xs_c))
             return carry, jnp.sum(auxs)
 
-        def stage_remat(p, carry):
+        def stage_remat(p, xs_c, carry):
             # B sub-tick: per-LAYER remat, so the vjp's scan residuals
             # are the small inter-layer carries, not every layer's
             # attention internals stacked [L/P, ...] at once (that stack
@@ -464,24 +500,11 @@ def pipeline_train_1f1b(
             body = jax.checkpoint(one, policy=remat_policy,
                                   prevent_cse=False)
             if unroll_stage:
-                return _stage_unrolled(body, p, carry)
-            carry, auxs = jax.lax.scan(body, carry, (p, xs_me))
+                return _stage_unrolled(body, p, xs_c, carry)
+            carry, auxs = jax.lax.scan(body, carry, (p, xs_c))
             return carry, jnp.sum(auxs)
 
-        def _pad_to_T(c):
-            return jax.tree.map(
-                lambda a: jnp.concatenate(
-                    [a, jnp.zeros((T - a.shape[0],) + a.shape[1:],
-                                  a.dtype)], 0), c)
-
-        feed = tuple(_pad_to_T(c) for c in micro_local)         # F feed @ t
-        # labels consumed by the last stage at t = m + (P-1)
-        lab_feed = jnp.concatenate([
-            jnp.zeros((Pn - 1,) + labels_m.shape[1:], labels_m.dtype),
-            labels_m,
-            jnp.zeros((T - M - (Pn - 1),) + labels_m.shape[1:],
-                      labels_m.dtype)], 0)
-
+        micro_stack = tuple(micro_local)        # each [M, mb, ...]
         zero_mb = tuple(jax.tree.map(
             lambda a: jnp.zeros(a.shape[1:], a.dtype), c)
             for c in micro_local)
@@ -500,21 +523,50 @@ def pipeline_train_1f1b(
         def body(state, xs):
             (f_hand, b_hand, ring_buf, dp, dhead, dx_bank,
              loss_sum, count) = state
-            t, lab_t, fed = xs
-            f_idx = t - me
-            b_idx = t - 2 * (Pn - 1) + me
-            f_on = jnp.logical_and(f_idx >= 0, f_idx < M)
-            b_on = jnp.logical_and(b_idx >= 0, b_idx < M)
+            t = xs
+            # ---- schedule decode (docstring): F of (m=g*P+r, chunk c)
+            # at u = t - me = g*V*P + c*P + r; B mirrors with offset
+            # VP-1 and reversed device/chunk order.  V=1 reduces to
+            # f_idx = t - me, b_idx = t - 2(P-1) + me, the plain ticks.
+            u_f = t - me
+            g_f = u_f // VP
+            rem_f = u_f % VP
+            c_f = rem_f // Pn
+            m_f = g_f * Pn + rem_f % Pn
+            f_on = jnp.logical_and(u_f >= 0, u_f < V * M)
+            u_b = t - (VP - 1) - (Pn - 1 - me)
+            g_b = u_b // VP
+            rem_b = u_b % VP
+            c_b = (V - 1) - rem_b // Pn
+            m_b = g_b * Pn + rem_b % Pn
+            b_on = jnp.logical_and(u_b >= 0, u_b < V * M)
+            # the banked F index of this tick's B pair (ring slot key)
+            u_fb = g_b * VP + c_b * Pn + rem_b % Pn
 
-            # F input: stage 0 ingests the feed, others the handoff
+            fed = tuple(jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, jnp.clip(m_f, 0, M - 1), 0, keepdims=False), c)
+                for c in micro_stack)
+            lab_t = jax.lax.dynamic_index_in_dim(
+                labels_m, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
+            p_f = chunk_of(params_me, jnp.clip(c_f, 0, V - 1))
+            xs_f = chunk_of(xs_me, jnp.clip(c_f, 0, V - 1))
+            p_b = chunk_of(params_me, jnp.clip(c_b, 0, V - 1))
+            xs_b = chunk_of(xs_me, jnp.clip(c_b, 0, V - 1))
+
+            # F input: chunk 0 on device 0 ingests the fresh micro;
+            # everything else (incl. device 0 on later chunks) takes the
+            # ring handoff, which the group schedule lands exactly one
+            # tick after the producer
+            ingest = jnp.logical_and(me == 0, c_f == 0)
             x_in = jax.tree.map(
-                lambda f, h: jnp.where(me == 0, f, h), fed, f_hand)
+                lambda f, h: jnp.where(ingest, f, h), fed, f_hand)
 
             # per-micro aux weight for this tick's F and B micro indices
             f_scale = jax.lax.dynamic_index_in_dim(
-                scale_m, jnp.clip(f_idx, 0, M - 1), 0, keepdims=False)
+                scale_m, jnp.clip(m_f, 0, M - 1), 0, keepdims=False)
             b_scale = jax.lax.dynamic_index_in_dim(
-                scale_m, jnp.clip(b_idx, 0, M - 1), 0, keepdims=False)
+                scale_m, jnp.clip(m_b, 0, M - 1), 0, keepdims=False)
 
             # ---- F sub-tick (head+loss fused on the last stage) ----
             def head_vjp(y):
@@ -528,6 +580,9 @@ def pipeline_train_1f1b(
                         jax.tree.map(lambda a: a.astype(jnp.float32), dhp),
                         dy.astype(jnp.float32))
 
+            # the head fires on the LAST virtual stage: device P-1,
+            # chunk V-1 (for V=1 that is the plain last-stage condition)
+            head_here = jnp.logical_and(me == Pn - 1, c_f == V - 1)
             if uniform:
                 # maskless control flow: every device runs stage + head
                 # every tick (on banked zeros during bubbles — finite
@@ -535,10 +590,10 @@ def pipeline_train_1f1b(
                 # GSPMD collective inside stage/head is issued in the
                 # same order on every pp rank
                 cin = (x_in[0].astype(compute_dtype),) + tuple(x_in[1:])
-                carry_out, aux = stage(params_me, cin)
+                carry_out, aux = stage(p_f, xs_f, cin)
                 y_raw = carry_out[0].astype(wire_dtype)
                 ls_h, cnt_h, dhp_h, dy_h = head_vjp(y_raw)
-                take_head = jnp.logical_and(f_on, me == Pn - 1)
+                take_head = jnp.logical_and(f_on, head_here)
                 y = jnp.where(f_on, y_raw, 0)
                 ls = jnp.where(f_on,
                                jnp.where(take_head, ls_h, 0.0)
@@ -550,7 +605,7 @@ def pipeline_train_1f1b(
             else:
                 def do_f(_):
                     cin = (x_in[0].astype(compute_dtype),) + tuple(x_in[1:])
-                    carry_out, aux = stage(params_me, cin)
+                    carry_out, aux = stage(p_f, xs_f, cin)
                     y = carry_out[0].astype(wire_dtype)
 
                     def last(_):
@@ -562,7 +617,7 @@ def pipeline_train_1f1b(
                                 jnp.zeros((), jnp.float32), zero_head(),
                                 jnp.zeros(y.shape, jnp.float32))
 
-                    ls, cnt, dhp, dy = jax.lax.cond(me == Pn - 1, last, mid,
+                    ls, cnt, dhp, dy = jax.lax.cond(head_here, last, mid,
                                                     None)
                     return y, ls + f_scale * aux, cnt, dhp, dy
 
@@ -578,8 +633,9 @@ def pipeline_train_1f1b(
             count = count + cnt
             dhead = jax.tree.map(jnp.add, dhead, dhp)
 
-            # bank this F's input (activation + riders) for its backward
-            slot_f = jnp.maximum(f_idx, 0) % S
+            # bank this F's input (activation + riders) for its backward;
+            # the dense F index u_f is the slot key (see S above)
+            slot_f = jnp.maximum(u_f, 0) % S
             ring_buf = jax.tree.map(
                 lambda r, v: jnp.where(
                     f_on,
@@ -588,11 +644,15 @@ def pipeline_train_1f1b(
                 ring_buf, tuple(x_in))
 
             # ---- B sub-tick (stage recompute under vjp) ----
-            slot_b = jnp.maximum(b_idx, 0) % S
+            slot_b = jnp.maximum(u_fb, 0) % S
             saved = jax.tree.map(
                 lambda r: jax.lax.dynamic_index_in_dim(
                     r, slot_b, 0, keepdims=False), ring_buf)
-            dy_in = jnp.where(me == Pn - 1, dy_last, b_hand)
+            # dy source: the last virtual stage consumes its own head dy
+            # (produced this same tick); every other (d, c) takes the
+            # cotangent handoff
+            dy_in = jnp.where(jnp.logical_and(me == Pn - 1, c_b == V - 1),
+                              dy_last, b_hand)
             # sequence B strictly after F (1F *then* 1B, like the
             # reference's per-cycle ordering) so the two sub-ticks'
             # working sets never coexist — without this barrier XLA may
@@ -604,10 +664,10 @@ def pipeline_train_1f1b(
 
                 def f_of(p, xact):
                     cin = (xact.astype(compute_dtype),) + riders
-                    carry_out, aux = stage_remat(p, cin)
+                    carry_out, aux = stage_remat(p, xs_b, cin)
                     return carry_out[0].astype(jnp.float32), aux
 
-                _, vjp = jax.vjp(f_of, params_me, saved[0])
+                _, vjp = jax.vjp(f_of, p_b, saved[0])
                 # the aux cotangent is the same per-micro weight the F
                 # sub-tick folded into loss_sum — grads stay exact
                 dpl, dxl = vjp((dy_in, b_scale))
@@ -621,18 +681,30 @@ def pipeline_train_1f1b(
             else:
                 def no_b(_):
                     return (jax.tree.map(
-                        lambda a: jnp.zeros(a.shape, jnp.float32),
+                        lambda a: jnp.zeros(a.shape[1:], jnp.float32),
                         params_me),
                         jnp.zeros(x_zero.shape, jnp.float32))
 
                 dpl, dxl = jax.lax.cond(b_on, b_vjp, no_b, None)
-            dp = jax.tree.map(jnp.add, dp, dpl)
+            # accumulate the chunk's grads into its [V, ...] row
+            if V == 1:
+                dp = jax.tree.map(lambda D, g: D + g[None], dp, dpl)
+            else:
+                cb_i = jnp.clip(c_b, 0, V - 1)
+                dp = jax.tree.map(
+                    lambda D, g: jax.lax.dynamic_update_index_in_dim(
+                        D,
+                        jax.lax.dynamic_index_in_dim(
+                            D, cb_i, 0, keepdims=False) + g,
+                        cb_i, 0),
+                    dp, dpl)
 
-            # stage 0's dx is the pipeline's input cotangent for micro b
+            # chunk 0 on device 0 emits the pipeline's input cotangent
             dx_bank = jnp.where(
-                jnp.logical_and(b_on, me == 0),
+                jnp.logical_and(
+                    b_on, jnp.logical_and(me == 0, c_b == 0)),
                 jax.lax.dynamic_update_index_in_dim(
-                    dx_bank, dxl, jnp.maximum(b_idx, 0), 0),
+                    dx_bank, dxl, jnp.clip(m_b, 0, M - 1), 0),
                 dx_bank)
 
             # ---- handoffs: activations forward, cotangents backward ----
@@ -651,22 +723,22 @@ def pipeline_train_1f1b(
                 ring0, dp0, dhead0, dx_bank0,
                 jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
         (_, _, _, dp, dhead, dx_bank, loss_sum, count), _ = jax.lax.scan(
-            body, init, (jnp.arange(T), lab_feed, feed))
+            body, init, jnp.arange(T))
 
         loss_sum = jax.lax.psum(loss_sum, pp_axis)
         count = jax.lax.psum(count, pp_axis)
         dhead = jax.tree.map(lambda a: jax.lax.psum(a, pp_axis), dhead)
         dx_all = jax.lax.psum(dx_bank, pp_axis)  # only stage 0 wrote
-        # [L/P, ...] local grads -> [1, L/P, ...]; the 'pp' out spec
-        # reassembles the stacked [P, L/P, ...] layout
-        dp_out = jax.tree.map(lambda a: a[None], dp)
+        # [V, L/(V*P), ...] local grads -> [V, 1, L/(V*P), ...]; the 'pp'
+        # out spec reassembles the stacked [V, P, L/(V*P), ...] layout
+        dp_out = jax.tree.map(lambda a: a[:, None], dp)
         return loss_sum, count, dp_out, dhead, dx_all
 
     out_specs = (P(), P(),
-                 jax.tree.map(lambda _: P(pp_axis), staged),
+                 jax.tree.map(lambda _: P(None, pp_axis), staged),
                  jax.tree.map(lambda _: P(), head_params),
                  P())
-    xs_spec = jax.tree.map(lambda _: P(pp_axis), staged_xs)
+    xs_spec = jax.tree.map(lambda _: P(None, pp_axis), staged_xs)
     loss_sum, count, dstaged, dhead, dx_micro = jax.shard_map(
         region, mesh=mesh,
         in_specs=(param_spec, head_spec, xs_spec, P()) + data_spec,
@@ -677,7 +749,7 @@ def pipeline_train_1f1b(
 
     # cotangent dtypes must match the primals' (custom_vjp contract)
     d_stacked = jax.tree.map(
-        lambda a, ref: a.reshape((L,) + a.shape[2:]).astype(ref.dtype),
+        lambda a, ref: a.reshape((L,) + a.shape[3:]).astype(ref.dtype),
         dstaged, stacked_params)
     dhead = jax.tree.map(lambda a, ref: a.astype(ref.dtype), dhead,
                          head_params)
@@ -685,11 +757,13 @@ def pipeline_train_1f1b(
     return (loss_sum, count), (d_stacked, dhead, dx)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 9, 10, 11, 12, 13))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(0, 1, 9, 10, 11, 12, 13, 14))
 def pipeline_loss_1f1b(apply_block, head_loss, stacked_params, head_params,
                        x, riders, labels, layer_xs, aux_scale,
                        pp_size, num_micro, pp_axis="pp",
-                       aux_from_block=False, unroll_stage=False):
+                       aux_from_block=False, unroll_stage=False,
+                       virtual_stages=1):
     """Differentiable (loss_sum, count) via the 1F1B schedule: the
     schedule already computed the grads during the forward, so the VJP
     just scales them by the loss cotangent (they are linear in it).
@@ -701,25 +775,25 @@ def pipeline_loss_1f1b(apply_block, head_loss, stacked_params, head_params,
         (x,) + tuple(riders), labels, pp_size=pp_size,
         num_micro=num_micro, pp_axis=pp_axis, layer_xs=layer_xs,
         aux_from_block=aux_from_block, aux_scale=aux_scale,
-        unroll_stage=unroll_stage)
+        unroll_stage=unroll_stage, virtual_stages=virtual_stages)
     return loss_sum, count
 
 
 def _pl1f1b_fwd(apply_block, head_loss, stacked_params, head_params,
                 x, riders, labels, layer_xs, aux_scale,
                 pp_size, num_micro, pp_axis="pp", aux_from_block=False,
-                unroll_stage=False):
+                unroll_stage=False, virtual_stages=1):
     (loss_sum, count), grads = pipeline_train_1f1b(
         apply_block, head_loss, stacked_params, head_params,
         (x,) + tuple(riders), labels, pp_size=pp_size,
         num_micro=num_micro, pp_axis=pp_axis, layer_xs=layer_xs,
         aux_from_block=aux_from_block, aux_scale=aux_scale,
-        unroll_stage=unroll_stage)
+        unroll_stage=unroll_stage, virtual_stages=virtual_stages)
     return (loss_sum, count), grads
 
 
 def _pl1f1b_bwd(apply_block, head_loss, pp_size, num_micro, pp_axis,
-                aux_from_block, unroll_stage, res, ct):
+                aux_from_block, unroll_stage, virtual_stages, res, ct):
     d_stacked, dhead, dx = res
     dls = ct[0]  # count is parameter-independent
     scale = lambda tree: jax.tree.map(
