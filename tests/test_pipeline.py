@@ -666,3 +666,32 @@ def test_pp_1f1b_interleaved_with_fsdp_and_dropout(devices):
     assert all(np.isfinite(a)), a
     assert a[-1] < a[0], a
     np.testing.assert_allclose(a, b, rtol=1e-6)  # seeded => reproducible
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_pp_1f1b_with_tp_matches_single(devices, fused):
+    """1F1B x TP (pp2 x tp2 x dp2): regression for the XLA SPMD-
+    partitioner CHECK crash (spmd_partitioner_util.cc:495) that fired
+    whenever the in-region head had tp-sharded weights or logits with a
+    data axis live — the head weights and the materialized logits are
+    now pinned tp-replicated inside the region (head grads still flow;
+    losses must match dp=8)."""
+    import optax
+
+    batches = list(_batches(4))
+    cfg_pp = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=4, schedule="1f1b"),
+        tp=ta.TPConfig(size=2),
+        dp=ta.DPConfig(size=2)))
+    cfg_pp.compute.fused_kernels = fused
+    t_pp, _ = accelerate(_model(), None, cfg_pp, optimizer=optax.adam(1e-3))
+    t_pp.init()
+    losses_pp = [float(t_pp.step(b)["loss"]) for b in batches]
+
+    cfg_1 = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)))
+    cfg_1.compute.fused_kernels = fused
+    t_1, _ = accelerate(_model(), None, cfg_1, optimizer=optax.adam(1e-3))
+    t_1.init()
+    losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
+
+    np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)

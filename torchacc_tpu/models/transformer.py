@@ -801,6 +801,23 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             new_c = tuple(new_c) + (seed_row,)
         return (new_c, aux) if moe_on else new_c
 
+    def _pin_logits(logits):
+        """Pin the in-region [mb, s, V] logits' VOCAB dim un-sharded: a
+        vocab dim GSPMD auto-shards over 'tp' puts tp collectives inside
+        the pp-manual tick body, which trips an XLA SPMD-partitioner
+        CHECK (spmd_partitioner_util.cc:495) whenever a data axis is
+        also live (same issue as the head-weight pin in parallel/pp.py
+        head_vjp).  Batch stays on the data axes; seq is left
+        unconstrained (sp may shard it)."""
+        from jax.sharding import PartitionSpec as _P
+
+        from torchacc_tpu.config import DATA_AXES
+        mesh = jax.sharding.get_abstract_mesh()
+        data = tuple(a for a in DATA_AXES
+                     if mesh is not None and a in getattr(mesh, "shape", {}))
+        return jax.lax.with_sharding_constraint(
+            logits, _P(data or None, _P.UNCONSTRAINED, None))
+
     def head_loss(hp, y, lab):
         xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
         w = (hp["embed"].T if cfg.tie_embeddings
@@ -812,8 +829,9 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             # pp/executor.py:283-321).  The batch view here carries the
             # micro's labels; losses needing other batch leaves should
             # use the gpipe schedule, whose loss runs outside the region.
-            logits = jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
-                                w.astype(jnp.float32))
+            logits = _pin_logits(
+                jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
+                           w.astype(jnp.float32)))
             res = custom_loss(softcap(logits, cfg.logit_softcap),
                               _MicroBatchView(labels=lab))
             if isinstance(res, tuple):
@@ -827,8 +845,9 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             return fused_linear_cross_entropy(
                 xn, w, lab, logit_softcap=cfg.logit_softcap,
                 scan_free=True)
-        logits = jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
-                            w.astype(jnp.float32))
+        logits = _pin_logits(
+            jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
+                       w.astype(jnp.float32)))
         return loss_sum_count(softcap(logits, cfg.logit_softcap), lab)
 
     return pipeline_loss_1f1b(

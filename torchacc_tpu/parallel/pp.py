@@ -570,10 +570,18 @@ def pipeline_train_1f1b(
 
             # ---- F sub-tick (head+loss fused on the last stage) ----
             def head_vjp(y):
+                # pin the head weights tp-replicated for the in-region
+                # compute: a vocab dim auto-sharded over 'tp' would put
+                # tp collectives inside the tick body, tripping an XLA
+                # SPMD-partitioner CHECK (spmd_partitioner_util.cc:495)
+                # when a data axis is also live
+                hp_rep = jax.tree.map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, P(*([None] * a.ndim))), head_p)
                 (ls, cnt), hvjp = jax.vjp(
                     lambda hp, yl: head_loss(
                         hp, yl.astype(compute_dtype), lab_t),
-                    head_p, y)
+                    hp_rep, y)
                 dhp, dy = hvjp((jnp.ones((), jnp.float32),
                                 jnp.zeros((), jnp.float32)))
                 return (ls, cnt,
@@ -727,19 +735,26 @@ def pipeline_train_1f1b(
 
         loss_sum = jax.lax.psum(loss_sum, pp_axis)
         count = jax.lax.psum(count, pp_axis)
-        dhead = jax.tree.map(lambda a: jax.lax.psum(a, pp_axis), dhead)
-        dx_all = jax.lax.psum(dx_bank, pp_axis)  # only stage 0 wrote
+        # dhead/dx leave the region as per-rank partials stacked over a
+        # leading 'pp' axis and are summed OUTSIDE: an in-region
+        # psum(pp) of head grads whose vocab dim GSPMD auto-shards over
+        # 'tp' trips an XLA SPMD-partitioner CHECK (partition-group
+        # mismatch, spmd_partitioner_util.cc:495) whenever a data axis
+        # is also live; the boundary-stack form partitions cleanly and
+        # XLA still fuses the outside sum into a reduce.
+        dhead_out = jax.tree.map(lambda a: a[None], dhead)
+        dx_out = dx_bank[None]
         # [V, L/(V*P), ...] local grads -> [V, 1, L/(V*P), ...]; the 'pp'
         # out spec reassembles the stacked [V, P, L/(V*P), ...] layout
         dp_out = jax.tree.map(lambda a: a[:, None], dp)
-        return loss_sum, count, dp_out, dhead, dx_all
+        return loss_sum, count, dp_out, dhead_out, dx_out
 
     out_specs = (P(), P(),
                  jax.tree.map(lambda _: P(None, pp_axis), staged),
-                 jax.tree.map(lambda _: P(), head_params),
-                 P())
+                 jax.tree.map(lambda _: P(pp_axis), head_params),
+                 P(pp_axis))
     xs_spec = jax.tree.map(lambda _: P(None, pp_axis), staged_xs)
-    loss_sum, count, dstaged, dhead, dx_micro = jax.shard_map(
+    loss_sum, count, dstaged, dhead_st, dx_st = jax.shard_map(
         region, mesh=mesh,
         in_specs=(param_spec, head_spec, xs_spec, P()) + data_spec,
         out_specs=out_specs,
@@ -751,8 +766,9 @@ def pipeline_train_1f1b(
     d_stacked = jax.tree.map(
         lambda a, ref: a.reshape((L,) + a.shape[3:]).astype(ref.dtype),
         dstaged, stacked_params)
-    dhead = jax.tree.map(lambda a, ref: a.astype(ref.dtype), dhead,
-                         head_params)
+    dhead = jax.tree.map(lambda a, ref: jnp.sum(a, 0).astype(ref.dtype),
+                         dhead_st, head_params)
+    dx_micro = jnp.sum(dx_st, 0)  # only stage 0 wrote
     dx = dx_micro.reshape((B,) + dx_micro.shape[2:]).astype(x.dtype)
     return (loss_sum, count), (d_stacked, dhead, dx)
 
