@@ -43,6 +43,8 @@ def parse_args(argv=None):
     p.add_argument("--pp_microbatches", type=int, default=None)
     p.add_argument("--pp_schedule", default="gpipe",
                    choices=["gpipe", "1f1b"])
+    p.add_argument("--pp_virtual", type=int, default=1,
+                   help="interleaved virtual stages per device")
     p.add_argument("--sp", type=int, default=1)
     p.add_argument("--sp_mode", default="ulysses",
                    choices=["ulysses", "ring", "2d"])
@@ -82,7 +84,8 @@ def _config_from_flags(args, dtype):
             pp=ta.PPConfig(size=args.pp,
                            num_micro_batches=(args.pp_microbatches
                                               or max(1, 2 * args.pp)),
-                           schedule=args.pp_schedule),
+                           schedule=args.pp_schedule,
+                           virtual_stages=args.pp_virtual),
             sp=ta.SPConfig(size=args.sp, mode=args.sp_mode,
                            intra_size=args.sp_intra),
             ep=ta.EPConfig(size=args.ep),

@@ -418,7 +418,8 @@ def pipeline_train_1f1b(
     # them take the same branch and the collective is uniform within its
     # group (verified on the emulated CPU mesh, whose in-process
     # communicator is the strictest rendezvous we have).
-    data_axes = tuple(a for a in ("dp", "fsdp")
+    from torchacc_tpu.config import DATA_AXES
+    data_axes = tuple(a for a in DATA_AXES
                       if mesh is not None and a in mesh.shape)
     ext = 1
     for a in data_axes:
@@ -443,6 +444,12 @@ def pipeline_train_1f1b(
     # cooldown sub-ticks genuinely shorten those ticks there.
     uniform = any(int(v) > 1 for k, v in dict(mesh.shape).items()
                   if k != pp_axis) if mesh is not None else False
+    # the head-weight pin in head_vjp is only needed (and only worth its
+    # replication cost) when a tp-like axis could shard the vocab dim:
+    # non-pp, non-data axes with extent > 1
+    tp_live = any(int(v) > 1 for k, v in dict(mesh.shape).items()
+                  if k != pp_axis and k not in data_axes) \
+        if mesh is not None else False
 
     param_spec = jax.tree.map(lambda _: P(None, pp_axis), staged)
     data_spec = tuple(P() for _ in micro)
@@ -570,14 +577,17 @@ def pipeline_train_1f1b(
 
             # ---- F sub-tick (head+loss fused on the last stage) ----
             def head_vjp(y):
-                # pin the head weights tp-replicated for the in-region
-                # compute: a vocab dim auto-sharded over 'tp' would put
-                # tp collectives inside the tick body, tripping an XLA
-                # SPMD-partitioner CHECK (spmd_partitioner_util.cc:495)
-                # when a data axis is also live
-                hp_rep = jax.tree.map(
+                # pin the head weights replicated for the in-region
+                # compute when a tp-like axis is live: a vocab dim
+                # auto-sharded over 'tp' would put tp collectives inside
+                # the tick body, tripping an XLA SPMD-partitioner CHECK
+                # (spmd_partitioner_util.cc:495) when a data axis is
+                # also live.  On tp-free meshes the pin is skipped so an
+                # fsdp-sharded head stays sharded.
+                hp_rep = (jax.tree.map(
                     lambda a: jax.lax.with_sharding_constraint(
                         a, P(*([None] * a.ndim))), head_p)
+                    if tp_live else head_p)
                 (ls, cnt), hvjp = jax.vjp(
                     lambda hp, yl: head_loss(
                         hp, yl.astype(compute_dtype), lab_t),
