@@ -640,6 +640,29 @@ def test_pp_1f1b_interleaved_exact_grads(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-5, rtol=1e-5, err_msg=name)
 
+    # per-micro-weighted aux losses (the MoE router-aux machinery) stay
+    # exact under the interleaved schedule too
+    aux_scale = jnp.asarray(rng.uniform(0.5, 2.0, (M,)), jnp.float32)
+
+    def apply_block_aux(p, c):
+        h = c[0]
+        h2 = h + jnp.tanh(h @ p)
+        return ((h2,) + tuple(c[1:])), jnp.mean(h2 ** 2)
+
+    def run_aux(v):
+        with jax.sharding.set_mesh(mesh):
+            return pipeline_train_1f1b(
+                apply_block_aux, head_loss, stacked, head, (x,), labels,
+                pp_size=Pn, num_micro=M, virtual_stages=v,
+                aux_from_block=True, aux_scale=aux_scale)
+
+    (la1, _), ga1 = run_aux(1)
+    (la2, _), ga2 = run_aux(2)
+    np.testing.assert_allclose(float(la2), float(la1), rtol=1e-6)
+    for a, b, name in zip(ga2, ga1, ("dstack", "dhead", "dx")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6, rtol=1e-6, err_msg=name)
+
 
 def test_pp_1f1b_interleaved_with_fsdp_and_dropout(devices):
     """Interleaved 1F1B on a mixed mesh (uniform tick body) with

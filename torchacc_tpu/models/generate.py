@@ -6,7 +6,8 @@ shells out to vLLM for inference).  The cached path runs a prefill
 forward that banks every layer's rotated k / raw v into the flax
 ``cache`` collection, then decodes all ``max_new_tokens`` steps inside
 ONE ``lax.scan`` under one jit — no per-token host sync, no prefix
-recompute; eos handling is pure masking inside the scan.
+recompute; eos handling is pure masking inside the scan.  Ragged
+batches decode via LEFT-padded prompts + ``prompt_mask``.
 """
 
 from __future__ import annotations
@@ -28,13 +29,25 @@ def _sample(logits, rng, temperature):
 @functools.partial(jax.jit, static_argnames=("model", "dec_model",
                                              "temperature", "max_new",
                                              "eos_id"))
-def _generate_cached(model, dec_model, params, prompt_ids, rng,
-                     temperature, max_new, eos_id):
+def _generate_cached(model, dec_model, params, prompt_ids, prompt_mask,
+                     rng, temperature, max_new, eos_id):
     b, p = prompt_ids.shape
+
+    if prompt_mask is not None:
+        mask = prompt_mask.astype(jnp.int32)
+        # left-padded: real tokens are right-aligned, so row i's token at
+        # column j sits at position j - pad_len_i; sampling at column
+        # p-1 is every row's last real token
+        positions = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0, None)
+        row_len = jnp.sum(mask, axis=1)                      # [b]
+        pre_kwargs = dict(positions=positions, segment_ids=mask)
+    else:
+        row_len = jnp.full((b,), p, jnp.int32)
+        pre_kwargs = {}
 
     # prefill: logits for the whole prompt + per-layer kv cache
     logits, vars_ = model.apply({"params": params}, prompt_ids,
-                                mutable=["cache"])
+                                mutable=["cache"], **pre_kwargs)
     cache = vars_["cache"]
     rng, sub = jax.random.split(rng)
     first = _sample(logits[:, p - 1], sub, temperature).astype(jnp.int32)
@@ -44,7 +57,12 @@ def _generate_cached(model, dec_model, params, prompt_ids, rng,
 
     def step(carry, pos):
         cache, tok, done, rng = carry
-        positions = jnp.broadcast_to(pos[None], (b, 1))
+        # per-row TRUE position of the token being decoded: the cache
+        # slot index is uniform (pos) but row i has pad_len_i pads, so
+        # its rope position is pos - pad_len_i
+        positions = (row_len + (pos - p))[:, None]
+        # ragged masking in decode is driven by the banked 'seg' cache
+        # (written at prefill), not a segment_ids argument
         logits1, upd = dec_model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             positions=positions, mutable=["cache"])
@@ -75,18 +93,46 @@ def generate(
     rng: Optional[jax.Array] = None,
     eos_id: Optional[int] = None,
     use_cache: bool = True,
+    prompt_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode ``max_new_tokens`` after ``prompt_ids`` [b, p].
 
     ``use_cache=True`` (default, zoo models): prefill + single-scan
     KV-cache decode — O(n) attention reads, one compile, zero per-token
     host syncs.  ``use_cache=False`` or non-zoo models: full-prefix
-    recompute fallback (O(n^2) compute, still one compile).
+    recompute fallback.
+
+    ``prompt_mask`` [b, p] (1 = real token) enables RAGGED batches:
+    prompts must be LEFT-padded (real tokens right-aligned, the standard
+    decode convention).  Positions and attention masking account for
+    each row's padding; outputs keep the [b, p + max_new] layout.
+    Requires the model to follow the ``(input_ids, positions,
+    segment_ids)`` call convention (zoo models and the custom-model
+    protocol do; a bare ``(input_ids) -> logits`` model works only
+    without ``prompt_mask``).
+
     temperature 0 = greedy; eos_id freezes finished rows at eos.
     """
     b, p = prompt_ids.shape
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if prompt_mask is not None:
+        m = jnp.asarray(prompt_mask)
+        if m.shape != (b, p):
+            raise ValueError(f"prompt_mask shape {m.shape} != {(b, p)}")
+        try:  # host-side sanity when concrete: left-padded = non-decreasing
+            import numpy as _np
+            mm = _np.asarray(m).astype(_np.int32)
+            if not (_np.diff(mm, axis=1) >= 0).all():
+                raise ValueError(
+                    "prompt_mask must be LEFT-padded (real tokens "
+                    "right-aligned): found a 0 after a 1")
+            if not mm[:, -1].all():
+                raise ValueError("prompt_mask: last column must be real "
+                                 "(left-padding)")
+        except jax.errors.TracerArrayConversionError:
+            pass
+        prompt_mask = m
     cfg = getattr(model, "cfg", None)
     # window/ALiBi decode runs through the cache branch (q_offset aligns
     # the decode-row geometry); pp/cp decode uses the full-forward
@@ -113,9 +159,10 @@ def generate(
         dec_model = TransformerLM(dataclasses.replace(cfg, decode=True,
                                                       cache_len=total))
         return _generate_cached(pre_model, dec_model, params, prompt_ids,
-                                rng, float(temperature),
+                                prompt_mask, rng, float(temperature),
                                 int(max_new_tokens), eos_id)
     return _generate_recompute(model, params, prompt_ids,
+                               prompt_mask=prompt_mask,
                                max_new_tokens=max_new_tokens,
                                temperature=temperature, rng=rng,
                                eos_id=eos_id)
@@ -126,9 +173,14 @@ def generate(
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit, static_argnames=("model", "temperature"))
-def _decode_step(model, params, tokens, cur, rng, temperature):
+def _decode_step(model, params, tokens, mask_full, cur, rng, temperature):
     b = tokens.shape[0]
-    logits = model.apply({"params": params}, tokens)
+    if mask_full is not None:
+        positions = jnp.clip(jnp.cumsum(mask_full, axis=1) - 1, 0, None)
+        logits = model.apply({"params": params}, tokens,
+                             positions=positions, segment_ids=mask_full)
+    else:
+        logits = model.apply({"params": params}, tokens)
     # logits at position cur-1 predict token cur
     next_logits = jnp.take_along_axis(
         logits, (cur - 1)[None, None, None].repeat(b, 0), axis=1)[:, 0]
@@ -138,17 +190,23 @@ def _decode_step(model, params, tokens, cur, rng, temperature):
 
 
 def _generate_recompute(model, params, prompt_ids, *, max_new_tokens,
-                        temperature, rng, eos_id):
+                        temperature, rng, eos_id, prompt_mask=None):
     b, p = prompt_ids.shape
     total = p + max_new_tokens
     tokens = jnp.zeros((b, total), jnp.int32)
     tokens = tokens.at[:, :p].set(prompt_ids)
+    mask_full = None
+    if prompt_mask is not None:
+        # generated tokens are always real
+        mask_full = jnp.concatenate(
+            [prompt_mask.astype(jnp.int32),
+             jnp.ones((b, max_new_tokens), jnp.int32)], axis=1)
 
     done = jnp.zeros((b,), jnp.bool_)
     for i in range(max_new_tokens):
         cur = jnp.asarray(p + i)
-        new_tokens, rng = _decode_step(model, params, tokens, cur, rng,
-                                       temperature)
+        new_tokens, rng = _decode_step(model, params, tokens, mask_full,
+                                       cur, rng, temperature)
         if eos_id is not None:
             prev = tokens
             new_col = new_tokens[:, p + i]

@@ -279,6 +279,18 @@ class Attention(nn.Module):
                 cv.value = jax.lax.dynamic_update_slice(
                     cv.value, v.astype(cfg.dtype), (0, pos, 0, 0))
                 cidx.value = pos + s
+                # ragged (left-padded) prompts: prefill banked per-slot
+                # validity in the 'seg' cache; decode-appended tokens are
+                # always real.  Segment equality masks each row's pad
+                # slots out of the attention.
+                qseg = kvseg = None
+                if self.has_variable("cache", "seg"):
+                    cseg = self.variable("cache", "seg", jnp.ones,
+                                         (b, max_len), jnp.int32)
+                    cseg.value = jax.lax.dynamic_update_slice(
+                        cseg.value, jnp.ones((b, s), jnp.int32), (0, pos))
+                    qseg = jnp.ones((b, s), jnp.int32)
+                    kvseg = cseg.value
                 # the query's TRUE position is pos while it sits at row 0
                 # of a [1, kv_len] score matrix: q_offset re-aligns the
                 # geometry so the shared mask/bias machinery gives exact
@@ -292,6 +304,7 @@ class Attention(nn.Module):
                 out = attention_reference(
                     q, ck.value, cv.value, causal=True, window=cfg.window,
                     alibi_slopes=slopes,
+                    q_segment_ids=qseg, kv_segment_ids=kvseg,
                     q_offset=pos - (kv_len - s))
                 return nn.DenseGeneral(
                     features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
@@ -305,6 +318,14 @@ class Attention(nn.Module):
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, 0, 0, 0))
             cidx.value = jnp.asarray(s, jnp.int32)
+            if segment_ids is not None:
+                # ragged (left-padded) prompts: bank per-slot validity so
+                # decode can mask each row's pad slots (slots past the
+                # prompt default to 1 = real, written again at decode)
+                cseg = self.variable("cache", "seg", jnp.ones,
+                                     (b, max_len), jnp.int32)
+                cseg.value = jax.lax.dynamic_update_slice(
+                    cseg.value, segment_ids.astype(jnp.int32), (0, 0))
         # per-layer decorrelation already happened in TransformerLM
         # (seeds_xs = _layer_seed(seed, arange(L)))
         dropout_p, seed = 0.0, None
