@@ -1,11 +1,12 @@
-"""Two-process jax.distributed smoke test over localhost (VERDICT weak-9:
+"""Two-process jax.distributed tests over localhost (VERDICT weak-9:
 multi-host init had no executed coverage; reference analogue is the
 torchrun-driven init_process_group path, dist/__init__.py:45-98).
 
 Each subprocess owns 2 emulated CPU devices; after
-``initialize_distributed`` the global mesh spans 4 devices across the two
-processes and a dp-sharded train step runs one optimizer update with a
-cross-process gradient psum.
+``initialize_distributed`` the global mesh spans 4 devices across the
+two processes.  Two legs: a dp-sharded step (cross-process gradient
+psum) and a 1F1B pipeline step whose ppermute ring crosses the process
+boundary (pp = outermost mesh axis).
 """
 
 import socket
@@ -34,17 +35,26 @@ import torchacc_tpu as ta
 from torchacc_tpu.models import get_preset
 from torchacc_tpu.train import accelerate
 
-cfg = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=4)))
+mode = sys.argv[3]
+if mode == "dp":
+    cfg = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=4)))
+else:  # the 1F1B ppermute ring spans the two PROCESSES (pp outermost)
+    cfg = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=2, schedule="1f1b"),
+        dp=ta.DPConfig(size=2),
+        topology=("pp", "dp", "fsdp", "sp", "spu", "ep", "tp")))
 mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32, num_layers=2,
                 num_heads=4, num_kv_heads=2, intermediate_size=64,
                 dtype=jnp.float32)
 trainer, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
 trainer.init()
-rng = np.random.default_rng(pid)  # each process feeds its local shard
-local = rng.integers(0, 64, size=(8, 16)).astype(np.int32)
 from jax.experimental import multihost_utils
 from jax.sharding import PartitionSpec as PS
-# local [8,16] rows become this process's dp shard of the global [16,16]
+# dp mode: each process feeds its local dp shard of the global batch.
+# pp mode: pp spans the processes, the batch axes are process-local, so
+# both processes feed the SAME global batch (seed 0).
+seed = pid if mode == "dp" else 0
+local = np.random.default_rng(seed).integers(0, 64, (8, 16)).astype(np.int32)
 arr = multihost_utils.host_local_array_to_global_array(
     local, trainer.mesh, PS(("dp", "fsdp"), ("sp", "spu")))
 loss = float(trainer.step({"input_ids": arr})["loss"])
@@ -53,13 +63,12 @@ print(f"proc {pid} ok loss={loss:.4f} primary={is_primary()}", flush=True)
 """
 
 
-@pytest.mark.slow
-def test_two_process_dp_step(tmp_path):
+def _run_two_procs(mode):
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _WORKER, str(port), str(i)],
+        [sys.executable, "-c", _WORKER, str(port), str(i), mode],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     outs = []
@@ -73,3 +82,23 @@ def test_two_process_dp_step(tmp_path):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
         assert f"proc {i} ok" in out, out[-2000:]
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_dp_step(tmp_path):
+    _run_two_procs("dp")
+
+
+@pytest.mark.slow
+def test_two_process_pp_1f1b_step(tmp_path):
+    """The 1F1B ppermute ring crosses the PROCESS boundary: pp is the
+    outermost (slowest-network) mesh axis over two jax.distributed
+    processes — the multi-host story for the flagship schedule
+    (reference analogue: NCCL send/recv between stage processes,
+    pp/p2p.py)."""
+    outs = _run_two_procs("pp")
+    # one SPMD program: both processes report the identical loss
+    l0 = outs[0].split("proc 0 ok loss=")[1].split()[0]
+    l1 = outs[1].split("proc 1 ok loss=")[1].split()[0]
+    assert l0 == l1, (l0, l1)
