@@ -82,6 +82,11 @@ _SLOW = {
     "test_remat_policies_train",
     "test_cp_grads_match_local",
     "test_cp_window_grads_match_local",
+    "test_pp_1f1b_interleaved_matches_single",
+    "test_pp_1f1b_interleaved_with_fsdp_and_dropout",
+    "test_pp_1f1b_with_tp_matches_single",
+    "test_pp_unrolled_layers_matches_scan",
+    "test_ep_x_pp_composition",
 }
 
 

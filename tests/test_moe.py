@@ -126,3 +126,37 @@ def test_moe_capacity_tight_drops_but_trains(devices):
                                  optimizer=optax.adam(3e-3))
     losses = [float(trainer.step(b)["loss"]) for b in loader]
     assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+@pytest.fixture(scope="module")
+def _dp8_moe_losses(devices):
+    """Shared dp=8 baseline for the EP x PP parametrizations."""
+    import optax
+
+    batches = list(_batches(4, seed=2))
+    cfg_dp = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)))
+    t2, _ = accelerate(_moe_model(), None, cfg_dp, optimizer=optax.adam(1e-3))
+    t2.init()
+    return [float(t2.step(b)["loss"]) for b in batches]
+
+
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_ep_x_pp_composition(devices, sched, _dp8_moe_losses):
+    """EP x PP (ep=2 inside the pipeline stages, pp=2, dp=2): experts
+    stay ep-sharded while layers stage-shard over pp; losses match dp=8
+    (reference has no EP at all — beyond-reference composition)."""
+    import optax
+
+    batches = list(_batches(4, seed=2))
+    cfg_pp = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=2, schedule=sched),
+        ep=ta.EPConfig(size=2),
+        dp=ta.DPConfig(size=2)))
+    t1, _ = accelerate(_moe_model(), None, cfg_pp, optimizer=optax.adam(1e-3))
+    t1.init()
+    l1 = [float(t1.step(b)["loss"]) for b in batches]
+    w = t1.state.params["layers"]["block"]["moe"]["experts/gate"]
+    spec = str(w.sharding.spec)
+    assert "ep" in spec and "pp" in spec, spec
+
+    np.testing.assert_allclose(l1, _dp8_moe_losses, rtol=2e-4)
