@@ -4,16 +4,19 @@ Reference: the accuracy benchmark suite trains the SAME model under
 torch and under torchacc on identical data/hyper-parameters and compares
 loss curves (+ downstream eval) — benchmarks/accuracy/README.md:95-109,
 .github/workflows/accuracy_benchmark.yml.  TPU-native equivalent: build
-a small HF Llama in torch (CPU), fine-tune it with a plain torch loop,
-convert the SAME initial weights through models/hf.py and fine-tune with
-this framework's Trainer on the SAME token stream and hyper-parameters,
-then require the two loss curves to agree step by step.
+a small HF model in torch (CPU; --family llama or qwen2), fine-tune it
+with a plain torch loop, convert the SAME initial weights through
+models/hf.py and fine-tune with this framework's Trainer on the SAME
+token stream and hyper-parameters, then require (a) the two loss curves
+to agree step by step, (b) the tuned models' heldout losses to agree
+(the downstream-eval leg), and (c) training to actually improve.
 
 One command, one JSON verdict line::
 
-    python benchmarks/accuracy_parity.py [--steps 20] [--tol 0.02]
+    python benchmarks/accuracy_parity.py [--steps 20] [--tol 0.02] \
+        [--family llama|qwen2]
 
-Exit code 0 iff the curves agree within --tol max relative deviation.
+Exit code 0 iff all three gates hold.
 """
 
 from __future__ import annotations
@@ -83,17 +86,7 @@ def converted_curve(hf_model, ids, steps, lr, heldout):
     return losses, sum(ev) / len(ev)
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--lr", type=float, default=5e-3)
-    ap.add_argument("--tol", type=float, default=0.02,
-                    help="max allowed relative loss deviation")
-    ap.add_argument("--batch", type=int, default=4)
-    ap.add_argument("--seq", type=int, default=64)
-    args = ap.parse_args(argv)
-
-    import numpy as np
+def _build_hf(family: str, seq: int):
     import torch
     import transformers
 
@@ -101,11 +94,34 @@ def main(argv=None) -> int:
     # trains a different model (and the `improved` gate on a short run
     # becomes a coin flip)
     torch.manual_seed(0)
-    hf_cfg = transformers.LlamaConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        max_position_embeddings=args.seq, rope_theta=10000.0)
-    hf_model = transformers.LlamaForCausalLM(hf_cfg).float()
+    kw = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=seq,
+              rope_theta=10000.0)
+    if family == "llama":
+        return transformers.LlamaForCausalLM(
+            transformers.LlamaConfig(**kw)).float()
+    if family == "qwen2":  # qkv bias — the reference's Qwen patch target
+        return transformers.Qwen2ForCausalLM(
+            transformers.Qwen2Config(**kw)).float()
+    raise ValueError(family)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=3e-2)
+    ap.add_argument("--tol", type=float, default=0.02,
+                    help="max allowed relative loss deviation")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--family", default="llama",
+                    choices=["llama", "qwen2"])
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    hf_model = _build_hf(args.family, args.seq)
 
     rng = np.random.default_rng(0)
     # tokens from a quarter of the vocab: LEARNABLE data (the model
@@ -137,7 +153,7 @@ def main(argv=None) -> int:
     improved = ours[-1] < ours[0]
     ok = bool(max_dev <= args.tol and ev_dev <= args.tol and improved)
     print(json.dumps({
-        "metric": "accuracy_parity_llama_sft",
+        "metric": f"accuracy_parity_{args.family}_sft",
         "ok": ok,
         "max_rel_dev": round(max_dev, 5),
         "tol": args.tol,

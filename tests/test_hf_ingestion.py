@@ -110,9 +110,12 @@ def test_converted_model_trains(devices):
 
 
 @pytest.mark.slow
-def test_accuracy_parity_harness():
+@pytest.mark.parametrize("family", ["llama", "qwen2"])
+def test_accuracy_parity_harness(family):
     """The one-command torch-vs-converted training comparison (reference
-    benchmarks/accuracy/ analogue) emits ok=true."""
+    benchmarks/accuracy/ analogue) emits ok=true — loss-curve parity,
+    heldout eval of the tuned model, and a real improvement gate, per
+    model family."""
     import json
     import os
     import subprocess
@@ -122,7 +125,8 @@ def test_accuracy_parity_harness():
     env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
     r = subprocess.run(
         [sys.executable, os.path.join(root, "benchmarks",
-                                      "accuracy_parity.py"), "--steps", "6"],
+                                      "accuracy_parity.py"), "--steps", "6",
+         "--family", family],
         capture_output=True, text=True, timeout=480, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     verdict = json.loads(r.stdout.strip().splitlines()[-1])
