@@ -300,3 +300,44 @@ def test_generate_ragged_left_padded():
     with pytest.raises(ValueError):
         generate(model, params, batch_ids, prompt_mask=bad,
                  max_new_tokens=2)
+
+
+def test_generate_top_k_top_p():
+    """top-k / nucleus truncation: sampled tokens always come from the
+    allowed set; top_k=1 equals greedy; cached == fallback shapes."""
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+    from torchacc_tpu.models.generate import _sample
+
+    # unit check on the truncation itself
+    logits = jnp.asarray([[2.0, 1.0, 0.5, -1.0, -3.0]])
+    for _ in range(5):
+        t = int(_sample(logits, jax.random.PRNGKey(_), 1.0, top_k=2)[0])
+        assert t in (0, 1), t
+    # top_p small enough to keep only the argmax
+    t = int(_sample(logits, jax.random.PRNGKey(0), 1.0, top_p=0.05)[0])
+    assert t == 0
+    # degenerate top_p=0 keeps the argmax (greedy), never an all--inf row
+    for seed in range(3):
+        t = int(_sample(logits, jax.random.PRNGKey(seed), 1.0,
+                        top_p=0.0)[0])
+        assert t == 0
+    # top_k=1 == greedy regardless of rng
+    for seed in range(3):
+        t = int(_sample(logits, jax.random.PRNGKey(seed), 1.0, top_k=1)[0])
+        assert t == 0
+
+    mc = get_preset("llama-tiny", vocab_size=50, hidden_size=32,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.float32)
+    model = TransformerLM(mc)
+    prompt = jnp.asarray([[3, 7, 11]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    # top_k=1 sampling must equal greedy end-to-end
+    greedy = generate(model, params, prompt, max_new_tokens=6)
+    k1 = generate(model, params, prompt, max_new_tokens=6,
+                  temperature=0.8, top_k=1, rng=jax.random.PRNGKey(9))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+    out = generate(model, params, prompt, max_new_tokens=6,
+                   temperature=0.8, top_k=5, top_p=0.9,
+                   rng=jax.random.PRNGKey(1))
+    assert out.shape == (1, 9)

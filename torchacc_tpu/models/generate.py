@@ -20,17 +20,38 @@ import jax
 import jax.numpy as jnp
 
 
-def _sample(logits, rng, temperature):
-    if temperature > 0:
-        return jax.random.categorical(rng, logits / temperature)
-    return jnp.argmax(logits, axis=-1)
+def _sample(logits, rng, temperature, top_k=0, top_p=1.0):
+    """Greedy (temperature 0) or temperature sampling with optional
+    top-k / nucleus (top-p) truncation (standard decode controls; the
+    reference is training-only and defers generation to vLLM)."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k and top_k > 0:
+        # k-th largest as the cutoff (O(V log k), not a full sort)
+        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][
+            ..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # keep the smallest prefix of descending-prob tokens with
+        # cumulative probability > top_p; the argmax is ALWAYS kept
+        # (top_p <= 0 must degrade to greedy, not an all--inf row)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep_sorted = cum - probs < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
+        kth = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                      axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits)
 
 
 @functools.partial(jax.jit, static_argnames=("model", "dec_model",
                                              "temperature", "max_new",
-                                             "eos_id"))
+                                             "eos_id", "top_k", "top_p"))
 def _generate_cached(model, dec_model, params, prompt_ids, prompt_mask,
-                     rng, temperature, max_new, eos_id):
+                     rng, temperature, max_new, eos_id, top_k, top_p):
     b, p = prompt_ids.shape
 
     if prompt_mask is not None:
@@ -50,7 +71,8 @@ def _generate_cached(model, dec_model, params, prompt_ids, prompt_mask,
                                 mutable=["cache"], **pre_kwargs)
     cache = vars_["cache"]
     rng, sub = jax.random.split(rng)
-    first = _sample(logits[:, p - 1], sub, temperature).astype(jnp.int32)
+    first = _sample(logits[:, p - 1], sub, temperature, top_k,
+                    top_p).astype(jnp.int32)
     done0 = jnp.zeros((b,), jnp.bool_)
     if eos_id is not None:
         done0 = first == eos_id
@@ -67,7 +89,8 @@ def _generate_cached(model, dec_model, params, prompt_ids, prompt_mask,
             {"params": params, "cache": cache}, tok[:, None],
             positions=positions, mutable=["cache"])
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits1[:, 0], sub, temperature).astype(jnp.int32)
+        nxt = _sample(logits1[:, 0], sub, temperature, top_k,
+                      top_p).astype(jnp.int32)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.int32(eos_id), nxt)
             done = done | (nxt == eos_id)
@@ -94,6 +117,8 @@ def generate(
     eos_id: Optional[int] = None,
     use_cache: bool = True,
     prompt_mask: Optional[jax.Array] = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Decode ``max_new_tokens`` after ``prompt_ids`` [b, p].
 
@@ -111,7 +136,9 @@ def generate(
     protocol do; a bare ``(input_ids) -> logits`` model works only
     without ``prompt_mask``).
 
-    temperature 0 = greedy; eos_id freezes finished rows at eos.
+    temperature 0 = greedy; ``top_k``/``top_p`` truncate the sampling
+    distribution (ignored when greedy); eos_id freezes finished rows at
+    eos.
     """
     b, p = prompt_ids.shape
     if rng is None:
@@ -160,20 +187,24 @@ def generate(
                                                       cache_len=total))
         return _generate_cached(pre_model, dec_model, params, prompt_ids,
                                 prompt_mask, rng, float(temperature),
-                                int(max_new_tokens), eos_id)
+                                int(max_new_tokens), eos_id,
+                                int(top_k), float(top_p))
     return _generate_recompute(model, params, prompt_ids,
                                prompt_mask=prompt_mask,
                                max_new_tokens=max_new_tokens,
                                temperature=temperature, rng=rng,
-                               eos_id=eos_id)
+                               eos_id=eos_id, top_k=int(top_k),
+                               top_p=float(top_p))
 
 
 # ---------------------------------------------------------------------------
 # fallback: full-prefix recompute (works for any (input_ids)->logits model)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("model", "temperature"))
-def _decode_step(model, params, tokens, mask_full, cur, rng, temperature):
+@functools.partial(jax.jit, static_argnames=("model", "temperature",
+                                             "top_k", "top_p"))
+def _decode_step(model, params, tokens, mask_full, cur, rng, temperature,
+                 top_k, top_p):
     b = tokens.shape[0]
     if mask_full is not None:
         positions = jnp.clip(jnp.cumsum(mask_full, axis=1) - 1, 0, None)
@@ -185,12 +216,13 @@ def _decode_step(model, params, tokens, mask_full, cur, rng, temperature):
     next_logits = jnp.take_along_axis(
         logits, (cur - 1)[None, None, None].repeat(b, 0), axis=1)[:, 0]
     rng, sub = jax.random.split(rng)
-    nxt = _sample(next_logits, sub, temperature)
+    nxt = _sample(next_logits, sub, temperature, top_k, top_p)
     return tokens.at[:, cur].set(nxt.astype(jnp.int32)), rng
 
 
 def _generate_recompute(model, params, prompt_ids, *, max_new_tokens,
-                        temperature, rng, eos_id, prompt_mask=None):
+                        temperature, rng, eos_id, prompt_mask=None,
+                        top_k=0, top_p=1.0):
     b, p = prompt_ids.shape
     total = p + max_new_tokens
     tokens = jnp.zeros((b, total), jnp.int32)
@@ -206,7 +238,7 @@ def _generate_recompute(model, params, prompt_ids, *, max_new_tokens,
     for i in range(max_new_tokens):
         cur = jnp.asarray(p + i)
         new_tokens, rng = _decode_step(model, params, tokens, mask_full,
-                                       cur, rng, temperature)
+                                       cur, rng, temperature, top_k, top_p)
         if eos_id is not None:
             prev = tokens
             new_col = new_tokens[:, p + i]
