@@ -372,13 +372,15 @@ class Trainer:
         return jax.tree.map(one, self._abstract, self.state_shardings,
                             is_leaf=lambda x: x is None)
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, blocking: bool = True):
         """Sharded checkpoint of the full train state (reference:
-        per-rank ``ta.save`` + shard_metadata, docs/source/dist/fsdp.md)."""
+        per-rank ``ta.save`` + shard_metadata, docs/source/dist/fsdp.md).
+        ``blocking=False`` snapshots and writes in the background;
+        call ``.wait()`` on the returned handle before relying on it."""
         if self.state is None:
             raise RuntimeError("nothing to save — call init() (or step) first")
         from torchacc_tpu.checkpoint import save_checkpoint
-        save_checkpoint(path, self.state)
+        return save_checkpoint(path, self.state, blocking=blocking)
 
     def restore(self, path: str) -> TrainState:
         """Restore (and reshard if the mesh/layout changed).  Does NOT
