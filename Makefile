@@ -4,7 +4,7 @@
 
 PYTEST ?= python -m pytest
 
-.PHONY: test test-all bench lint dryrun
+.PHONY: test test-all bench lint dryrun tpu-watch
 
 test:
 	$(PYTEST) tests/ -q -m "not slow"
@@ -22,3 +22,8 @@ dryrun:
 
 lint:
 	python -m compileall -q torchacc_tpu benchmarks bench.py __graft_entry__.py
+
+# probe the TPU transport until it recovers, then capture a profiled
+# bench run + the 8B-geometry row (writes docs/last_good_bench.json)
+tpu-watch:
+	nohup bash scripts/tpu_watch.sh >/dev/null 2>&1 &
