@@ -24,13 +24,12 @@ def main() -> int:
     p.add_argument("--ckpt", default="/tmp/torchacc_tpu_example_ckpt")
     args = p.parse_args()
 
-    import jax
     import jax.numpy as jnp
 
     import torchacc_tpu as ta
     from torchacc_tpu.data import AsyncLoader, PackedDataset
-    from torchacc_tpu.models import TransformerLM, generate, get_preset
-    from torchacc_tpu.train import Trainer, adamw, warmup_cosine
+    from torchacc_tpu.models import generate, get_preset
+    from torchacc_tpu.train import adamw, warmup_cosine
 
     config = ta.Config(
         memory=ta.MemoryConfig(gc=True, gc_policy="dots_with_no_batch_dims"),
@@ -38,19 +37,11 @@ def main() -> int:
     )
 
     if args.hf_path:
-        from torchacc_tpu.models.hf import load_hf_model
-        from torchacc_tpu.train import apply_config_to_model
-        mc, params = load_hf_model(args.hf_path)
-        mc = apply_config_to_model(mc, config)  # dtype, remat, CP/PP wiring
-        model = TransformerLM(mc)
-        trainer = Trainer(model, config,
-                          optimizer=adamw(warmup_cosine(2e-5, args.steps, 10)))
-        trainer.resolve_shardings()
-        from torchacc_tpu.train.state import TrainState
-        params = jax.device_put(params, trainer.state_shardings.params)
-        trainer.state = TrainState(
-            step=jnp.zeros((), jnp.int32), params=params,
-            opt_state=trainer.optimizer.init(params))
+        # one call: convert + shard + initialise from the HF weights
+        trainer, _ = ta.accelerate(
+            args.hf_path, None, config,
+            optimizer=adamw(warmup_cosine(2e-5, args.steps, 10)))
+        mc = trainer.model.cfg
     else:
         mc = get_preset("llama-tiny", vocab_size=1000)
         trainer, _ = ta.accelerate(

@@ -172,3 +172,37 @@ def test_hf_trainer_adapter(tmp_path, devices):
     assert np.isfinite(ev["eval_loss"])
     tr.save_model(str(tmp_path / "saved"))
     assert (tmp_path / "saved").exists()
+
+
+def test_accelerate_hf_model_one_call(devices):
+    """accelerate(hf_torch_model, ...) converts the weights and returns
+    an ALREADY-initialised sharded trainer (reference:
+    ta.accelerate(model, config) wraps the torch model in place,
+    accelerate.py:49-149) — logits match torch, params land sharded."""
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)).float()
+    cfg = ta.Config(
+        compute=ta.ComputeConfig(dtype="float32", fused_kernels=False),
+        dist=ta.DistConfig(fsdp=ta.FSDPConfig(size=8, min_weight_size=0)))
+    trainer, _ = accelerate(hf, None, cfg, optimizer=optax.sgd(1e-2))
+
+    ids = np.random.default_rng(0).integers(0, 256, (8, 16)).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.from_numpy(ids)).logits.numpy()
+    ours = np.asarray(trainer.model.apply(
+        {"params": trainer.state.params}, jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(ours, ref, atol=2e-4)
+    spec = str(trainer.state.params["layers"]["block"]["attn"]["q_proj"]
+               ["kernel"].sharding.spec)
+    assert "fsdp" in spec, spec
+    loss = float(trainer.step({"input_ids": jnp.asarray(ids, jnp.int32)})
+                 ["loss"])
+    assert np.isfinite(loss)

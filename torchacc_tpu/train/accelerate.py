@@ -68,9 +68,12 @@ def accelerate(
 ) -> Tuple[Trainer, Optional[AsyncLoader]]:
     """Returns ``(trainer, async_loader)``.
 
-    ``model`` may be a :class:`ModelConfig` (zoo model is built for you)
-    or any flax Module following the ``(input_ids, positions, segment_ids)``
-    call convention.
+    ``model`` may be a :class:`ModelConfig` (zoo model is built for
+    you), any flax Module following the ``(input_ids, positions,
+    segment_ids)`` call convention, or an HF torch model / checkpoint
+    path (reference: ``ta.accelerate(hf_model, config)``
+    accelerate.py:49-149) — the weights convert through ``models/hf.py``
+    and the trainer comes back already initialised from them.
     """
     config = config or Config()
     config.validate()
@@ -81,9 +84,20 @@ def accelerate(
         "jax_default_matmul_precision",
         None if config.compute.matmul_precision == "default"
         else config.compute.matmul_precision)
+    hf_params = None
+    if isinstance(model, str) or hasattr(model, "state_dict"):
+        # HF torch model or checkpoint path: convert, then fold the
+        # framework config in exactly like the zoo path
+        from torchacc_tpu.models.hf import load_hf_model
+        mc, hf_params = load_hf_model(
+            model, dtype=_DTYPES[config.compute.dtype],
+            param_dtype=_DTYPES[config.compute.param_dtype])
+        model = mc
     if isinstance(model, ModelConfig):
         model = TransformerLM(apply_config_to_model(model, config))
     trainer = Trainer(model, config, optimizer=optimizer, **trainer_kwargs)
+    if hf_params is not None:
+        trainer.init_from_params(hf_params)
     loader = None
     if dataloader is not None:
         loader = AsyncLoader(dataloader, config, mesh=trainer.mesh)

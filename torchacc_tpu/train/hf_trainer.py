@@ -121,9 +121,9 @@ class HFTrainerAdapter:
 
         self.config = config
         self.trainer, _ = accelerate(mc, None, config, optimizer=optimizer)
-        self.trainer.init()
-        # graft the converted HF weights over the random init
-        self.trainer.state = self.trainer.state.replace(params=params)
+        # converted HF weights land directly in their shards (no
+        # throwaway random init; opt_state initialises from THESE params)
+        self.trainer.init_from_params(params)
         self.model_config = mc
         self._history = []
 

@@ -156,6 +156,32 @@ class Trainer:
                     f"{dict(self.mesh.shape)}")
         return self.state
 
+    def init_from_params(self, params: Any) -> TrainState:
+        """Sharded state from EXISTING params (e.g. HF-converted
+        weights): params land directly in their shards, optimizer state
+        initialises sharded, step starts at 0.  Replaces the manual
+        resolve_shardings + device_put + TrainState dance."""
+        self.resolve_shardings()
+        sh = self.state_shardings
+        params = jax.device_put(params, sh.params)
+        use_scaler = self.config.compute.dtype == "float16"
+
+        def mk(p):
+            scaler = None
+            if use_scaler:
+                from torchacc_tpu.train.amp import scaler_init
+                scaler = scaler_init()
+            return TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                              opt_state=self.optimizer.init(p),
+                              scaler=scaler)
+
+        with jax.sharding.set_mesh(self.mesh):
+            # donate: params would otherwise be held twice on device
+            # during init (the large-model case this path exists for)
+            self.state = jax.jit(mk, out_shardings=sh,
+                                 donate_argnums=0)(params)
+        return self.state
+
     # -- train step ---------------------------------------------------------
     @property
     def _attn_dropout_on(self) -> bool:
