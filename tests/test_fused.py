@@ -72,3 +72,25 @@ def test_trainer_fused_matches_unfused(devices, tie):
         t.init()
         losses[fused] = [float(t.step(b)["loss"]) for b in batches]
     np.testing.assert_allclose(losses[True], losses[False], rtol=2e-4)
+
+
+def test_scan_free_chunk_never_unrolls_tiny_divisors():
+    """ADVICE r3 medium: prime/near-prime row counts must not pick a tiny
+    divisor (which would unroll n/d python chunks at trace time)."""
+    from torchacc_tpu.ops.fused import _scan_free_chunk
+
+    # prime n: only divisors are {1, n}; must fall back to n (one chunk),
+    # never 1 (n chunks)
+    assert _scan_free_chunk(4099, 2048) == 4099
+    # 2 * prime: {1, 2, p, n}; 2 would unroll ~4k chunks — must pick >= n/2
+    assert _scan_free_chunk(2 * 4099, 2048) in (4099, 2 * 4099)
+    # composite n keeps the tuned size
+    assert _scan_free_chunk(8192, 2048) == 2048
+    # awkward-but-composite picks the nearest in-band divisor
+    assert _scan_free_chunk(4106, 2048) == 2053
+    # n smaller than the band floor: one chunk of n rows
+    assert _scan_free_chunk(13, 2048) == 13
+    # chunk count stays bounded in all cases
+    for n in (4099, 2 * 4099, 3 * 1361, 8192, 4106, 13, 6 * 4099):
+        d = _scan_free_chunk(n, 2048)
+        assert n % d == 0 and n // d <= 64, (n, d)

@@ -718,3 +718,48 @@ def test_pp_1f1b_with_tp_matches_single(devices, fused):
     losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
 
     np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
+
+
+def test_1f1b_data_pin_divisibility_guard(devices):
+    """ADVICE r3: per-micro rows not divisible by the dp/fsdp extent must
+    be surfaced (warning + replication fallback) — and stay CORRECT."""
+    import logging
+
+    from jax.sharding import Mesh
+    from torchacc_tpu.parallel.pp import pipeline_loss_1f1b
+    from torchacc_tpu.utils.logger import logger as ta_logger
+
+    stacked, head, x, labels, apply_block, head_loss, ref_loss = _toy_setup(
+        P=2, M=2, mb=3)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "dp"))
+
+    f = jax.jit(lambda s, h, xx: pipeline_loss_1f1b(
+        apply_block, head_loss, s, h, xx, (), labels,
+        None, None, 2, 2, "pp")[0])
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    ta_logger.addHandler(handler)  # logger has propagate=False
+    try:
+        with jax.sharding.set_mesh(mesh):
+            ls = f(stacked, head, x)
+    finally:
+        ta_logger.removeHandler(handler)
+    assert any("not divisible by the data extent" in r.getMessage()
+               for r in records)
+    np.testing.assert_allclose(
+        float(ls), float(ref_loss(stacked, head, x)), rtol=1e-5)
+
+
+def test_micro_batch_view_get_raises_like_getitem():
+    """ADVICE r3: dict.get() must not bypass the curated 1f1b batch-view
+    error and silently hand a custom loss None."""
+    from torchacc_tpu.models.transformer import _MicroBatchView
+
+    view = _MicroBatchView(labels=np.zeros((2, 4)))
+    assert view.get("labels") is not None
+    assert "labels" in view and "attention_mask" not in view
+    with pytest.raises(KeyError, match="not available inside the 1f1b"):
+        view.get("attention_mask")
+    with pytest.raises(KeyError, match="not available inside the 1f1b"):
+        view["attention_mask"]

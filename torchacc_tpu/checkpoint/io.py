@@ -14,9 +14,11 @@ has no equivalent because nothing is ever flattened.
 from __future__ import annotations
 
 import os
+import re
 from typing import Any, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 from torchacc_tpu.train.state import TrainState
@@ -80,7 +82,164 @@ def restore_checkpoint(
     ckptr = ocp.StandardCheckpointer()
     if abstract_state is None:
         return ckptr.restore(path)
-    return ckptr.restore(path, abstract_state)
+    try:
+        return ckptr.restore(path, abstract_state)
+    except Exception:
+        # Migration shim: checkpoints saved before the canonical-stacked
+        # unification (models/transformer.py "ONE canonical param layout")
+        # hold per-layer ``layers_{i}`` subtrees where the current layout
+        # has one stacked ``layers`` [L, ...] tree.  Detect (from tree
+        # metadata — no array reads), restack on host, reshard to the
+        # target — otherwise re-raise the original mismatch untouched.
+        legacy = _checkpoint_has_legacy_layers(ckptr, path)
+        if legacy is False:
+            raise  # known-modern layout: the mismatch is genuine
+        # legacy is True (metadata shows layers_{i}) or None (metadata
+        # unavailable on this orbax — decide from the host restore, the
+        # one case that still pays full host RAM)
+        host = ckptr.restore(path)
+        converted, changed = _restack_legacy_layers(host)
+        if not changed:
+            raise
+        logger.warning(
+            f"checkpoint at {path} uses the legacy unrolled per-layer "
+            "param layout (layers_0..layers_N); restacking to the "
+            "canonical stacked layout.  Re-save to migrate permanently.")
+        return _reshard_into(converted, abstract_state)
+
+
+def _checkpoint_has_legacy_layers(ckptr, path: str) -> Optional[bool]:
+    """Whether the checkpoint's key tree contains ``layers_{i}`` nodes.
+    Reads orbax tree metadata only — never array data — so a genuine
+    (non-legacy) mismatch on a huge checkpoint fails fast without a full
+    host-RAM restore.  Returns None when metadata is unavailable (older
+    orbax) and the caller must decide from a host restore."""
+    try:
+        meta = ckptr.metadata(path)
+        tree = getattr(getattr(meta, "item_metadata", meta), "tree", None)
+    except Exception:
+        return None
+    if tree is None:
+        return None
+    found = False
+
+    def walk(node):
+        nonlocal found
+        if isinstance(node, dict):
+            if any(re.fullmatch(r"layers_\d+", str(k)) for k in node):
+                found = True
+            for v in node.values():
+                walk(v)
+
+    walk(tree)
+    return found
+
+
+def _reshard_into(host_tree: Any, abstract_state: Any) -> Any:
+    """Map a host-restored nested-dict tree onto ``abstract_state``
+    (possibly a TrainState/optax pytree of ShapeDtypeStructs), casting
+    dtype, validating shape, and device_put-ing to each leaf's target
+    sharding.  Orbax represents pytree tuples as lists while flax's
+    state-dict form indexes them as {'0': ...} dicts — normalise to the
+    flax form, map leaf-wise, then rebuild the original structure."""
+    from flax import serialization
+
+    def normalise(node):
+        if isinstance(node, (list, tuple)):
+            return {str(i): normalise(v) for i, v in enumerate(node)}
+        if isinstance(node, dict):
+            return {k: normalise(v) for k, v in node.items()}
+        return node
+
+    def _put(x, a):
+        x = np.asarray(x)
+        if hasattr(a, "shape") and tuple(x.shape) != tuple(a.shape):
+            raise ValueError(
+                f"legacy-checkpoint migration: restacked leaf has shape "
+                f"{tuple(x.shape)} but the target expects {tuple(a.shape)}")
+        if hasattr(a, "dtype") and x.dtype != a.dtype:
+            x = x.astype(a.dtype)
+        sharding = getattr(a, "sharding", None)
+        return jax.device_put(x, sharding) if sharding is not None \
+            else jax.numpy.asarray(x)
+
+    def map_like(conv, abs_, path=""):
+        # walk by the abstract structure: empty containers and None
+        # leaves (optax EmptyState, unused scaler slots) serialise
+        # differently between orbax ({}/None) and flax state-dicts —
+        # treat them as equivalent instead of tree.map's strict match
+        if isinstance(abs_, dict):
+            if not abs_:
+                return {}
+            if not isinstance(conv, dict):
+                raise ValueError(
+                    f"legacy-checkpoint migration: expected a subtree at "
+                    f"{path or '<root>'}, checkpoint has "
+                    f"{type(conv).__name__}")
+            missing = set(abs_) - set(conv)
+            if missing:
+                raise ValueError(
+                    f"legacy-checkpoint migration: checkpoint is missing "
+                    f"{sorted(missing)} under {path or '<root>'}")
+            extra = set(conv) - set(abs_)
+            if extra:
+                # keep the strictness of the non-shim orbax path: a
+                # subtree the target doesn't expect must not be
+                # silently dropped
+                raise ValueError(
+                    f"legacy-checkpoint migration: checkpoint has extra "
+                    f"keys {sorted(extra)} under {path or '<root>'} that "
+                    f"the target state does not expect")
+            return {k: map_like(conv[k], v, f"{path}/{k}")
+                    for k, v in abs_.items()}
+        if abs_ is None:
+            return None
+        if conv is None or (isinstance(conv, dict) and not conv):
+            raise ValueError(
+                f"legacy-checkpoint migration: checkpoint has no value "
+                f"for leaf {path}")
+        return _put(conv, abs_)
+
+    abstract_sd = normalise(serialization.to_state_dict(abstract_state))
+    out_sd = map_like(normalise(host_tree), abstract_sd)
+    return serialization.from_state_dict(abstract_state, out_sd)
+
+
+def _restack_legacy_layers(tree: Any) -> tuple[Any, bool]:
+    """Restack a legacy unrolled checkpoint (``layers_0``..``layers_{L-1}``
+    per-layer subtrees) into the canonical stacked ``layers`` [L, ...]
+    layout.  Returns (converted_tree, changed)."""
+    changed = False
+
+    def walk(node):
+        nonlocal changed
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(v) for v in node)
+        if not isinstance(node, dict):
+            return node
+        legacy = sorted(
+            (k for k in node if re.fullmatch(r"layers_\d+", k)),
+            key=lambda k: int(k.rsplit("_", 1)[1]))
+        if legacy and "layers" not in node \
+                and legacy != [f"layers_{i}" for i in range(len(legacy))]:
+            missing = sorted(
+                set(range(len(legacy)))
+                - {int(k.rsplit("_", 1)[1]) for k in legacy})
+            raise ValueError(
+                f"legacy-checkpoint migration: per-layer keys are not "
+                f"contiguous (found {legacy}; missing indices "
+                f"{missing}) — the checkpoint looks corrupted/partial")
+        if legacy and "layers" not in node:
+            changed = True
+            per_layer = [walk(node[k]) for k in legacy]
+            out = {k: walk(v) for k, v in node.items() if k not in legacy}
+            out["layers"] = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *per_layer)
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(tree), changed
 
 
 class CheckpointManager:

@@ -736,6 +736,15 @@ class _MicroBatchView(dict):
             "other batch leaves should use pp.schedule='gpipe', whose "
             "loss runs outside the region.")
 
+    # dict.get() bypasses __missing__, so batch.get('attention_mask')
+    # would silently hand a custom loss None; raise the same curated
+    # error instead.  (`in` keeps plain membership so a loss can branch
+    # on availability.)
+    def get(self, key, default=None):
+        if not dict.__contains__(self, key):
+            self.__missing__(key)
+        return dict.get(self, key, default)
+
 
 def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
                               positions=None, segment_ids=None,

@@ -20,6 +20,23 @@ import jax
 import jax.numpy as jnp
 
 
+def _scan_free_chunk(n: int, chunk_rows: int) -> int:
+    """Pick the scan_free chunk size: the divisor of n nearest chunk_rows.
+
+    When no divisor lies within [chunk_rows/4, 4*chunk_rows] (n prime or
+    near-prime), a tiny divisor would unroll n/d python chunks — a
+    trace-time blowup — so fall back to the smallest divisor >=
+    chunk_rows; worst case n itself, which IS the plain materialized
+    head (one chunk).  (ADVICE r3 medium.)
+    """
+    divisors = [d for d in range(1, int(n ** 0.5) + 1) if n % d == 0]
+    divisors += [n // d for d in divisors]
+    in_band = [d for d in divisors if chunk_rows // 4 <= d <= 4 * chunk_rows]
+    if in_band:
+        return min(in_band, key=lambda d: (abs(d - chunk_rows), d))
+    return min([d for d in divisors if d >= chunk_rows] or [n])
+
+
 def fused_linear_cross_entropy(
     hidden: jax.Array,
     w_head: jax.Array,
@@ -63,9 +80,7 @@ def fused_linear_cross_entropy(
         # degrade smoothly — worst case one chunk of n rows, which IS the
         # plain materialized-logits head — instead of failing at trace
         # time (the old bounded search raised for e.g. n=4106).
-        divisors = [d for d in range(1, int(n ** 0.5) + 1) if n % d == 0]
-        divisors += [n // d for d in divisors]
-        best = min(divisors, key=lambda d: (abs(d - chunk_rows), d))
+        best = _scan_free_chunk(n, chunk_rows)
         if best > 4 * chunk_rows:
             from torchacc_tpu.utils.logger import logger
             logger.warning(

@@ -424,7 +424,21 @@ def pipeline_train_1f1b(
     ext = 1
     for a in data_axes:
         ext *= mesh.shape[a]
-    if ext > 1:
+    if ext > 1 and mb % ext != 0:
+        # An uneven row pin is degenerate under GSPMD: depending on the
+        # mb/ext ratio the constraint is silently dropped, padded with
+        # empty shards, or rejected at an inner jit output boundary
+        # (probed on jax 0.6/XLA:CPU).  Fall back to replicated micro
+        # rows — always correct, dp-fold redundant compute — and say so
+        # (ADVICE r3).
+        from torchacc_tpu.utils.logger import logger
+        logger.warning(
+            f"1F1B: per-micro rows (batch/num_micro_batches = {mb}) not "
+            f"divisible by the data extent dp*fsdp = {ext}; micro rows "
+            f"are replicated across data replicas (redundant compute).  "
+            f"Pick num_micro_batches so that batch / num_micro_batches "
+            f"is a multiple of {ext} to restore data-sharded 1F1B.")
+    elif ext > 1:
         def _pin(a):
             return jax.lax.with_sharding_constraint(
                 a, P(None, data_axes, *([None] * (a.ndim - 2))))
