@@ -763,3 +763,36 @@ def test_micro_batch_view_get_raises_like_getitem():
         view.get("attention_mask")
     with pytest.raises(KeyError, match="not available inside the 1f1b"):
         view["attention_mask"]
+
+
+def test_pp_1f1b_tp_head_sharded_and_smaller(devices):
+    """VERDICT r3 #3: the 1F1B head must be vocab-parallel under tp —
+    head weight tp-sharded at state level AND in-region (peak temp
+    memory strictly below the replicated-pin fallback at a vocab-heavy
+    geometry), with identical losses."""
+    import dataclasses
+    import optax
+
+    base = get_preset("llama-tiny", vocab_size=2048, hidden_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=2,
+                      intermediate_size=128, dtype=jnp.float32)
+    batch = {"input_ids": np.zeros((8, 128), np.int32)}
+    stats = {}
+    for mode in ("tp_head", "pinned"):
+        mc = dataclasses.replace(base, tp_vocab_head=mode == "tp_head")
+        cfg = ta.Config(dist=ta.DistConfig(
+            pp=ta.PPConfig(size=2, num_micro_batches=2, schedule="1f1b"),
+            tp=ta.TPConfig(size=2), dp=ta.DPConfig(size=2)))
+        tr, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
+        tr.init()
+        assert "tp" in str(
+            tr.state.params["lm_head"]["kernel"].sharding.spec)
+        fn = tr._build_train_step(batch)
+        with jax.sharding.set_mesh(tr.mesh):
+            compiled = fn.lower(tr.state, batch).compile()
+            stats[mode] = compiled.memory_analysis().temp_size_in_bytes
+        loss = float(tr.step(batch)["loss"])
+        stats[mode + "_loss"] = loss
+    assert stats["tp_head"] < stats["pinned"], stats
+    np.testing.assert_allclose(stats["tp_head_loss"], stats["pinned_loss"],
+                               rtol=2e-4)

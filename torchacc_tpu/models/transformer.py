@@ -89,6 +89,10 @@ class ModelConfig:
     # logical-axis rule table for activation sharding constraints; None =
     # parallel.sharding.DEFAULT_RULES (accelerate() injects make_rules(cfg))
     logical_axis_rules: Optional[Tuple] = None
+    # 1F1B vocab-parallel head (pp_1f1b_forward_sum_count): False
+    # restores the round-3 behavior of pinning the head weights
+    # replicated inside the pipeline region
+    tp_vocab_head: bool = True
     # MoE (0 = dense). See models/moe.py.
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -848,10 +852,28 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
         return jax.lax.with_sharding_constraint(
             logits, _P(data or None, _P.UNCONSTRAINED, None))
 
+    # Vocab-parallel head: with a live tp axis the head weight, its grad
+    # and the head matmul stay 1/tp per device via hand-written manual
+    # collectives (ops/fused.py fused_linear_cross_entropy_tp) — the
+    # GSPMD-auto alternative trips the SPMD-partitioner CHECK inside the
+    # pp-manual region (see _pin_logits).  Falls back to the replicated
+    # pin for custom losses (which need full logits) and non-divisible
+    # vocabs.  cfg.tp_vocab_head is the escape hatch back to the pinned
+    # (replicated) head.
+    _mesh = jax.sharding.get_abstract_mesh()
+    _tp_ext = int(getattr(_mesh, "shape", {}).get("tp", 1) or 1)
+    tp_head = (cfg.tp_vocab_head and _tp_ext > 1 and custom_loss is None
+               and cfg.vocab_size % _tp_ext == 0)
+
     def head_loss(hp, y, lab):
         xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
         w = (hp["embed"].T if cfg.tie_embeddings
              else hp["lm_head"]["kernel"])
+        if tp_head:
+            from torchacc_tpu.ops.fused import fused_linear_cross_entropy_tp
+            return fused_linear_cross_entropy_tp(
+                xn, w, lab, tp_axis="tp",
+                logit_softcap=cfg.logit_softcap)
         if custom_loss is not None:
             # user loss(logits, batch) -> (sum, count) | scalar, applied
             # per micro-batch in the last stage (reference: the PP
@@ -879,6 +901,12 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
                        w.astype(jnp.float32)))
         return loss_sum_count(softcap(logits, cfg.logit_softcap), lab)
+
+    # tells the 1F1B executor's head_vjp to SKIP its replicated-head pin:
+    # the tp-aware head consumes the tp-sharded weight directly (a
+    # replicated copy would force an all-gather each tick and a reshard
+    # at the inner shard_map boundary)
+    head_loss.tp_aware = tp_head
 
     return pipeline_loss_1f1b(
         apply_block, head_loss, stacked, head_params, x, riders, labels,

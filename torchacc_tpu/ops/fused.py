@@ -135,3 +135,118 @@ def fused_linear_cross_entropy(
         body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
         (xc, yc))
     return loss_sum, count
+
+
+def fused_linear_cross_entropy_tp(
+    hidden: jax.Array,
+    w_head: jax.Array,
+    labels: jax.Array,
+    *,
+    tp_axis: str = "tp",
+    chunk_rows: int = 2048,
+    logit_softcap: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Vocab-parallel fused linear+CE: each tp rank holds a [H, V/tp]
+    head slice and computes its logits chunk; softmax statistics (max,
+    denominator, label logit) combine via hand-written pmax/psum over
+    ``tp_axis`` inside a shard_map manual over ONLY that axis.
+
+    Built for the 1F1B tick body (parallel/pp.py head_vjp): GSPMD
+    auto-sharding a vocab dim over 'tp' inside the pp-manual region
+    trips an XLA SPMD-partitioner CHECK (spmd_partitioner_util.cc:495)
+    when a data axis is live, which round 3 dodged by replicating the
+    head per device — ~1 GB bf16 at Llama-3's 128k vocab, and the head
+    matmul didn't scale with tp.  Manual collectives never reach the
+    auto partitioner, so the head weight, its gradient, and the head
+    FLOPs all stay 1/tp per device.  (Reference capability:
+    vocab-parallel projection, torchacc/dist/tp.py:1-5 +
+    spmd_fsdp.py:75-77.)
+
+    Grads: dW emerges tp-sharded (each rank owns its vocab slice); the
+    shard_map transpose inserts the psum over tp for d(hidden).  Rows
+    are chunked like ``fused_linear_cross_entropy(scan_free=True)`` —
+    python-unrolled ``jax.checkpoint`` chunks, O(chunk x V/tp) logits
+    live at a time on each rank.
+
+    Requires vocab % tp == 0 (callers fall back to the replicated-head
+    path otherwise) and runs under an active mesh with ``tp_axis``.
+    """
+    b, s, h = hidden.shape
+    v = w_head.shape[1]
+    mesh = jax.sharding.get_abstract_mesh()
+    tp = mesh.shape[tp_axis]
+    if v % tp != 0:
+        raise ValueError(
+            f"fused_linear_cross_entropy_tp: vocab {v} not divisible by "
+            f"{tp_axis} extent {tp}")
+    from jax.sharding import PartitionSpec as P
+
+    n = b * s
+    compute_dtype = hidden.dtype
+    # f32 across the shard_map boundary: the transpose of the
+    # (tp-replicated) hidden input is a psum over tp, and a bf16
+    # all-reduce CHECK-crashes XLA:CPU's AllReducePromotion pass
+    # (hlo_instruction.cc:1585 'Invalid binary instruction opcode
+    # copy').  bf16->f32->bf16 round-trips exactly, and the matmul
+    # below casts back to the model dtype for MXU throughput.
+    x = hidden.reshape(n, h).astype(jnp.float32)
+    y = labels.reshape(n)
+    rows = _scan_free_chunk(n, chunk_rows)
+    chunks = n // rows
+    if rows > 4 * chunk_rows:
+        from torchacc_tpu.utils.logger import logger
+        logger.warning(
+            f"fused CE (tp): n={n} rows has no divisor near "
+            f"chunk_rows={chunk_rows}; using {rows}-row chunks (per-rank "
+            f"memory approaches the unchunked [n, V/tp] logits)")
+    # per-rank vocab offsets ride in as a P(tp)-sharded array: shardy
+    # cannot lower jax.lax.axis_index for a nested-manual axis
+    offs = jnp.arange(tp, dtype=jnp.int32) * (v // tp)
+
+    def local(off_arr, xf, w_loc, yf):
+        off = off_arr[0]
+        vloc = w_loc.shape[1]
+
+        def one_chunk(xi, yi):
+            z = jnp.dot(xi.astype(compute_dtype),
+                        w_loc.astype(compute_dtype),
+                        preferred_element_type=jnp.float32)
+            if logit_softcap > 0.0:
+                from torchacc_tpu.models.transformer import softcap
+                z = softcap(z, logit_softcap)
+            # the max shift is stability-only: cut the tangent BEFORE
+            # pmax (no pmax differentiation rule; exact regardless)
+            m = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(z, axis=-1)), tp_axis)
+            valid = yi != -100
+            mine = jnp.logical_and(yi >= off, yi < off + vloc)
+            safe = jnp.clip(yi - off, 0, vloc - 1)
+            # one combined all-reduce for the denominator and the label
+            # logit (independent of each other; only pmax must precede)
+            ssum, ll = jax.lax.psum(
+                (jnp.sum(jnp.exp(z - m[:, None]), axis=-1),
+                 jnp.where(mine,
+                           jnp.take_along_axis(z, safe[:, None], 1)[:, 0],
+                           0.0)), tp_axis)
+            lse = m + jnp.log(ssum)
+            loss = jnp.sum(jnp.where(valid, lse - ll, 0.0))
+            count = jnp.sum(valid).astype(jnp.float32)
+            return loss, count
+
+        one_chunk = jax.checkpoint(
+            one_chunk, policy=jax.checkpoint_policies.nothing_saveable)
+        loss_sum = jnp.zeros((), jnp.float32)
+        count = jnp.zeros((), jnp.float32)
+        xc = xf.reshape(chunks, rows, h)
+        yc = yf.reshape(chunks, rows)
+        for i in range(chunks):
+            l, c = one_chunk(xc[i], yc[i])
+            loss_sum, count = loss_sum + l, count + c
+        return loss_sum, count
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(tp_axis), P(), P(None, tp_axis), P()),
+        out_specs=(P(), P()),
+        axis_names=frozenset({tp_axis}), check_vma=False,
+    )(offs, x, w_head, y)

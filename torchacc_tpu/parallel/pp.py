@@ -597,11 +597,17 @@ def pipeline_train_1f1b(
                 # the tick body, tripping an XLA SPMD-partitioner CHECK
                 # (spmd_partitioner_util.cc:495) when a data axis is
                 # also live.  On tp-free meshes the pin is skipped so an
-                # fsdp-sharded head stays sharded.
+                # fsdp-sharded head stays sharded.  A tp-AWARE head
+                # (models/transformer.py marks head_loss.tp_aware: vocab-
+                # parallel CE with hand-written manual collectives) keeps
+                # the weight tp-sharded — pinning would all-gather it
+                # every tick.
+                pin_rep = tp_live and not getattr(
+                    head_loss, "tp_aware", False)
                 hp_rep = (jax.tree.map(
                     lambda a: jax.lax.with_sharding_constraint(
                         a, P(*([None] * a.ndim))), head_p)
-                    if tp_live else head_p)
+                    if pin_rep else head_p)
                 (ls, cnt), hvjp = jax.vjp(
                     lambda hp, yl: head_loss(
                         hp, yl.astype(compute_dtype), lab_t),
