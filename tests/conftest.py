@@ -78,7 +78,7 @@ _SLOW = {
     "test_moe_aux_loss_survives_gc_cnt",
     "test_expert_parallel_training",
     "test_checkpoint_manager_rotation",
-    "test_offload_policy_compiles",
+    "test_offload_policy_real_multi_device",
     "test_remat_policies_train",
     "test_cp_grads_match_local",
     "test_cp_window_grads_match_local",

@@ -693,12 +693,14 @@ def test_pp_1f1b_interleaved_with_fsdp_and_dropout(devices):
 
 @pytest.mark.parametrize("fused", [True, False])
 def test_pp_1f1b_with_tp_matches_single(devices, fused):
-    """1F1B x TP (pp2 x tp2 x dp2): regression for the XLA SPMD-
-    partitioner CHECK crash (spmd_partitioner_util.cc:495) that fired
-    whenever the in-region head had tp-sharded weights or logits with a
-    data axis live — the head weights and the materialized logits are
-    now pinned tp-replicated inside the region (head grads still flow;
-    losses must match dp=8)."""
+    """1F1B x TP (pp2 x tp2 x dp2): the last-stage head runs the
+    VOCAB-PARALLEL fused CE (nested tp-manual shard_map,
+    ops/fused.py fused_linear_cross_entropy_tp) — also the regression
+    geometry for two partitioner CHECK crashes: the round-3 GSPMD
+    vocab-over-tp crash (spmd_partitioner_util.cc:495, dodged because
+    the manual collectives never reach the auto partitioner) and the
+    round-4 XLA:CPU AllReducePromotion bf16-all-reduce crash (f32
+    boundary).  Losses must match dp=8 step for step."""
     import optax
 
     batches = list(_batches(4))

@@ -262,6 +262,8 @@ class Trainer:
         use_scaler = self.config.compute.dtype == "float16"
         dropout_on = self._attn_dropout_on
         base_fsc = self._forward_sum_count
+        from torchacc_tpu.utils.remat import offload_is_live
+        offload_live = offload_is_live(self.config.memory)
 
         def train_step(state: TrainState, batch: Dict[str, jax.Array]):
             # train steps supply a per-step dropout seed (step * accum,
@@ -358,14 +360,34 @@ class Trainer:
             }
             if use_scaler:
                 metrics["loss_scale"] = new_scaler["scale"]
-            return TrainState(step=state.step + 1, params=new_params,
-                              opt_state=new_opt, scaler=new_scaler), metrics
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   opt_state=new_opt, scaler=new_scaler)
+            if offload_live:
+                # pin output shardings in-graph instead of via
+                # out_shardings (see the jit below)
+                new_state = jax.tree.map(
+                    jax.lax.with_sharding_constraint, new_state,
+                    self.state_shardings)
+                metrics = jax.tree.map(
+                    lambda m: jax.lax.with_sharding_constraint(
+                        m, self._metrics_sharding), metrics)
+            return new_state, metrics
 
+        # Host-offload remat makes the lowered module contain memory-kind
+        # ops, which flips jit's out_shardings handling into annotating
+        # EVERY output with an `annotate_device_placement` custom call —
+        # and the SPMD partitioner RET_CHECKs on the scalar outputs
+        # (step, adam count) whose annotate carries no sharding
+        # (spmd_partitioner.cc:5743, 'Side-effect HLO must have
+        # sharding').  Pinning the outputs with in-graph
+        # with_sharding_constraint instead keeps the layouts AND skips
+        # the output-annotate path, so multi-device SPMD offload works.
         return jax.jit(
             train_step,
             in_shardings=(self.state_shardings,
                           self._batch_shardings(sample_batch)),
-            out_shardings=(self.state_shardings, self._metrics_sharding),
+            out_shardings=(None if offload_live else
+                           (self.state_shardings, self._metrics_sharding)),
             donate_argnums=(0,),
         )
 

@@ -15,6 +15,33 @@ from typing import Optional
 import jax
 
 
+def _host_memory_available() -> bool:
+    """Whether the backend exposes a pinned_host memory space (the
+    memories-API target for residual offload).  TPU always does; modern
+    XLA:CPU does too, which lets the emulated mesh exercise the REAL
+    offload path instead of a fallback."""
+    try:
+        # local_devices: jax.devices()[0] is non-addressable on
+        # processes other than 0 in a multi-process run, and the
+        # processes must agree on the answer
+        mems = jax.local_devices()[0].addressable_memories()
+        return any(m.kind == "pinned_host" for m in mems)
+    except Exception:
+        return False
+
+
+def offload_is_live(memory_cfg) -> bool:
+    """Single source of truth for 'does this config actually host-offload
+    residuals on this backend' — the trainer keys its jit out_shardings
+    workaround off this, and it must agree with remat_policy's
+    capability fallback."""
+    wants = bool(getattr(memory_cfg, "offload_activations", False)
+                 or (getattr(memory_cfg, "gc", False)
+                     and getattr(memory_cfg, "gc_policy", "")
+                     == "offload_dots"))
+    return wants and _host_memory_available()
+
+
 def remat_policy(name: str = "nothing") -> Optional[object]:
     """Map a policy name to a jax.checkpoint policy.
 
@@ -45,13 +72,13 @@ def remat_policy(name: str = "nothing") -> Optional[object]:
             "qkv_proj", "attn_ctx", "attn_lse", "attn_out", "mlp_out",
             "mlp_gate_up")
     if name == "offload_dots":
-        from torchacc_tpu.ops._common import on_tpu
-        if not on_tpu():
-            # the memories-API custom calls (annotate_device_placement)
-            # are unimplemented on the CPU backend
+        if not _host_memory_available():
+            # backends without a pinned_host memory space cannot place
+            # the offloaded residuals
             from torchacc_tpu.utils.logger import logger
-            logger.warning("host offload ('offload_dots') requires a TPU "
-                           "backend; falling back to 'dots'")
+            logger.warning("host offload ('offload_dots') requires a "
+                           "backend with pinned_host memory; falling "
+                           "back to 'dots'")
             return cp.checkpoint_dots
         # names annotated in models/transformer.py Block via checkpoint_name
         return cp.save_and_offload_only_these_names(
