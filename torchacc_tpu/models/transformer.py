@@ -97,6 +97,11 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     router_aux_weight: float = 0.01   # switch-style load-balance loss weight
+    # capacity-dispatch mechanism (models/moe.py): 'einsum' = one-hot
+    # dispatch/combine einsums (MXU-friendly at small n*e*cap), 'sort' =
+    # argsort/scatter (no [n, e, cap] materialisation — the Mixtral-scale
+    # answer), 'auto' = sort above ~2^24 dispatch elements
+    moe_dispatch: str = "auto"
     # None = exact capacity-free dense dispatch (every token through
     # every expert — right for small e).  A float (e.g. 1.25) switches
     # to switch-transformer capacity dispatch: per-expert buffers of
