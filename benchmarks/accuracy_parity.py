@@ -32,35 +32,57 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def torch_curve(hf_model, ids, steps, lr, heldout):
-    """Plain torch fine-tune loop: next-token CE, SGD, f32.  Returns the
-    loss curve plus heldout perplexity of the TUNED model (the
-    downstream-eval leg — reference scores the tuned model too,
-    benchmarks/accuracy/README.md:103-105)."""
+# AdamW hyper-parameters pinned EXPLICITLY on both sides: torch and
+# optax have different defaults (weight_decay 1e-2 vs 1e-4), and the
+# whole point of the AdamW leg is that moment/decay arithmetic agrees
+# over hundreds of steps
+_ADAMW = dict(b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+
+
+def torch_curve(hf_model, ids, steps, lr, heldout, optimizer="sgd",
+                dtype="float32"):
+    """Plain torch fine-tune loop: next-token CE.  ``dtype='bfloat16'``
+    runs forward/backward under CPU autocast with f32 master weights —
+    the same mixed-precision regime as the converted side (bf16 compute
+    dtype, f32 param dtype).  Returns the loss curve plus heldout loss
+    of the TUNED model (the downstream-eval leg — reference scores the
+    tuned model too, benchmarks/accuracy/README.md:103-105)."""
+    import contextlib
+
     import torch
 
     model = hf_model.train()
-    opt = torch.optim.SGD(model.parameters(), lr=lr)
+    if optimizer == "adamw":
+        opt = torch.optim.AdamW(
+            model.parameters(), lr=lr,
+            betas=(_ADAMW["b1"], _ADAMW["b2"]), eps=_ADAMW["eps"],
+            weight_decay=_ADAMW["weight_decay"])
+    else:
+        opt = torch.optim.SGD(model.parameters(), lr=lr)
+    autocast = (torch.autocast("cpu", dtype=torch.bfloat16)
+                if dtype == "bfloat16" else contextlib.nullcontext())
     losses = []
     for step in range(steps):
         batch = torch.from_numpy(ids[step])
-        out = model(input_ids=batch, labels=batch)
+        with autocast:
+            out = model(input_ids=batch, labels=batch)
         # HF computes shifted CE internally (mean over tokens)
         opt.zero_grad()
         out.loss.backward()
         opt.step()
         losses.append(float(out.loss.detach()))
     model.eval()
-    with torch.no_grad():
+    with torch.no_grad(), autocast:
         ev = [float(model(input_ids=torch.from_numpy(b),
                           labels=torch.from_numpy(b)).loss)
               for b in heldout]
     return losses, sum(ev) / len(ev)
 
 
-def converted_curve(hf_model, ids, steps, lr, heldout):
+def converted_curve(hf_model, ids, steps, lr, heldout, optimizer="sgd",
+                    dtype="float32"):
     """Same initial weights via models/hf.py, trained by the Trainer;
-    returns the curve plus heldout perplexity of the tuned model."""
+    returns the curve plus heldout loss of the tuned model."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -70,11 +92,15 @@ def converted_curve(hf_model, ids, steps, lr, heldout):
     from torchacc_tpu.models import load_hf_model
     from torchacc_tpu.train import accelerate
 
-    mc, params = load_hf_model(hf_model, dtype=jnp.float32,
+    compute_dtype = (jnp.bfloat16 if dtype == "bfloat16"
+                     else jnp.float32)
+    mc, params = load_hf_model(hf_model, dtype=compute_dtype,
                                param_dtype=jnp.float32)
     cfg = ta.Config(compute=ta.ComputeConfig(
-        dtype="float32", fused_kernels=False))
-    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(lr))
+        dtype=dtype, fused_kernels=False))
+    opt = (optax.adamw(lr, **_ADAMW) if optimizer == "adamw"
+           else optax.sgd(lr))
+    trainer, _ = accelerate(mc, None, cfg, optimizer=opt)
     trainer.init()
     trainer.state = trainer.state.replace(params=params)
     losses = []
@@ -86,7 +112,8 @@ def converted_curve(hf_model, ids, steps, lr, heldout):
     return losses, sum(ev) / len(ev)
 
 
-def _build_hf(family: str, seq: int):
+def _build_hf(family: str, seq: int, hidden: int = 64, layers: int = 2,
+              vocab: int = 256):
     import torch
     import transformers
 
@@ -94,9 +121,12 @@ def _build_hf(family: str, seq: int):
     # trains a different model (and the `improved` gate on a short run
     # becomes a coin flip)
     torch.manual_seed(0)
-    kw = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
-              num_hidden_layers=2, num_attention_heads=4,
-              num_key_value_heads=2, max_position_embeddings=seq,
+    kw = dict(vocab_size=vocab, hidden_size=hidden,
+              intermediate_size=2 * hidden,
+              num_hidden_layers=layers,
+              num_attention_heads=max(hidden // 16, 1),
+              num_key_value_heads=max(hidden // 32, 1),
+              max_position_embeddings=seq,
               rope_theta=10000.0)
     if family == "llama":
         return transformers.LlamaForCausalLM(
@@ -117,30 +147,45 @@ def main(argv=None) -> int:
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--family", default="llama",
                     choices=["llama", "qwen2"])
+    ap.add_argument("--optimizer", default="sgd",
+                    choices=["sgd", "adamw"],
+                    help="adamw = the long-horizon leg where moment "
+                         "accumulation effects live (VERDICT r3)")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="bfloat16 = bf16 compute + f32 params on both "
+                         "sides (torch CPU autocast vs ComputeConfig)")
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=256)
     args = ap.parse_args(argv)
 
     import numpy as np
 
-    hf_model = _build_hf(args.family, args.seq)
+    hf_model = _build_hf(args.family, args.seq, hidden=args.hidden,
+                         layers=args.layers, vocab=args.vocab)
 
     rng = np.random.default_rng(0)
     # tokens from a quarter of the vocab: LEARNABLE data (the model
-    # shifts mass onto the live tokens, loss falls toward log(64)), so
-    # the `improved` gate checks that training actually trains instead
-    # of flipping a coin on uniform noise
-    ids = rng.integers(0, 64, size=(args.steps, args.batch, args.seq)
+    # shifts mass onto the live tokens, loss falls toward log(vocab/4)),
+    # so the `improved` gate checks that training actually trains
+    # instead of flipping a coin on uniform noise
+    live = max(args.vocab // 4, 2)
+    ids = rng.integers(0, live, size=(args.steps, args.batch, args.seq)
                        ).astype(np.int64)
     # heldout set for the downstream-eval leg: same distribution, never
     # trained on (reference also scores the tuned model,
     # benchmarks/accuracy/README.md:103-105; MT-bench itself needs
     # serving infra — heldout perplexity is the self-contained analogue)
-    heldout = rng.integers(0, 64, size=(4, args.batch, args.seq)
+    heldout = rng.integers(0, live, size=(4, args.batch, args.seq)
                            ).astype(np.int64)
 
-    ours, ev_ours = converted_curve(hf_model, ids, args.steps, args.lr,
-                                    heldout)
-    theirs, ev_torch = torch_curve(hf_model, ids, args.steps, args.lr,
-                                   heldout)
+    ours, ev_ours = converted_curve(
+        hf_model, ids, args.steps, args.lr, heldout,
+        optimizer=args.optimizer, dtype=args.dtype)
+    theirs, ev_torch = torch_curve(
+        hf_model, ids, args.steps, args.lr, heldout,
+        optimizer=args.optimizer, dtype=args.dtype)
 
     devs = [abs(a - b) / max(abs(b), 1e-6) for a, b in zip(ours, theirs)]
     max_dev = max(devs)
@@ -153,7 +198,8 @@ def main(argv=None) -> int:
     improved = ours[-1] < ours[0]
     ok = bool(max_dev <= args.tol and ev_dev <= args.tol and improved)
     print(json.dumps({
-        "metric": f"accuracy_parity_{args.family}_sft",
+        "metric": (f"accuracy_parity_{args.family}_{args.optimizer}"
+                   f"_{args.dtype}_sft"),
         "ok": ok,
         "max_rel_dev": round(max_dev, 5),
         "tol": args.tol,

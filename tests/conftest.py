@@ -70,6 +70,7 @@ _SLOW = {
     "test_trainer_fused_matches_unfused",
     "test_converted_model_trains",
     "test_accuracy_parity_harness",
+    "test_accuracy_parity_adamw_bf16_leg",
     "test_tp_with_cp_composition",
     "test_pp_with_fsdp_trains",
     "test_e2e_training_with_cp",

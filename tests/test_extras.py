@@ -341,3 +341,94 @@ def test_generate_top_k_top_p():
                    temperature=0.8, top_k=5, top_p=0.9,
                    rng=jax.random.PRNGKey(1))
     assert out.shape == (1, 9)
+
+
+def test_generate_pp_cached_matches_single(devices):
+    """KV-cache decode under pipeline parallelism (VERDICT r3 next-7):
+    pp=2 stage-ring decode (cache stage-local, one ring pass per token,
+    NO full-prefix recompute) must produce the same greedy tokens as
+    the single-device cached path."""
+    import dataclasses
+
+    from jax.sharding import Mesh
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+
+    mc = get_preset("llama-tiny", vocab_size=97, hidden_size=64,
+                    num_layers=4, num_heads=4, num_kv_heads=2,
+                    intermediate_size=128, max_seq_len=64,
+                    dtype=jnp.float32)
+    model1 = TransformerLM(mc)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(1, 97, (2, 7)),
+                         jnp.int32)
+    params = model1.init(jax.random.PRNGKey(0), prompt)["params"]
+    ref = generate(model1, params, prompt, max_new_tokens=10)
+
+    mc_pp = dataclasses.replace(mc, pp_size=2, pp_num_micro=2)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+    with jax.sharding.set_mesh(mesh):
+        out = generate(TransformerLM(mc_pp), params, prompt,
+                       max_new_tokens=10)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # ragged left-padded prompts through the pp path
+    pad = jnp.concatenate([jnp.zeros((2, 3), jnp.int32), prompt], axis=1)
+    mask = jnp.concatenate([jnp.zeros((2, 3), jnp.int32),
+                            jnp.ones((2, 7), jnp.int32)], axis=1)
+    ref_r = generate(model1, params, pad, prompt_mask=mask,
+                     max_new_tokens=6)
+    with jax.sharding.set_mesh(mesh):
+        out_r = generate(TransformerLM(mc_pp), params, pad,
+                         prompt_mask=mask, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(ref_r))
+
+
+def test_generate_cp_cached_matches_single(devices):
+    """KV-cache decode under context parallelism (VERDICT r3 next-7):
+    with sp live, prefill banks k/v through the cp forward with the
+    cache's slot dim sharded over 'sp', and decode attends over the
+    sharded slots — same greedy tokens as single-device, no full-prefix
+    recompute."""
+    import dataclasses
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+
+    mc = get_preset("llama-tiny", vocab_size=97, hidden_size=64,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    intermediate_size=128, max_seq_len=64,
+                    dtype=jnp.float32)
+    model1 = TransformerLM(mc)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(1, 97, (4, 8)),
+                         jnp.int32)
+    params = model1.init(jax.random.PRNGKey(0), prompt)["params"]
+    ref = generate(model1, params, prompt, max_new_tokens=8)
+
+    cfg = ta.Config(dist=ta.DistConfig(
+        sp=ta.SPConfig(size=2, mode="ring"), dp=ta.DPConfig(size=4)))
+    mesh = cfg.get_mesh()
+    mc_cp = dataclasses.replace(mc, context_parallel=True)
+    with jax.sharding.set_mesh(mesh):
+        out = generate(TransformerLM(mc_cp), params, prompt,
+                       max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_pp_cfg_without_mesh_demotes(devices):
+    """A pp-trained cfg used for generation OUTSIDE any mesh context
+    must not crash: the stacked param layout is pp-agnostic, so
+    generate() demotes to a pp_size=1 view and decodes exactly."""
+    import dataclasses
+
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+
+    mc = get_preset("llama-tiny", vocab_size=97, hidden_size=64,
+                    num_layers=4, num_heads=4, num_kv_heads=2,
+                    intermediate_size=128, max_seq_len=64,
+                    dtype=jnp.float32)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(1, 97, (2, 7)),
+                         jnp.int32)
+    params = TransformerLM(mc).init(jax.random.PRNGKey(0), prompt)["params"]
+    ref = generate(TransformerLM(mc), params, prompt, max_new_tokens=6)
+    mc_pp = dataclasses.replace(mc, pp_size=2, pp_num_micro=2)
+    out = generate(TransformerLM(mc_pp), params, prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

@@ -287,6 +287,13 @@ class Attention(nn.Module):
                     ck.value, k.astype(cfg.dtype), (0, pos, 0, 0))
                 cv.value = jax.lax.dynamic_update_slice(
                     cv.value, v.astype(cfg.dtype), (0, pos, 0, 0))
+                if cfg.context_parallel:
+                    # keep the slot dim sp-sharded through the decode
+                    # scan (see the prefill-side constraint below)
+                    ck.value = activation_constraint(
+                        ck.value, ("batch", "seq", None, None), rules)
+                    cv.value = activation_constraint(
+                        cv.value, ("batch", "seq", None, None), rules)
                 cidx.value = pos + s
                 # ragged (left-padded) prompts: prefill banked per-slot
                 # validity in the 'seg' cache; decode-appended tokens are
@@ -326,6 +333,16 @@ class Attention(nn.Module):
                 ck.value, k.astype(cfg.dtype), (0, 0, 0, 0))
             cv.value = jax.lax.dynamic_update_slice(
                 cv.value, v.astype(cfg.dtype), (0, 0, 0, 0))
+            if cfg.context_parallel:
+                # long-context decode: the cache's SLOT dim shards over
+                # the sequence axes, so per-device cache memory is
+                # cache_len/sp — the point of cp decode.  Decode's
+                # single-token DUS and the partial-softmax attention
+                # over the sharded slots are GSPMD-handled.
+                ck.value = activation_constraint(
+                    ck.value, ("batch", "seq", None, None), rules)
+                cv.value = activation_constraint(
+                    cv.value, ("batch", "seq", None, None), rules)
             cidx.value = jnp.asarray(s, jnp.int32)
             if segment_ids is not None:
                 # ragged (left-padded) prompts: bank per-slot validity so
@@ -711,6 +728,20 @@ def _embed_extras(cfg: ModelConfig, x: jax.Array, positions: jax.Array,
     if cfg.pos_emb == "learned":
         x = x + pos_table.astype(cfg.dtype)[positions]
     return x
+
+
+def head_logits(cfg: ModelConfig, params, x: jax.Array) -> jax.Array:
+    """Shared raw-params head tail: final norm -> vocab projection ->
+    softcap, numerically identical to TransformerLM.__call__'s tail
+    (Dense/attend both cast operands to cfg.dtype) — one definition so
+    raw-params consumers (the pp decode path, models/generate.py)
+    cannot drift from the module."""
+    xn = Norm(cfg).apply({"params": params["final_norm"]}, x)
+    w = (params["embed_tokens"]["embedding"].T if cfg.tie_embeddings
+         else params["lm_head"]["kernel"])
+    logits = jnp.einsum("bsh,hv->bsv", xn.astype(cfg.dtype),
+                        w.astype(cfg.dtype))
+    return softcap(logits.astype(jnp.float32), cfg.logit_softcap)
 
 
 def _micro_seed(base, micro_idx):
