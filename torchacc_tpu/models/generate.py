@@ -205,7 +205,12 @@ def generate(
     # exact
     pp_live = (cfg is not None and getattr(cfg, "pp_size", 1) > 1
                and _mesh_extent("pp") == cfg.pp_size
-               and isinstance(params, dict) and "layers" in params)
+               and isinstance(params, dict) and "layers" in params
+               # the pp stage ring applies ScanBlock uniformly — a
+               # layer_pattern model must take the pattern path instead
+               # (correct per-layer windows; GSPMD still resolves the
+               # pp-sharded param slices)
+               and not getattr(cfg, "layer_pattern", None))
     if (cfg is not None and getattr(cfg, "pp_size", 1) > 1
             and not pp_live):
         from torchacc_tpu.models.transformer import TransformerLM
@@ -229,12 +234,23 @@ def generate(
                                    rng, float(temperature),
                                    int(max_new_tokens), eos_id,
                                    int(top_k), float(top_p))
+    if (can_cache and getattr(cfg, "layer_pattern", None)
+            and not pp_live and not cp_cfg
+            and isinstance(params, dict) and "layers" in params):
+        # layer_pattern models cannot decode through model.apply (the
+        # scan path cannot vary the per-layer window; TransformerLM
+        # rejects pattern+cache) — use the per-layer pattern loop
+        return _generate_cached_pattern(
+            cfg, params, prompt_ids, prompt_mask, rng,
+            float(temperature), int(max_new_tokens), eos_id,
+            int(top_k), float(top_p))
     # pp x cp decode: the one remaining recompute fallback (a cp
     # attention shard_map nested inside the pp stage ring is untested);
     # a cp cfg without a live sp/spu mesh axis also falls back (the cp
     # attention shard_map needs the axes)
     can_cache = (can_cache and not pp_live
                  and getattr(cfg, "pp_size", 1) == 1
+                 and not getattr(cfg, "layer_pattern", None)
                  and (not cp_cfg or _mesh_extent("sp", "spu") > 1))
     if can_cache:
         from torchacc_tpu.models.transformer import TransformerLM
@@ -275,8 +291,6 @@ def _generate_cached_pp(cfg, params, prompt_ids, prompt_mask, rng,
     stays STAGE-LOCAL (sharded over 'pp' on the layer-chunk dim); each
     token costs one pass over the stage ring (pp.py
     pp_forward_with_cache) — no full-prefix recompute."""
-    import dataclasses as _dc
-
     from torchacc_tpu.models.transformer import head_logits
     from torchacc_tpu.parallel.pp import pp_forward_with_cache
 
@@ -284,8 +298,8 @@ def _generate_cached_pp(cfg, params, prompt_ids, prompt_mask, rng,
     total = p + max_new
     # the block cfgs run OUTSIDE the pipeline dispatch (pp_size=1): the
     # pipeline structure lives in pp_forward_with_cache itself
-    blk_pre = _dc.replace(cfg, decode=False, cache_len=total, pp_size=1)
-    blk_dec = _dc.replace(cfg, decode=True, cache_len=total, pp_size=1)
+    blk_pre = dataclasses.replace(cfg, decode=False, cache_len=total, pp_size=1)
+    blk_dec = dataclasses.replace(cfg, decode=True, cache_len=total, pp_size=1)
 
     positions, row_len, seg = _prompt_geometry(prompt_ids, prompt_mask)
     if positions is None:
@@ -301,6 +315,67 @@ def _generate_cached_pp(cfg, params, prompt_ids, prompt_mask, rng,
         y1, cache = pp_forward_with_cache(
             blk_dec, params["layers"], cache, x1, positions1, None,
             cfg.pp_size)
+        return head_logits(cfg, params, y1)[:, 0], cache
+
+    return _drive_decode(logits, cache, step_fn, prompt_ids, row_len,
+                         rng, temperature, max_new, eos_id, top_k,
+                         top_p)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous-layer (gemma2-style) KV-cache decode
+# ---------------------------------------------------------------------------
+
+def _pattern_layers_with_cache(cfg, stacked_params, cache, x, positions,
+                               seg):
+    """Raw per-layer loop threading the kv cache through the canonical
+    [L, ...] stacked layout, with each layer's own pattern cfg — the
+    scan path cannot vary a static window per layer.  ``cache=None``
+    (prefill) creates the banked cache."""
+    from torchacc_tpu.models.transformer import ScanBlock, pattern_cfg
+
+    new_layers = []
+    for i in range(cfg.num_layers):
+        blk = ScanBlock(pattern_cfg(cfg, i))
+        variables = {"params": jax.tree.map(
+            lambda a, i=i: a[i], stacked_params)}
+        if cache is not None:
+            variables["cache"] = jax.tree.map(
+                lambda a, i=i: a[i], cache)
+        (carry, _), vs = blk.apply(variables, (x, positions, seg), None,
+                                   mutable=["cache"])
+        x = carry[0]
+        new_layers.append(vs["cache"])
+    new_cache = jax.tree.map(lambda *xs: jnp.stack(xs), *new_layers)
+    return x, new_cache
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "temperature", "max_new", "eos_id", "top_k", "top_p"))
+def _generate_cached_pattern(cfg, params, prompt_ids, prompt_mask, rng,
+                             temperature, max_new, eos_id, top_k, top_p):
+    """KV-cache decode for layer_pattern models: same scaffold as the
+    other cached paths, with the per-layer pattern loop as forward."""
+    from torchacc_tpu.models.transformer import head_logits
+
+    b, p = prompt_ids.shape
+    total = p + max_new
+    blk_pre = dataclasses.replace(cfg, decode=False, cache_len=total)
+    blk_dec = dataclasses.replace(cfg, decode=True, cache_len=total)
+
+    positions, row_len, seg = _prompt_geometry(prompt_ids, prompt_mask)
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(p), (b, p))
+
+    x = _zoo_embed(cfg, params, prompt_ids, positions)
+    y, cache = _pattern_layers_with_cache(
+        blk_pre, params["layers"], None, x, positions, seg)
+    logits = head_logits(cfg, params, y)
+
+    def step_fn(cache, tok, positions1):
+        x1 = _zoo_embed(cfg, params, tok[:, None], positions1)
+        y1, cache = _pattern_layers_with_cache(
+            blk_dec, params["layers"], cache, x1, positions1, None)
         return head_logits(cfg, params, y1)[:, 0], cache
 
     return _drive_decode(logits, cache, step_fn, prompt_ids, row_len,

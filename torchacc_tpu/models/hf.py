@@ -29,12 +29,11 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
     mistral/gemma family)."""
     get = lambda n, d=None: getattr(hf_config, n, d)
     mt = get("model_type")
-    if mt in ("gemma2", "gemma3", "gemma3_text"):
+    if mt in ("gemma3", "gemma3_text"):
         raise NotImplementedError(
-            f"model_type {mt!r}: gemma2/3's per-layer alternation "
-            "(sliding/global attention, pre+post feedforward norms) does "
-            "not map onto the uniform scan-stacked block; gemma (v1) is "
-            "supported")
+            f"model_type {mt!r}: gemma3's per-layer-TYPE rope bases "
+            "(local 10k / global 1M) and qk-norm are not implemented; "
+            "gemma (v1) and gemma2 are supported")
     kw = dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -54,10 +53,25 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         # (gelu_pytorch_tanh), sqrt(hidden)-scaled embeddings, explicit
         # head_dim (7b: 256 != hidden/heads), tied head
         kw.update(norm="rmsnorm1p", activation="geglu", embed_scale=True)
+    if mt == "gemma2":
+        # Gemma2 adds to v1: sandwich norms (post-attention and
+        # post-feedforward), alternating sliding/global attention
+        # (HF Gemma2Attention: even layers sliding), attention-score
+        # soft-capping, and a fixed query scale
+        # (query_pre_attn_scalar ** -0.5 instead of head_dim ** -0.5)
+        kw.update(
+            norm="rmsnorm1p", activation="geglu", embed_scale=True,
+            sandwich_norms=True, layer_pattern=("sliding", "global"),
+            attn_logit_softcap=float(get("attn_logit_softcapping") or 0.0),
+            query_scale=float(get("query_pre_attn_scalar",
+                                  kw.get("head_dim") or 256)) ** -0.5)
     if get("final_logit_softcapping"):
         kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):
-        kw["window"] = (int(get("sliding_window")), -1)
+        # HF sliding masks attend iff kv > q - sliding_window (inclusive
+        # count = sliding_window); our window=(left, right) attends
+        # kv >= q - left (count = left + 1) -> left = sliding_window - 1
+        kw["window"] = (int(get("sliding_window")) - 1, -1)
     kw.update(overrides)
     return ModelConfig(**kw)
 
@@ -124,9 +138,19 @@ def params_from_hf_state_dict(
         },
         "ln1": {"scale": stack("layers.{i}.input_layernorm.weight",
                                lambda w: w)},
-        "ln2": {"scale": stack(
-            "layers.{i}.post_attention_layernorm.weight", lambda w: w)},
     }
+    if cfg.sandwich_norms:
+        # gemma2 norm naming: post_attention_layernorm is the POST-attn
+        # sandwich norm; the pre-mlp norm is pre_feedforward_layernorm
+        block["ln1_post"] = {"scale": stack(
+            "layers.{i}.post_attention_layernorm.weight", lambda w: w)}
+        block["ln2"] = {"scale": stack(
+            "layers.{i}.pre_feedforward_layernorm.weight", lambda w: w)}
+        block["ln2_post"] = {"scale": stack(
+            "layers.{i}.post_feedforward_layernorm.weight", lambda w: w)}
+    else:
+        block["ln2"] = {"scale": stack(
+            "layers.{i}.post_attention_layernorm.weight", lambda w: w)}
     params: Dict[str, Any] = {
         "embed_tokens": {"embedding": get("embed_tokens.weight")},
         "layers": {"block": block},

@@ -65,6 +65,25 @@ class ModelConfig:
     remat_cnt: Optional[int] = None
     attention_impl: str = "auto"
     window: Tuple[int, int] = (-1, -1)      # sliding-window attention
+    # Gemma2-style attention-score soft-capping: scores = c * tanh(s/c)
+    # applied after the q-scale, before mask/softmax; 0 disables.
+    # Routed to the XLA attention (the Pallas kernel does not implement
+    # it — ops/attn.py falls back with a warning).
+    attn_logit_softcap: float = 0.0
+    # query scaling override: None = head_dim ** -0.5; Gemma2 sets
+    # query_pre_attn_scalar ** -0.5
+    query_scale: Optional[float] = None
+    # Gemma2 sandwich norms: extra RMSNorms AFTER attention and mlp
+    # (HF post_attention_layernorm / post_feedforward_layernorm), adding
+    # ln1_post / ln2_post params to each block
+    sandwich_norms: bool = False
+    # heterogeneous per-layer attention (gemma2/3): a cycle of
+    # 'sliding' (uses cfg.window) | 'global' (full attention) applied as
+    # layer i -> pattern[i % len]. None = every layer uses cfg.window.
+    # Layers stay structurally identical (the pattern is param-free), so
+    # the canonical stacked layout and checkpoints are unchanged;
+    # execution uses the per-layer loop (scan_layers is ignored).
+    layer_pattern: Optional[Tuple[str, ...]] = None
     # KV-cache decode mode (models/generate.py): __call__ consumes one
     # token per step, appending rotated k / raw v into the 'cache'
     # collection and attending over the filled prefix
@@ -319,9 +338,10 @@ class Attention(nn.Module):
                 kv_len = ck.value.shape[1]
                 out = attention_reference(
                     q, ck.value, cv.value, causal=True, window=cfg.window,
-                    alibi_slopes=slopes,
+                    scale=cfg.query_scale, alibi_slopes=slopes,
                     q_segment_ids=qseg, kv_segment_ids=kvseg,
-                    q_offset=pos - (kv_len - s))
+                    q_offset=pos - (kv_len - s),
+                    logit_softcap=cfg.attn_logit_softcap)
                 return nn.DenseGeneral(
                     features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
                     name="o_proj", dtype=cfg.dtype,
@@ -359,6 +379,15 @@ class Attention(nn.Module):
             dropout_p = cfg.attn_dropout
             seed = dropout_seed
         if cfg.context_parallel:
+            if cfg.attn_logit_softcap > 0.0:
+                raise NotImplementedError(
+                    "attn_logit_softcap under context parallelism is not "
+                    "implemented (the ring/ulysses LSE merge assumes "
+                    "uncapped scores)")
+            if cfg.query_scale is not None:
+                raise NotImplementedError(
+                    "query_scale under context parallelism is not "
+                    "implemented (cp_attention has no scale override)")
             from torchacc_tpu.ops.context_parallel import cp_attention
             out = cp_attention(q, k, v, causal=True, window=cfg.window,
                                q_segment_ids=segment_ids,
@@ -368,11 +397,13 @@ class Attention(nn.Module):
                                impl=cfg.attention_impl)
         else:
             out = attention(q, k, v, causal=True, window=cfg.window,
+                            scale=cfg.query_scale,
                             q_segment_ids=segment_ids,
                             kv_segment_ids=segment_ids,
                             alibi_slopes=slopes, dropout_p=dropout_p,
                             dropout_seed=seed,
-                            impl=cfg.attention_impl)
+                            impl=cfg.attention_impl,
+                            logit_softcap=cfg.attn_logit_softcap)
         out = nn.DenseGeneral(
             features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
             name="o_proj", dtype=cfg.dtype, param_dtype=cfg.param_dtype,
@@ -447,10 +478,15 @@ class Block(nn.Module):
                 mlp_cls = nn.remat(mlp_cls, policy=pol, prevent_cse=False)
         attn_out = attn_cls(cfg, name="attn")(
             Norm(cfg, name="ln1")(x), positions, segment_ids, dropout_seed)
+        if cfg.sandwich_norms:
+            # Gemma2: post-attention norm before the residual add
+            attn_out = Norm(cfg, name="ln1_post")(attn_out)
         # names referenced by the 'offload_dots' remat policy (utils/remat.py)
         h = x + checkpoint_name(attn_out, "attn_out")
         mlp_out = mlp_cls(cfg, name="moe" if cfg.num_experts > 0 else "mlp")(
             Norm(cfg, name="ln2")(h))
+        if cfg.sandwich_norms:
+            mlp_out = Norm(cfg, name="ln2_post")(mlp_out)
         return h + checkpoint_name(mlp_out, "mlp_out")
 
 
@@ -547,6 +583,39 @@ class TransformerLM(nn.Module):
         )(cfg, name="layers")
         if self.is_initializing():
             (x, _, _), _ = scan_mod((x, positions, segment_ids), seeds_xs)
+        elif cfg.layer_pattern:
+            # heterogeneous layers (gemma2-style sliding/global
+            # alternation): the pattern is param-free, so params keep the
+            # canonical stacked layout; execution is a per-layer python
+            # loop with each layer's own static cfg (lax.scan cannot
+            # vary a static window across iterations).  Composes with
+            # GSPMD sharding (dp/fsdp/tp); pp is rejected in validation
+            # and decode/cache goes through generate()'s pattern path.
+            if cfg.pp_size > 1:
+                raise NotImplementedError(
+                    "layer_pattern with pipeline parallelism is not "
+                    "supported")
+            if cache_live:
+                raise NotImplementedError(
+                    "layer_pattern decode must go through "
+                    "models.generate (its pattern-aware cached path); "
+                    "direct .apply with a mutable cache is unsupported")
+            layer_params = self.variables["params"]["layers"]
+            aux_total = jnp.zeros((), jnp.float32)
+            carry = (x, positions, segment_ids)
+            from torchacc_tpu.utils.remat import remat_policy as _rp
+            for i in range(cfg.num_layers):
+                fn = _raw_block_fn(pattern_cfg(cfg, i))
+                if _block_remat(cfg):
+                    fn = jax.checkpoint(fn, policy=_rp(cfg.remat_policy),
+                                        prevent_cse=False)
+                p_i = jax.tree.map(lambda a, i=i: a[i], layer_params)
+                s_i = None if seeds_xs is None else seeds_xs[i]
+                carry, aux = fn(p_i, carry, s_i)
+                aux_total = aux_total + aux
+            if cfg.num_experts > 0:
+                self.sow("intermediates", "moe_aux_loss", aux_total)
+            x = carry[0]
         elif cfg.pp_size > 1:
             # pipeline path: drive the stacked layer params through the
             # pp-stage pipeline (init traced scan_mod so params exist
@@ -728,6 +797,22 @@ def _embed_extras(cfg: ModelConfig, x: jax.Array, positions: jax.Array,
     if cfg.pos_emb == "learned":
         x = x + pos_table.astype(cfg.dtype)[positions]
     return x
+
+
+def pattern_cfg(cfg: ModelConfig, i: int) -> ModelConfig:
+    """The effective per-layer config under ``cfg.layer_pattern``:
+    layer i takes pattern[i % len] — 'sliding' keeps cfg.window,
+    'global' lifts it to full attention.  Identity when no pattern."""
+    if not cfg.layer_pattern:
+        return cfg
+    kind = cfg.layer_pattern[i % len(cfg.layer_pattern)]
+    if kind == "sliding":
+        return cfg
+    if kind == "global":
+        return dataclasses.replace(cfg, window=(-1, -1))
+    raise ValueError(
+        f"layer_pattern entries must be 'sliding' | 'global', got "
+        f"{kind!r}")
 
 
 def head_logits(cfg: ModelConfig, params, x: jax.Array) -> jax.Array:

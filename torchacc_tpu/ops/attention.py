@@ -93,7 +93,8 @@ def make_attention_mask(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "window", "scale", "return_lse", "dropout_p"),
+    static_argnames=("causal", "window", "scale", "return_lse",
+                     "dropout_p", "logit_softcap"),
 )
 def attention_reference(
     q: jax.Array,
@@ -114,6 +115,7 @@ def attention_reference(
     h_offset=0,
     b_offset=0,
     return_lse: bool = False,
+    logit_softcap: float = 0.0,
 ):
     """Plain-XLA attention.  Returns ``out`` or ``(out, lse)``.
 
@@ -134,6 +136,10 @@ def attention_reference(
     # [b, h, sq, sk] scores in f32 for a stable softmax
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
+    if logit_softcap > 0.0:
+        # Gemma2 attention soft-capping: c * tanh(s / c), after the
+        # scale and BEFORE bias/mask (HF Gemma2Attention order)
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     if bias is not None:
         scores = scores + bias.astype(jnp.float32)
     shift = q_offset - k_offset + (sk - sq)
