@@ -194,6 +194,11 @@ def pipeline_blocks(
                                                              a.dtype), c)
                             for c in micro_local)
 
+        # Every device carries the queue (lockstep SPMD cannot allocate
+        # per-device) though only device 0 reads it; the footprint is
+        # bounded by ONE batch activation regardless of M (Qn slots of
+        # B/M rows each) — measured <0.5% of step temp memory at the
+        # bench geometry (docs/PERF.md).
         qbuf0 = (tuple(jax.tree.map(
             lambda a: jnp.zeros((Qn,) + a.shape[1:], a.dtype), c)
             for c in micro_local) if interleave_mp else None)
