@@ -88,14 +88,52 @@ def test_gemma2_logits_match():
     _compare(hf_model, ids, atol=3e-4)
 
 
-def test_gemma3_rejected_with_clear_error():
+def test_gemma3_logits_match():
+    """Gemma3: gemma2's recipe plus qk-norm and DUAL rope bases (local
+    theta on sliding layers, global theta on full-attention layers).
+    Six layers = one full 5:1 sliding/global cycle; prompt longer than
+    the window so both the pattern and the dual rope change the math."""
     if not hasattr(transformers, "Gemma3TextConfig"):
         pytest.skip("transformers too old for gemma3")
     hf_cfg = transformers.Gemma3TextConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2)
-    with pytest.raises(NotImplementedError, match="gemma3"):
-        config_from_hf(hf_cfg)
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=1000000.0,
+        rope_local_base_freq=10000.0, rope_scaling=None,
+        rms_norm_eps=1e-6, tie_word_embeddings=True,
+        sliding_window=8, query_pre_attn_scalar=16,
+        attn_implementation="eager")
+    torch.manual_seed(4)
+    hf_model = transformers.Gemma3ForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type in ("gemma3", "gemma3_text")
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.layer_pattern == ("sliding",) * 5 + ("global",)
+    assert cfg.qk_norm and cfg.rope_local_theta == 10000.0
+    ids = np.random.default_rng(4).integers(0, 128, size=(2, 24)).astype(np.int32)
+    _compare(hf_model, ids, atol=3e-4)
+
+
+def test_gemma3_rope_scaling_logits_match():
+    """Real gemma3 >=4B checkpoints ship linear rope_scaling factor 8 on
+    the GLOBAL rotary (sliding layers stay unscaled) — converted logits
+    must still be identical."""
+    if not hasattr(transformers, "Gemma3TextConfig"):
+        pytest.skip("transformers too old for gemma3")
+    hf_cfg = transformers.Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=6, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rope_theta=1000000.0,
+        rope_local_base_freq=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        rms_norm_eps=1e-6, tie_word_embeddings=True,
+        sliding_window=8, query_pre_attn_scalar=16,
+        attn_implementation="eager")
+    torch.manual_seed(5)
+    hf_model = transformers.Gemma3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.rope_scale == 8.0
+    ids = np.random.default_rng(5).integers(0, 128, size=(2, 24)).astype(np.int32)
+    _compare(hf_model, ids, atol=3e-4)
 
 
 def test_converted_model_trains(devices):

@@ -30,7 +30,8 @@ TRANSFORMER_AXES: Tuple[AxesRule, ...] = (
     (r"router/kernel$", ("embed", "expert")),
     (r"experts/(gate|up)$", ("expert", "embed", "expert_mlp")),
     (r"experts/down$", ("expert", "expert_mlp", "embed")),
-    (r"(ln1|ln2|ln1_post|ln2_post|final_norm)/(scale|bias)$", ("norm",)),
+    (r"(ln1|ln2|ln1_post|ln2_post|final_norm|q_norm|k_norm)/(scale|bias)$",
+     ("norm",)),
     (r"lm_head/kernel$", ("embed", "vocab")),
 )
 

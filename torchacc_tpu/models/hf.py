@@ -29,11 +29,6 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
     mistral/gemma family)."""
     get = lambda n, d=None: getattr(hf_config, n, d)
     mt = get("model_type")
-    if mt in ("gemma3", "gemma3_text"):
-        raise NotImplementedError(
-            f"model_type {mt!r}: gemma3's per-layer-TYPE rope bases "
-            "(local 10k / global 1M) and qk-norm are not implemented; "
-            "gemma (v1) and gemma2 are supported")
     kw = dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -65,6 +60,30 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             attn_logit_softcap=float(get("attn_logit_softcapping") or 0.0),
             query_scale=float(get("query_pre_attn_scalar",
                                   kw.get("head_dim") or 256)) ** -0.5)
+    if mt in ("gemma3", "gemma3_text"):
+        # Gemma3: gemma2's sandwich norms + 5:1 sliding/global pattern,
+        # per-layer-type rope bases (local 10k on sliding layers, global
+        # rope_theta on full layers), qk-norm, no score soft-capping
+        kw.update(
+            norm="rmsnorm1p", activation="geglu", embed_scale=True,
+            sandwich_norms=True, qk_norm=True,
+            layer_pattern=_pattern_from_layer_types(
+                get("layer_types"),
+                sliding_window_pattern=get("sliding_window_pattern")),
+            rope_local_theta=float(get("rope_local_base_freq", 10000.0)),
+            query_scale=float(get("query_pre_attn_scalar",
+                                  kw.get("head_dim") or 256)) ** -0.5)
+        rs = get("rope_scaling")
+        if rs:
+            rt = rs.get("rope_type", rs.get("type"))
+            if rt != "linear":
+                raise NotImplementedError(
+                    f"gemma3 rope_scaling type {rt!r} is not implemented "
+                    "(linear is)")
+            # linear scaling on the GLOBAL rotary only (sliding layers
+            # reset to 1 in pattern_cfg) — real gemma3 >=4B checkpoints
+            # ship factor 8
+            kw["rope_scale"] = float(rs["factor"])
     if get("final_logit_softcapping"):
         kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):
@@ -74,6 +93,27 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         kw["window"] = (int(get("sliding_window")) - 1, -1)
     kw.update(overrides)
     return ModelConfig(**kw)
+
+
+def _pattern_from_layer_types(layer_types,
+                              sliding_window_pattern=None
+                              ) -> Tuple[str, ...]:
+    """Shortest cyclic layer_pattern reproducing HF's per-layer
+    ``layer_types`` list (gemma3: 5 sliding + 1 full).  Pre-4.53
+    transformers gemma3 configs expose ``sliding_window_pattern=p``
+    (every p-th layer global) instead of ``layer_types``."""
+    if not layer_types:
+        if sliding_window_pattern:
+            p = int(sliding_window_pattern)
+            return ("sliding",) * (p - 1) + ("global",)
+        raise ValueError("layer_types missing from the HF config")
+    kinds = tuple("sliding" if t == "sliding_attention" else "global"
+                  for t in layer_types)
+    n = len(kinds)
+    for period in range(1, n):
+        if n % period == 0 and kinds == kinds[:period] * (n // period):
+            return kinds[:period]
+    return kinds  # no shorter period: one full cycle
 
 
 def _t(x) -> np.ndarray:
@@ -125,6 +165,11 @@ def params_from_hf_state_dict(
             attn[name]["bias"] = stack(
                 f"layers.{{i}}.self_attn.{name}.bias",
                 lambda b, heads=heads: b.reshape(heads, d))
+    if cfg.qk_norm:
+        attn["q_norm"] = {"scale": stack(
+            "layers.{i}.self_attn.q_norm.weight", lambda w: w)}
+        attn["k_norm"] = {"scale": stack(
+            "layers.{i}.self_attn.k_norm.weight", lambda w: w)}
 
     block = {
         "attn": attn,

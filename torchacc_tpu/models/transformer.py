@@ -77,6 +77,18 @@ class ModelConfig:
     # (HF post_attention_layernorm / post_feedforward_layernorm), adding
     # ln1_post / ln2_post params to each block
     sandwich_norms: bool = False
+    # Gemma3 qk-norm: per-head-dim RMSNorm on q and k after projection,
+    # before rope (adds q_norm / k_norm params to each attention)
+    qk_norm: bool = False
+    # Gemma3 dual rope bases: 'sliding' pattern layers use this theta
+    # (local 10k) while 'global' layers use cfg.rope_theta (1M);
+    # None = every layer uses cfg.rope_theta
+    rope_local_theta: Optional[float] = None
+    # linear rope position scaling (HF rope_scaling type 'linear'):
+    # rope sees positions / rope_scale.  Under a gemma3 layer_pattern
+    # the factor applies to GLOBAL layers only (sliding layers reset to
+    # 1, matching HF's unscaled local rotary)
+    rope_scale: float = 1.0
     # heterogeneous per-layer attention (gemma2/3): a cycle of
     # 'sliding' (uses cfg.window) | 'global' (full attention) applied as
     # layer i -> pattern[i % len]. None = every layer uses cfg.window.
@@ -274,8 +286,15 @@ class Attention(nn.Module):
         q = activation_constraint(q, ("batch", "seq", "heads", None), rules)
         k = activation_constraint(k, ("batch", "seq", "heads", None), rules)
         v = activation_constraint(v, ("batch", "seq", "heads", None), rules)
+        if cfg.qk_norm:
+            # Gemma3: per-head-dim RMSNorm on q and k after projection,
+            # BEFORE rope (HF Gemma3Attention q_norm/k_norm)
+            q = Norm(cfg, name="q_norm")(q)
+            k = Norm(cfg, name="k_norm")(k)
         if cfg.pos_emb == "rope":
-            q, k = _rope(q, k, positions, cfg.rope_theta)
+            rp = (positions.astype(jnp.float32) / cfg.rope_scale
+                  if cfg.rope_scale != 1.0 else positions)
+            q, k = _rope(q, k, rp, cfg.rope_theta)
         # names for the selective-remat policies (utils/remat.py): saving
         # post-rope q/k/v means the backward recomputes only the cheap
         # norms/elementwise ops, never the projections or the rope
@@ -825,6 +844,12 @@ def pattern_cfg(cfg: ModelConfig, i: int) -> ModelConfig:
         return cfg
     kind = cfg.layer_pattern[i % len(cfg.layer_pattern)]
     if kind == "sliding":
+        # gemma3 dual rope: sliding layers use the local base frequency,
+        # UNSCALED (HF applies rope_scaling to the global rotary only)
+        if cfg.rope_local_theta is not None:
+            return dataclasses.replace(cfg,
+                                       rope_theta=cfg.rope_local_theta,
+                                       rope_scale=1.0)
         return cfg
     if kind == "global":
         return dataclasses.replace(cfg, window=(-1, -1))
