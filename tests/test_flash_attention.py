@@ -334,3 +334,56 @@ def test_global_offsets_match_full_attention():
                                atol=3e-5, rtol=3e-5)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(full_lse[:, :, half:]),
                                atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("window", [(-1, -1), (32, -1)])
+def test_logit_softcap_matches_reference(window):
+    """Gemma2 attention-score soft-capping in the kernel: forward AND
+    gradients (the hand-written bwd must chain 1 - tanh^2 through the
+    recomputed scores) match the XLA reference, with and without a
+    sliding window."""
+    q, k, v = _make_qkv(2, 128, 128, 4, 2, 64, seed=7)
+    cap = 20.0
+
+    out = flash_attention(q, k, v, causal=True, window=window,
+                          logit_softcap=cap, block_q=64, block_k=64)
+    ref = attention_reference(q, k, v, causal=True, window=window,
+                              logit_softcap=cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_pl(q, k, v):
+        return jnp.sum(flash_attention(
+            q, k, v, causal=True, window=window, logit_softcap=cap,
+            block_q=64, block_k=64).astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(
+            q, k, v, causal=True, window=window,
+            logit_softcap=cap).astype(jnp.float32) ** 2)
+
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    g_rf = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_pl, g_rf, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{name}")
+    # capping actually changes the math (the test is not vacuous)
+    base = flash_attention(q, k, v, causal=True, window=window,
+                           block_q=64, block_k=64)
+    assert not np.allclose(np.asarray(out), np.asarray(base), atol=1e-3)
+
+    # the standalone fwd(return_lse)+bwd pair (the CP-ring contract)
+    # honors the cap too
+    from torchacc_tpu.ops.flash_attention import flash_attention_bwd
+    o2, lse = flash_attention(q, k, v, causal=True, window=window,
+                              logit_softcap=cap, return_lse=True,
+                              block_q=64, block_k=64)
+    do = (2.0 * o2.astype(jnp.float32)).astype(q.dtype)
+    dq, dk, dv = flash_attention_bwd(
+        q, k, v, o2, lse, do, causal=True, window=window,
+        logit_softcap=cap, block_q=64, block_k=64)
+    for a, b, name in zip((dq, dk, dv), g_rf, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5,
+                                   err_msg=f"standalone d{name}")

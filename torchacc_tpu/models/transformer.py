@@ -67,8 +67,7 @@ class ModelConfig:
     window: Tuple[int, int] = (-1, -1)      # sliding-window attention
     # Gemma2-style attention-score soft-capping: scores = c * tanh(s/c)
     # applied after the q-scale, before mask/softmax; 0 disables.
-    # Routed to the XLA attention (the Pallas kernel does not implement
-    # it — ops/attn.py falls back with a warning).
+    # Implemented by both the Pallas kernel and the XLA attention.
     attn_logit_softcap: float = 0.0
     # query scaling override: None = head_dim ** -0.5; Gemma2 sets
     # query_pre_attn_scalar ** -0.5

@@ -42,19 +42,11 @@ def attention(
     ``dropout_p``/``dropout_seed``: post-softmax attention dropout; the
     stateless coordinate-hash mask (ops/_common.py) makes the pallas and
     xla backends bit-identical for the same seed.  ``logit_softcap``
-    (Gemma2 score capping) is implemented by the XLA attention only —
-    requesting it routes there."""
+    (Gemma2 score capping) is implemented by both backends."""
     global _warned_fallback
     forced = impl == "pallas"
     if impl == "auto":
         impl = "pallas" if _on_tpu() else "xla"
-    if logit_softcap > 0.0 and impl == "pallas":
-        if not _warned_fallback:
-            _warned_fallback = True
-            from torchacc_tpu.utils.logger import logger
-            logger.warning("attention logit_softcap is not implemented in "
-                           "the Pallas kernel; using plain-XLA attention")
-        impl = "xla"
     if impl == "pallas":
         try:
             from torchacc_tpu.ops.flash_attention import flash_attention
@@ -62,7 +54,8 @@ def attention(
                 q, k, v, causal=causal, window=window, scale=scale,
                 q_segment_ids=q_segment_ids, kv_segment_ids=kv_segment_ids,
                 alibi_slopes=alibi_slopes, dropout_p=dropout_p,
-                dropout_seed=dropout_seed, return_lse=return_lse)
+                dropout_seed=dropout_seed, return_lse=return_lse,
+                logit_softcap=logit_softcap)
         except ImportError:
             if forced:
                 raise

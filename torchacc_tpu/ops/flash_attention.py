@@ -180,7 +180,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
                 o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
                 *, scale, causal, window, block_q, block_k, num_kv_blocks,
-                qk_shift=0, dropout_p=0.0):
+                qk_shift=0, dropout_p=0.0, logit_softcap=0.0):
     bi = pl.program_id(0)
     hi = pl.program_id(1)
     qi = pl.program_id(2)
@@ -212,6 +212,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [bq, bk]
+        if logit_softcap > 0.0:
+            # Gemma2 score capping: c * tanh(s / c), after the scale and
+            # before alibi/mask (matches the XLA reference)
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
         if alibi_ref is not None:
             s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
                                 block_q, block_k, shift)
@@ -295,7 +299,8 @@ def _alibi_operand(alibi_slopes):
 
 
 def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta, scale,
-         causal, window, block_q, block_k, qk_shift=0, dropout_p=0.0):
+         causal, window, block_q, block_k, qk_shift=0, dropout_p=0.0,
+         logit_softcap=0.0):
     """q,k,v in BHSD.  Returns (o BHSD, lse [b,h,sq] f32).
 
     ``meta``: optional int32 [5] = (dropout seed, global q offset,
@@ -315,7 +320,8 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta, scale,
         _fwd_kernel, has_seg, has_alibi, has_meta,
         scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_kv_blocks=nk,
-        qk_shift=qk_shift, dropout_p=dropout_p)
+        qk_shift=qk_shift, dropout_p=dropout_p,
+        logit_softcap=logit_softcap)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q, d), lambda b_, h, qi, ki: (b_, h, qi, 0)),
@@ -378,12 +384,15 @@ def _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta, scale,
 
 def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref, lse,
                  q_start, k_start, b_idx, h_idx, *, scale, causal, window,
-                 block_q, block_k, qk_shift=0, dropout_p=0.0, masked=True):
+                 block_q, block_k, qk_shift=0, dropout_p=0.0,
+                 logit_softcap=0.0, masked=True):
     """Rebuild (p, p_tilde, q, k) for one tile from the saved lse.
 
-    ``p`` is the exact softmax tile; ``p_tilde`` is the dropout-scaled
-    tile actually used in the forward P@V (equal to ``p`` when dropout is
-    off).  The VJP through dropped softmax is
+    Returns (p, p_tilde, q, k, dcap): ``p`` is the exact softmax tile;
+    ``p_tilde`` is the dropout-scaled tile actually used in the forward
+    P@V (equal to ``p`` when dropout is off); ``dcap`` is the softcap
+    derivative factor 1 - tanh^2 (1.0 when capping is off) the caller
+    must chain into dS.  The VJP through dropped softmax is
         dS = P̃ ∘ (dO Vᵀ) − P ∘ delta
     with delta = rowsum(dO ∘ O) — note P̃ multiplies the dO Vᵀ term and
     the plain P multiplies delta."""
@@ -394,6 +403,12 @@ def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref, lse,
     k = k_ref[0, 0, :, :]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
+    dcap = 1.0
+    if logit_softcap > 0.0:
+        s = logit_softcap * jnp.tanh(s / logit_softcap)
+        # d(c*tanh(x/c))/dx = 1 - tanh^2 = 1 - (s_capped / c)^2, taken
+        # BEFORE the alibi bias lands on s
+        dcap = 1.0 - (s / logit_softcap) ** 2
     if alibi_ref is not None:
         s = s + _alibi_bias(alibi_ref[0, 0, 0], q_start, k_start,
                             block_q, block_k, shift)
@@ -414,13 +429,14 @@ def _recompute_p(q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref, lse,
             meta_ref[1] + q_start, meta_ref[2] + k_start,
             block_q, block_k, dropout_p)
         p_tilde = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
-    return p, p_tilde, q, k
+    return p, p_tilde, q, k, dcap
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
                    meta_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
                    *, scale, causal, window, block_q, block_k,
-                   num_kv_blocks, qk_shift=0, dropout_p=0.0):
+                   num_kv_blocks, qk_shift=0, dropout_p=0.0,
+                   logit_softcap=0.0):
     bi = pl.program_id(0)
     hi = pl.program_id(1)
     qi = pl.program_id(2)
@@ -441,15 +457,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
         delta = delta_ref[0, 0, :, 0]
         do = do_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
-        p, p_tilde, q, k = _recompute_p(
+        p, p_tilde, q, k, dcap = _recompute_p(
             q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
             lse, q_start, k_start, bi, hi, scale=scale,
             causal=causal, window=window, block_q=block_q,
             block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p,
-            masked=masked)
+            logit_softcap=logit_softcap, masked=masked)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = (p_tilde * dp - p * delta[:, None]) * scale
+        ds = (p_tilde * dp - p * delta[:, None]) * dcap * scale
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -469,7 +485,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
                     meta_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
                     dk_scr, dv_scr,
                     *, scale, causal, window, block_q, block_k,
-                    num_q_blocks, group, qk_shift=0, dropout_p=0.0):
+                    num_q_blocks, group, qk_shift=0, dropout_p=0.0,
+                    logit_softcap=0.0):
     # grid (b, hk, nk, group, nq): the scratch accumulates over the whole
     # (group, q-block) inner sweep, so GQA/MQA grads never materialise
     # per-q-head dk/dv in HBM.
@@ -496,18 +513,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
         delta = delta_ref[0, 0, :, 0]
         do = do_ref[0, 0, :, :]
         v = v_ref[0, 0, :, :]
-        p, p_tilde, q, k = _recompute_p(
+        p, p_tilde, q, k, dcap = _recompute_p(
             q_ref, k_ref, qseg_ref, kseg_ref, alibi_ref, meta_ref,
             lse, q_start, k_start, bi, h_idx, scale=scale,
             causal=causal, window=window, block_q=block_q,
             block_k=block_k, qk_shift=qk_shift, dropout_p=dropout_p,
-            masked=masked)
+            logit_softcap=logit_softcap, masked=masked)
         dv_scr[...] += jax.lax.dot_general(
             p_tilde.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = (p_tilde * dp - p * delta[:, None]) * scale        # [bq, bk]
+        ds = (p_tilde * dp - p * delta[:, None]) * dcap * scale  # [bq, bk]
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)                 # [bk, d]
@@ -525,7 +542,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, alibi_ref,
 
 
 def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0,
-         dropout_p=0.0):
+         dropout_p=0.0, logit_softcap=0.0):
     (q, k, v, o, lse, q_segment_ids, kv_segment_ids, alibi_slopes,
      meta) = res
     b, hq, sq, d = q.shape
@@ -545,7 +562,7 @@ def _bwd(res, do, *, scale, causal, window, block_q, block_k, qk_shift=0,
 
     common = dict(scale=scale, causal=causal, window=window,
                   block_q=block_q, block_k=block_k, qk_shift=qk_shift,
-                  dropout_p=dropout_p)
+                  dropout_p=dropout_p, logit_softcap=logit_softcap)
 
     if has_seg:
         qseg = jax.lax.broadcast_in_dim(
@@ -677,21 +694,25 @@ def _pad_seq(x, block, axis, value=0):
     return jnp.pad(x, pad, constant_values=value)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
 def _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
-           scale, causal, window, block_q, block_k, qk_shift, dropout_p):
+           scale, causal, window, block_q, block_k, qk_shift, dropout_p,
+           logit_softcap):
     o, _ = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
-                scale, causal, window, block_q, block_k, qk_shift, dropout_p)
+                scale, causal, window, block_q, block_k, qk_shift, dropout_p,
+                logit_softcap)
     return o
 
 
 def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
-               scale, causal, window, block_q, block_k, qk_shift, dropout_p):
+               scale, causal, window, block_q, block_k, qk_shift, dropout_p,
+               logit_softcap):
     from jax.ad_checkpoint import checkpoint_name
 
     o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
                   scale, causal, window, block_q, block_k, qk_shift,
-                  dropout_p)
+                  dropout_p, logit_softcap)
     # Named so the selective-remat policies (utils/remat.py 'save_attn*')
     # can save the kernel's residuals and skip re-running the fwd kernel
     # in the backward pass; identity outside jax.checkpoint.  The SAME
@@ -704,10 +725,10 @@ def _flash_fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
 
 
 def _flash_bwd(scale, causal, window, block_q, block_k, qk_shift, dropout_p,
-               res, g):
+               logit_softcap, res, g):
     return _bwd(res, g, scale=scale, causal=causal, window=window,
                 block_q=block_q, block_k=block_k, qk_shift=qk_shift,
-                dropout_p=dropout_p)
+                dropout_p=dropout_p, logit_softcap=logit_softcap)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -751,6 +772,7 @@ def flash_attention(
     return_lse: bool = False,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    logit_softcap: float = 0.0,
 ):
     """[b, s, h, d] flash attention (see module docstring).
 
@@ -813,10 +835,12 @@ def flash_attention(
     if return_lse:
         o, lse = _fwd(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
                       meta, scale, causal, window, block_q, block_k,
-                      qk_shift=sk - sq, dropout_p=dropout_p)
+                      qk_shift=sk - sq, dropout_p=dropout_p,
+                      logit_softcap=logit_softcap)
         return o.swapaxes(1, 2)[:, :sq], lse[:, :, :sq]
     o = _flash(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes, meta,
-               scale, causal, window, block_q, block_k, sk - sq, dropout_p)
+               scale, causal, window, block_q, block_k, sk - sq, dropout_p,
+               float(logit_softcap))
     return o.swapaxes(1, 2)[:, :sq]
 
 
@@ -842,6 +866,7 @@ def flash_attention_bwd(
     b_offset=0,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    logit_softcap: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Standalone flash backward: (dq, dk, dv) from saved (o, lse).
 
@@ -882,7 +907,8 @@ def flash_attention_bwd(
     dq, dk, dv, _, _, _, _ = _bwd(res, doT, scale=scale, causal=causal,
                                   window=window, block_q=block_q,
                                   block_k=block_k, qk_shift=sk - sq,
-                                  dropout_p=dropout_p)
+                                  dropout_p=dropout_p,
+                                  logit_softcap=logit_softcap)
     return (dq.swapaxes(1, 2)[:, :sq], dk.swapaxes(1, 2)[:, :sk],
             dv.swapaxes(1, 2)[:, :sk])
 
