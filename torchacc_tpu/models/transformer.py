@@ -397,17 +397,14 @@ class Attention(nn.Module):
             dropout_p = cfg.attn_dropout
             seed = dropout_seed
         if cfg.context_parallel:
-            if cfg.attn_logit_softcap > 0.0:
-                raise NotImplementedError(
-                    "attn_logit_softcap under context parallelism is not "
-                    "implemented (the ring/ulysses LSE merge assumes "
-                    "uncapped scores)")
-            if cfg.query_scale is not None:
-                raise NotImplementedError(
-                    "query_scale under context parallelism is not "
-                    "implemented (cp_attention has no scale override)")
+            # scale and score softcap are both elementwise on the
+            # pre-softmax scores, so the ring/ulysses LSE merge is exact
+            # with them (each chunk caps the same per-score values the
+            # global computation would)
             from torchacc_tpu.ops.context_parallel import cp_attention
             out = cp_attention(q, k, v, causal=True, window=cfg.window,
+                               scale=cfg.query_scale,
+                               logit_softcap=cfg.attn_logit_softcap,
                                q_segment_ids=segment_ids,
                                kv_segment_ids=segment_ids,
                                alibi_slopes=slopes, dropout_p=dropout_p,

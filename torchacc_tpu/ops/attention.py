@@ -196,6 +196,7 @@ def attention_reference_bwd(
     k_offset=0,
     h_offset=0,
     b_offset=0,
+    logit_softcap: float = 0.0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Plain-XLA flash-style backward from saved (o, lse): (dq, dk, dv).
 
@@ -218,6 +219,11 @@ def attention_reference_bwd(
 
     shift = q_offset - k_offset + (sk - sq)
     s = jnp.einsum("bqhd,bkhd->bhqk", qf, kr) * scale
+    dcap = 1.0
+    if logit_softcap > 0.0:
+        s = logit_softcap * jnp.tanh(s / logit_softcap)
+        # derivative of c*tanh(x/c) = 1 - tanh^2, taken before alibi
+        dcap = 1.0 - (s / logit_softcap) ** 2
     if alibi_slopes is not None:
         s = s + _alibi_scores(alibi_slopes, sq, sk, shift)
     mask = make_attention_mask(sq, sk, causal=causal, window=window,
@@ -238,7 +244,7 @@ def attention_reference_bwd(
         p_tilde = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_p))
     delta = jnp.einsum("bqhd,bqhd->bhq", dof, of)
     dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vr)
-    ds = (p_tilde * dp - p * delta[..., None]) * scale
+    ds = (p_tilde * dp - p * delta[..., None]) * dcap * scale
     dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kr)
     dk_full = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
     dv_full = jnp.einsum("bhqk,bqhd->bkhd", p_tilde, dof)

@@ -67,6 +67,8 @@ def cp_attention(
     *,
     causal: bool = True,
     window: Tuple[int, int] = (-1, -1),
+    scale: Optional[float] = None,
+    logit_softcap: float = 0.0,
     q_segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     alibi_slopes: Optional[jax.Array] = None,
@@ -88,6 +90,7 @@ def cp_attention(
     ul_n = int(mesh.shape.get(a2a_axis, 1)) if mesh is not None else 1
     if ring_n * ul_n == 1:
         return attention(q, k, v, causal=causal, window=window,
+                         scale=scale, logit_softcap=logit_softcap,
                          q_segment_ids=q_segment_ids,
                          kv_segment_ids=kv_segment_ids,
                          alibi_slopes=alibi_slopes, dropout_p=dropout_p,
@@ -143,7 +146,8 @@ def cp_attention(
 
         return b_off, inner_offsets
 
-    scale = d ** -0.5
+    if scale is None:
+        scale = d ** -0.5
 
     def region_fwd(q, k, v, *rest):
         """Forward returning (out, o_inner, lse): the inner-layout
@@ -158,7 +162,7 @@ def cp_attention(
                 o, lse = _ring_fwd_impl(
                     q_, k_, v_, qs_, ks_, slopes, seed, h_off, b_off,
                     ring_axis, ring_n, causal, window, dropout_p,
-                    inner_impl)
+                    inner_impl, scale, logit_softcap)
             else:
                 fn = (attention_reference if inner_impl == "xla"
                       else flash_attention)
@@ -167,7 +171,8 @@ def cp_attention(
                             kv_segment_ids=ks_, alibi_slopes=slopes,
                             dropout_p=dropout_p, dropout_seed=seed,
                             h_offset=h_off, b_offset=b_off,
-                            return_lse=True)
+                            return_lse=True,
+                            logit_softcap=logit_softcap)
             return o, (o, lse)
 
         out, (o_in, lse) = ulysses_attention(
@@ -199,7 +204,8 @@ def cp_attention(
                 q_, k_, v_, qs_, ks_, slopes, seed, h_off, b_off,
                 o_in, lse, do_, axis_name=ring_axis, n=ring_n,
                 causal=causal, window=window, dropout_p=dropout_p,
-                impl=inner_impl)
+                impl=inner_impl, scale=scale,
+                logit_softcap=logit_softcap)
         else:
             bwd = (attention_reference_bwd if inner_impl == "xla"
                    else flash_attention_bwd)
@@ -208,7 +214,8 @@ def cp_attention(
                              q_segment_ids=qs_, kv_segment_ids=ks_,
                              alibi_slopes=slopes, dropout_p=dropout_p,
                              dropout_seed=seed, h_offset=h_off,
-                             b_offset=b_off)
+                             b_offset=b_off,
+                             logit_softcap=logit_softcap)
         if ul_n > 1:
             a2a_out = lambda x: jax.lax.all_to_all(
                 x, a2a_axis, split_axis=1, concat_axis=2, tiled=True)

@@ -69,25 +69,30 @@ def _step_should_run(me, src, s: int, causal: bool, window):
     return run
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11, 12, 13, 14))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(9, 10, 11, 12, 13, 14, 15, 16))
 def ring_attention(q, k, v, q_segment_ids, kv_segment_ids, alibi_slopes,
                    dropout_seed, h_offset, b_offset,
                    axis_name: str, n: int, causal: bool,
                    window: Tuple[int, int] = (-1, -1),
                    dropout_p: float = 0.0,
-                   impl: str = "pallas"):
+                   impl: str = "pallas",
+                   scale=None, logit_softcap: float = 0.0):
     out, _ = _ring_fwd_impl(q, k, v, q_segment_ids, kv_segment_ids,
                             alibi_slopes, dropout_seed, h_offset, b_offset,
-                            axis_name, n, causal, window, dropout_p, impl)
+                            axis_name, n, causal, window, dropout_p, impl,
+                            scale, logit_softcap)
     return out
 
 
 def _ring_fwd_impl(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
                    h_offset, b_offset,
-                   axis_name, n, causal, window, dropout_p, impl):
+                   axis_name, n, causal, window, dropout_p, impl,
+                   scale=None, logit_softcap=0.0):
     b, sq, hq, d = q.shape
     me = jax.lax.axis_index(axis_name)
-    scale = d ** -0.5
+    if scale is None:
+        scale = d ** -0.5
 
     out0 = jnp.zeros((b, sq, hq, d), jnp.float32)
     lse0 = jnp.full((b, hq, sq), NEG_INF, jnp.float32)
@@ -107,7 +112,8 @@ def _ring_fwd_impl(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
                        kv_segment_ids=kseg_cur, alibi_slopes=alibi_slopes,
                        dropout_p=dropout_p, dropout_seed=dropout_seed,
                        q_offset=me * sq, k_offset=src * sq,
-                       h_offset=h_offset, b_offset=b_offset)
+                       h_offset=h_offset, b_offset=b_offset,
+                       logit_softcap=logit_softcap)
 
         o_i, lse_i = jax.lax.cond(
             _step_should_run(me, src, sq, causal, window), _run, _skip, None)
@@ -126,29 +132,33 @@ def _ring_fwd_impl(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
 
 def _ring_fwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
               h_offset, b_offset,
-              axis_name, n, causal, window, dropout_p, impl):
+              axis_name, n, causal, window, dropout_p, impl,
+              scale=None, logit_softcap=0.0):
     out, lse = _ring_fwd_impl(q, k, v, qseg, kseg, alibi_slopes,
                               dropout_seed, h_offset, b_offset,
                               axis_name, n, causal, window,
-                              dropout_p, impl)
+                              dropout_p, impl, scale, logit_softcap)
     return out, (q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
                  h_offset, b_offset, out, lse)
 
 
-def _ring_bwd(axis_name, n, causal, window, dropout_p, impl, res, do):
+def _ring_bwd(axis_name, n, causal, window, dropout_p, impl,
+              scale, logit_softcap, res, do):
     (q, k, v, qseg, kseg, alibi_slopes, dropout_seed, h_offset, b_offset,
      o, lse) = res
     dq, dk, dv = ring_attention_bwd(
         q, k, v, qseg, kseg, alibi_slopes, dropout_seed, h_offset,
         b_offset, o, lse, do, axis_name=axis_name, n=n, causal=causal,
-        window=window, dropout_p=dropout_p, impl=impl)
+        window=window, dropout_p=dropout_p, impl=impl, scale=scale,
+        logit_softcap=logit_softcap)
     return dq, dk, dv, None, None, None, None, None, None
 
 
 def ring_attention_bwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
                        h_offset, b_offset, o, lse, do, *,
                        axis_name, n, causal, window=(-1, -1),
-                       dropout_p=0.0, impl="pallas"):
+                       dropout_p=0.0, impl="pallas", scale=None,
+                       logit_softcap=0.0):
     """Explicit ring backward from the saved merged (o, lse): (dq, dk, dv).
 
     Exposed (like :func:`flash_attention_bwd`) so cp_attention's
@@ -158,7 +168,8 @@ def ring_attention_bwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
     rotation, ring_attn.py:130-271)."""
     b, sq, hq, d = q.shape
     me = jax.lax.axis_index(axis_name)
-    scale = d ** -0.5
+    if scale is None:
+        scale = d ** -0.5
 
     dq0 = jnp.zeros(q.shape, jnp.float32)
     dk0 = jnp.zeros(k.shape, jnp.float32)
@@ -179,7 +190,8 @@ def ring_attention_bwd(q, k, v, qseg, kseg, alibi_slopes, dropout_seed,
                        kv_segment_ids=kseg_cur, alibi_slopes=alibi_slopes,
                        dropout_p=dropout_p, dropout_seed=dropout_seed,
                        q_offset=me * sq, k_offset=src * sq,
-                       h_offset=h_offset, b_offset=b_offset)
+                       h_offset=h_offset, b_offset=b_offset,
+                       logit_softcap=logit_softcap)
 
         dq_i, dk_i, dv_i = jax.lax.cond(
             _step_should_run(me, src, sq, causal, window), _run, _skip, None)
