@@ -432,3 +432,43 @@ def test_generate_pp_cfg_without_mesh_demotes(devices):
     mc_pp = dataclasses.replace(mc, pp_size=2, pp_num_micro=2)
     out = generate(TransformerLM(mc_pp), params, prompt, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_pp_x_cp_cached_matches_single(devices, monkeypatch):
+    """The pp x cp decode COMBINATION (the last former recompute
+    fallback): the cp attention shard_map nests inside the pp stage
+    ring; greedy tokens match single-device exactly — through the
+    CACHED path (the recompute fallback is poisoned)."""
+    import dataclasses
+    import sys
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+
+    mc = get_preset("llama-tiny", vocab_size=97, hidden_size=64,
+                    num_layers=4, num_heads=4, num_kv_heads=4,
+                    intermediate_size=128, max_seq_len=64,
+                    dtype=jnp.float32)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(1, 97, (4, 8)),
+                         jnp.int32)
+    params = TransformerLM(mc).init(jax.random.PRNGKey(0), prompt)["params"]
+    ref = generate(TransformerLM(mc), params, prompt, max_new_tokens=8)
+
+    gen_mod = sys.modules["torchacc_tpu.models.generate"]
+
+    def _no_fallback(*a, **kw):
+        raise AssertionError("pp x cp must take the PP-RING cached path")
+
+    # poison every other route so only _generate_cached_pp can answer
+    monkeypatch.setattr(gen_mod, "_generate_recompute", _no_fallback)
+    monkeypatch.setattr(gen_mod, "_generate_cached", _no_fallback)
+    cfg = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=2),
+        sp=ta.SPConfig(size=2, mode="ring"), dp=ta.DPConfig(size=2)))
+    mesh = cfg.get_mesh()
+    mc_ppcp = dataclasses.replace(mc, pp_size=2, pp_num_micro=2,
+                                  context_parallel=True)
+    with jax.sharding.set_mesh(mesh):
+        out = generate(TransformerLM(mc_ppcp), params, prompt,
+                       max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))

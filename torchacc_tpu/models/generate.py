@@ -229,7 +229,11 @@ def generate(
         raise ValueError(
             f"prompt + max_new_tokens = {total} exceeds the learned "
             f"position table max_seq_len {cfg.max_seq_len}")
-    if can_cache and pp_live and not cp_cfg:
+    if (can_cache and pp_live
+            and (not cp_cfg or _mesh_extent("sp", "spu") > 1)):
+        # pp x cp composes: the cp attention shard_map nests inside the
+        # pp stage ring exactly as in the training path, and the cache's
+        # slot sharding rides through the stage-local layout
         return _generate_cached_pp(cfg, params, prompt_ids, prompt_mask,
                                    rng, float(temperature),
                                    int(max_new_tokens), eos_id,
@@ -244,10 +248,8 @@ def generate(
             cfg, params, prompt_ids, prompt_mask, rng,
             float(temperature), int(max_new_tokens), eos_id,
             int(top_k), float(top_p))
-    # pp x cp decode: the one remaining recompute fallback (a cp
-    # attention shard_map nested inside the pp stage ring is untested);
-    # a cp cfg without a live sp/spu mesh axis also falls back (the cp
-    # attention shard_map needs the axes)
+    # a cp cfg without a live sp/spu mesh axis falls back to recompute
+    # (the cp attention shard_map needs the axes)
     can_cache = (can_cache and not pp_live
                  and getattr(cfg, "pp_size", 1) == 1
                  and not getattr(cfg, "layer_pattern", None)
