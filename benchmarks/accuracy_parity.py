@@ -134,6 +134,17 @@ def _build_hf(family: str, seq: int, hidden: int = 64, layers: int = 2,
     if family == "qwen2":  # qkv bias — the reference's Qwen patch target
         return transformers.Qwen2ForCausalLM(
             transformers.Qwen2Config(**kw)).float()
+    if family == "gemma2":  # sandwich norms, layer pattern, soft-caps
+        kw = dict(kw, head_dim=max(kw["hidden_size"]
+                                   // kw["num_attention_heads"], 8),
+                  sliding_window=max(seq // 4, 4),
+                  query_pre_attn_scalar=16,
+                  attn_logit_softcapping=50.0,
+                  final_logit_softcapping=30.0,
+                  tie_word_embeddings=True, rms_norm_eps=1e-6,
+                  attn_implementation="eager")
+        return transformers.Gemma2ForCausalLM(
+            transformers.Gemma2Config(**kw)).float()
     raise ValueError(family)
 
 
@@ -146,7 +157,7 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--family", default="llama",
-                    choices=["llama", "qwen2"])
+                    choices=["llama", "qwen2", "gemma2"])
     ap.add_argument("--optimizer", default="sgd",
                     choices=["sgd", "adamw"],
                     help="adamw = the long-horizon leg where moment "

@@ -78,6 +78,36 @@ def gemma_7b(**kw) -> ModelConfig:
     return ModelConfig(**defaults)
 
 
+def gemma2_2b(**kw) -> ModelConfig:
+    # HF google/gemma-2-2b config.json (sandwich norms, alternating
+    # sliding/global attention, score + logit soft-capping, fixed query
+    # scale query_pre_attn_scalar=256)
+    defaults = dict(vocab_size=256000, hidden_size=2304, num_layers=26,
+        num_heads=8, num_kv_heads=4, head_dim=256, intermediate_size=9216,
+        max_seq_len=8192, rope_theta=10000.0, norm="rmsnorm1p",
+        activation="geglu", embed_scale=True, tie_embeddings=True,
+        norm_eps=1e-6, sandwich_norms=True,
+        layer_pattern=("sliding", "global"), window=(4095, -1),
+        attn_logit_softcap=50.0, logit_softcap=30.0,
+        query_scale=256.0 ** -0.5)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+def gemma3_1b(**kw) -> ModelConfig:
+    # HF google/gemma-3-1b-pt config.json (5:1 sliding/global pattern,
+    # dual rope bases, qk-norm; no soft-capping)
+    defaults = dict(vocab_size=262144, hidden_size=1152, num_layers=26,
+        num_heads=4, num_kv_heads=1, head_dim=256, intermediate_size=6912,
+        max_seq_len=32768, rope_theta=1000000.0, rope_local_theta=10000.0,
+        norm="rmsnorm1p", activation="geglu", embed_scale=True,
+        tie_embeddings=True, norm_eps=1e-6, sandwich_norms=True,
+        qk_norm=True, layer_pattern=("sliding",) * 5 + ("global",),
+        window=(511, -1), query_scale=256.0 ** -0.5)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
 def mixtral_8x7b(**kw) -> ModelConfig:
     defaults = dict(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
         num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
@@ -95,6 +125,8 @@ PRESETS = {
     "qwen2-7b": qwen2_7b,
     "gemma-2b": gemma_2b,
     "gemma-7b": gemma_7b,
+    "gemma2-2b": gemma2_2b,
+    "gemma3-1b": gemma3_1b,
     "mixtral-8x7b": mixtral_8x7b,
 }
 
