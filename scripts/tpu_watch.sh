@@ -24,7 +24,18 @@ x = jnp.ones((128, 128))
 float((x @ x).sum())
 print('accelerator up:', d[0].platform, d[0].device_kind)
 " >> "$LOG" 2>&1; then
-    echo "[watch] TPU up at $(date -u +%FT%TZ); capturing bench" >> "$LOG"
+    echo "[watch] TPU up at $(date -u +%FT%TZ); running tpu_smoke" >> "$LOG"
+    # on-chip smoke set FIRST (kernel compile at bench blocks, offload
+    # placement execute, tp fused-CE, train+decode): a regression that
+    # interpret-mode tests cannot see must be caught in the same window.
+    # Bench still runs on smoke failure — the MFU number is the round's
+    # scarcest artifact — but the failure is logged loudly for triage.
+    if timeout 900 python -m pytest tests_tpu -q -m tpu_smoke >> "$LOG" 2>&1; then
+      echo "[watch] tpu_smoke PASSED" >> "$LOG"
+    else
+      echo "[watch] tpu_smoke FAILED (rc=$?) — see log above; continuing to bench" >> "$LOG"
+    fi
+    echo "[watch] capturing bench" >> "$LOG"
     if timeout 1800 python bench.py --profile docs/profile_r3 >> "$LOG" 2>&1; then
       echo "[watch] full bench captured" >> "$LOG"
       if [ -f benchmarks/bench_8b.py ]; then

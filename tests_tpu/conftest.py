@@ -1,0 +1,50 @@
+"""On-chip smoke suite: runs on the REAL accelerator, not the CPU mesh.
+
+The main suite (tests/) forces an 8-device emulated CPU mesh, so Pallas
+kernels run in interpret mode and host-offload placement never executes.
+This directory is the complement: a handful of fast checks that exercise
+the exact code paths only visible on hardware — Mosaic kernel compilation
+at bench block sizes, pinned_host placement execution, the tp fused-CE
+manual-collective lowering, one real train step and a cached decode.
+
+`scripts/tpu_watch.sh` runs this set the moment the TPU transport
+recovers, BEFORE the long bench, so a kernel regression invisible to
+interpret mode is caught in the same window it becomes observable.
+"""
+
+import os
+
+import pytest
+
+if os.environ.get("TPU_SMOKE_ALLOW_CPU"):
+    # The TPU site hook overrides JAX_PLATFORMS via jax.config (same
+    # problem tests/conftest.py solves): in debug mode pin CPU through
+    # the config too, or the import probes the (possibly dead) remote
+    # transport and hangs.
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu_smoke: on-chip smoke checks (skipped off-chip)")
+
+
+@pytest.fixture(scope="session")
+def chip():
+    """The real accelerator device; skips the suite when only CPU exists.
+
+    Intentionally no platform forcing here — whatever backend the site
+    hook resolves (tpu / experimental axon plugin) is what we smoke.
+    Set TPU_SMOKE_ALLOW_CPU=1 to run the suite on CPU for harness
+    debugging (numbers are then meaningless but the code paths execute;
+    Pallas falls back to interpret mode).
+    """
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu" and not os.environ.get("TPU_SMOKE_ALLOW_CPU"):
+        pytest.skip("no accelerator: tpu_smoke needs the real chip")
+    return dev
