@@ -1,0 +1,336 @@
+"""Streamed safetensors ingestion (models/hf_stream.py): bounded host
+memory, shard-by-shard conversion, direct placement into target
+shardings.  Reference capability: LOW_CPU_MEM_USAGE deferred init
+(reference accelerate.py:13-17,114-119 via torchdistx fake tensors) —
+here the TPU-native answer is streaming straight to sharded device
+arrays, no full-model materialisation ever."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import transformers
+
+from torchacc_tpu.models import TransformerLM
+from torchacc_tpu.models.hf import config_from_hf, params_from_hf_state_dict
+from torchacc_tpu.models.hf_stream import (
+    ingestion_plan, load_hf_model_streamed, resolve_checkpoint_files,
+    stream_params, validate_checkpoint_header)
+
+
+def _tiny_llama_cfg(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager")
+    base.update(kw)
+    return transformers.LlamaConfig(**base)
+
+
+def _save_sharded(hf_model, path, n_shards=3):
+    """Write an HF-style multi-shard safetensors checkpoint (index json
+    + shards), the exact on-disk layout real releases ship."""
+    from safetensors.torch import save_file
+
+    sd = {k: v.contiguous() for k, v in hf_model.state_dict().items()}
+    os.makedirs(path, exist_ok=True)
+    hf_model.config.save_pretrained(path)
+    names = sorted(sd)
+    weight_map = {}
+    for s in range(n_shards):
+        part = {n: sd[n] for n in names[s::n_shards]}
+        fname = f"model-{s + 1:05d}-of-{n_shards:05d}.safetensors"
+        save_file(part, os.path.join(path, fname))
+        for n in part:
+            weight_map[n] = fname
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {}, "weight_map": weight_map}, f)
+
+
+def test_streamed_matches_materialised(tmp_path):
+    """Tensor-for-tensor: streaming the shards reproduces exactly what
+    the materialising converter builds from the same checkpoint."""
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(_tiny_llama_cfg()).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=3)
+
+    cfg = config_from_hf(hf_model.config, dtype=jnp.float32,
+                         param_dtype=jnp.float32)
+    ref = params_from_hf_state_dict(hf_model.state_dict(), cfg)
+
+    files = resolve_checkpoint_files(path)
+    assert files is not None and len(files) == 3
+    got = stream_params(files, cfg, param_dtype=jnp.float32)
+
+    ref_flat = jax.tree_util.tree_flatten_with_path(ref)[0]
+    got_flat = jax.tree_util.tree_flatten_with_path(got)[0]
+    assert [k for k, _ in ref_flat] == [k for k, _ in got_flat]
+    for (k, a), (_, b) in zip(ref_flat, got_flat):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=str(k))
+
+
+def test_streamed_single_file_and_tied(tmp_path):
+    """Single-file checkpoints and tied embeddings (no lm_head tensor on
+    disk) both stream."""
+    torch.manual_seed(1)
+    hf_model = transformers.LlamaForCausalLM(
+        _tiny_llama_cfg(tie_word_embeddings=True)).eval()
+    from safetensors.torch import save_file
+    path = str(tmp_path / "ckpt")
+    os.makedirs(path)
+    hf_model.config.save_pretrained(path)
+    sd = {k: v.contiguous() for k, v in hf_model.state_dict().items()
+          if k != "lm_head.weight"}
+    save_file(sd, os.path.join(path, "model.safetensors"))
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    assert cfg.tie_embeddings and "lm_head" not in params
+
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_streamed_tied_with_dealiased_head(tmp_path):
+    """Some exporters write a DE-ALIASED lm_head copy even for tied
+    models (safetensors refuses aliased tensors): it must stream as a
+    discard, exactly like the materialising path ignores it."""
+    torch.manual_seed(4)
+    hf_model = transformers.LlamaForCausalLM(
+        _tiny_llama_cfg(tie_word_embeddings=True)).eval()
+    path = str(tmp_path / "ckpt")
+    os.makedirs(path)
+    hf_model.config.save_pretrained(path)
+    from safetensors.torch import save_file
+    sd = {k: v.contiguous() for k, v in hf_model.state_dict().items()}
+    sd["lm_head.weight"] = hf_model.model.embed_tokens.weight.detach().clone()
+    save_file(sd, os.path.join(path, "model.safetensors"))
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    assert cfg.tie_embeddings and "lm_head" not in params
+    # header validation accepts the same checkpoint abstractly
+    validate_checkpoint_header({k: tuple(v.shape) for k, v in sd.items()},
+                               cfg)
+
+
+def test_streamed_bf16_checkpoint(tmp_path):
+    """bf16 shards (what real llama3 releases ship) stream without the
+    f32 upcast round-trip: values land bit-identical to the checkpoint."""
+    torch.manual_seed(2)
+    hf_model = transformers.LlamaForCausalLM(_tiny_llama_cfg()).to(
+        torch.bfloat16)
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg = config_from_hf(hf_model.config, param_dtype=jnp.bfloat16)
+    got = stream_params(resolve_checkpoint_files(path), cfg,
+                        param_dtype=jnp.bfloat16)
+    want = hf_model.model.embed_tokens.weight.detach().view(
+        torch.uint16).numpy()
+    np.testing.assert_array_equal(
+        np.asarray(got["embed_tokens"]["embedding"]).view(np.uint16), want)
+
+
+def test_streamed_into_fsdp_shardings(tmp_path, devices):
+    """accelerate(checkpoint_path) streams into the live FSDP shardings:
+    params come back already sharded over the mesh and the model trains."""
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    torch.manual_seed(3)
+    hf_model = transformers.LlamaForCausalLM(_tiny_llama_cfg()).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg = ta.Config(dist=ta.DistConfig(
+        fsdp=ta.FSDPConfig(size=8, min_weight_size=0)))
+    cfg.compute.dtype = "float32"
+    cfg.compute.param_dtype = "float32"
+    trainer, _ = accelerate(path, None, cfg, optimizer=optax.adam(1e-3))
+
+    # weights must match the checkpoint (spot-check embed) AND be sharded
+    emb = trainer.state.params["embed_tokens"]["embedding"]
+    np.testing.assert_allclose(
+        np.asarray(emb),
+        hf_model.model.embed_tokens.weight.detach().float().numpy(),
+        atol=1e-6)
+    sharded = [x for x in jax.tree.leaves(trainer.state.params)
+               if "fsdp" in str(x.sharding.spec)]
+    assert sharded, "no parameter landed sharded over fsdp"
+
+    batch = {"input_ids": jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, size=(8, 32)), jnp.int32)}
+    assert np.isfinite(float(trainer.step(batch)["loss"]))
+
+
+def test_header_validation_catches_mismatch():
+    cfg = config_from_hf(_tiny_llama_cfg())
+    plan = ingestion_plan(cfg)
+    shapes = {n: e.hf_shape for n, e in plan.items()}
+    validate_checkpoint_header(shapes, cfg)  # clean header passes
+
+    bad = dict(shapes)
+    bad["layers.0.self_attn.q_proj.weight"] = (7, 7)
+    with pytest.raises(ValueError, match="shape"):
+        validate_checkpoint_header(bad, cfg)
+    with pytest.raises(KeyError, match="unmappable"):
+        validate_checkpoint_header({**shapes, "visual.patch_embed": (3, 3)},
+                                   cfg)
+    del shapes["layers.1.mlp.up_proj.weight"]
+    with pytest.raises(ValueError, match="missing"):
+        validate_checkpoint_header(shapes, cfg)
+
+
+@pytest.mark.slow
+def test_streamed_peak_rss_bounded(tmp_path):
+    """THE point of streaming: peak host RSS while ingesting stays at
+    resident-params + a transient bounded by a couple of stacked leaves
+    — NOT the 2-3x full-model overhead of the materialising path (torch
+    module + stacked numpy copies).  ~360 MB synthetic checkpoint keeps
+    the signal far above allocator noise; measured in a subprocess so
+    ru_maxrss is this load's peak and nothing else's."""
+    from safetensors.numpy import save_file
+
+    hf_cfg = _tiny_llama_cfg(
+        vocab_size=4096, hidden_size=1024, intermediate_size=3072,
+        num_hidden_layers=6, num_attention_heads=8, num_key_value_heads=8)
+    mc = config_from_hf(hf_cfg, param_dtype=jnp.float32)
+    plan = ingestion_plan(mc)
+    path = str(tmp_path / "big")
+    os.makedirs(path)
+    hf_cfg.save_pretrained(path)
+    rng = np.random.default_rng(0)
+    names = sorted(plan)
+    n_shards, weight_map = 3, {}
+    for s in range(n_shards):
+        part = {f"model.{n}": rng.standard_normal(
+                    plan[n].hf_shape).astype(np.float32) * 0.02
+                for n in names[s::n_shards]}
+        fname = f"model-{s + 1:05d}-of-{n_shards:05d}.safetensors"
+        save_file(part, os.path.join(path, fname))
+        for n in part:
+            weight_map[n] = fname
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {}, "weight_map": weight_map}, f)
+
+    child = textwrap.dedent(f"""
+        import ctypes, json, os, sys
+        # fix glibc's dynamic mmap threshold at 1 MB so every large
+        # buffer is mmap'd and returned to the OS on free — otherwise
+        # arena retention adds a nondeterministic hundreds-of-MB floor
+        # that has nothing to do with what the loader keeps alive
+        try:
+            ctypes.CDLL("libc.so.6").mallopt(-3, 1 << 20)
+        except Exception:
+            pass
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        from torchacc_tpu.models.hf import config_from_hf
+        from torchacc_tpu.models.hf_stream import (
+            resolve_checkpoint_files, stream_params)
+        import transformers
+        def _status(key):
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith(key):
+                        return int(line.split()[1]) * 1024
+        rss = lambda: _status("VmRSS")
+        # NOT getrusage ru_maxrss: on linux it survives execve, so a
+        # subprocess inherits the pytest parent's high-water mark.
+        # VmHWM belongs to this process's own mm and resets on exec.
+        hwm = lambda: _status("VmHWM")
+        jnp.ones((8, 8)).sum().item()  # backend warm before baseline
+        hf_cfg = transformers.AutoConfig.from_pretrained({path!r})
+        cfg = config_from_hf(hf_cfg, param_dtype=jnp.float32)
+        baseline = rss()
+        params = stream_params(resolve_checkpoint_files({path!r}), cfg,
+                               param_dtype=jnp.float32)
+        jax.block_until_ready(params)
+        final = rss()
+        peak = hwm()
+        pbytes = sum(x.size * x.dtype.itemsize
+                     for x in jax.tree.leaves(params))
+        print(json.dumps({{"baseline": baseline, "final": final,
+                           "peak": peak, "params_bytes": pbytes}}))
+    """)
+    r = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                       text=True, timeout=420,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-2000:]
+    m = json.loads(r.stdout.strip().splitlines()[-1])
+    pb = m["params_bytes"]
+    assert pb > 250e6  # the checkpoint is big enough to measure
+    load_overhead = m["peak"] - m["baseline"]
+    transient = m["peak"] - m["final"]
+    # materialising path: torch state dict + stacked numpy copies =
+    # >= 2x params on top of the resident arrays.  Streaming: resident
+    # params + a transient bounded by ~2 stacked leaves + jit machinery.
+    assert load_overhead < 1.5 * pb, (load_overhead, pb, m)
+    assert transient < 0.6 * pb, (transient, pb, m)
+
+
+def test_llama3_70b_abstract_ingestion_dryrun(devices):
+    """The 70B-scale leg (BASELINE.json config 3) WITHOUT 140 GB of
+    weights: HF's own meta-device module provides the checkpoint header
+    (independent source of truth for every tensor name+shape), the plan
+    validates it, and the FSDP+TP trainer's resolved shardings cover
+    every stacked leaf at the real [80, ...] geometry."""
+    from accelerate import init_empty_weights
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models.hf_stream import _tree_get
+    from torchacc_tpu.train import accelerate as ta_accelerate
+    from torchacc_tpu.train.accelerate import apply_config_to_model
+    from torchacc_tpu.train.trainer import Trainer
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_hidden_layers=80, num_attention_heads=64,
+        num_key_value_heads=8, max_position_embeddings=8192,
+        rope_theta=500000.0, rms_norm_eps=1e-5, tie_word_embeddings=False)
+    with init_empty_weights():
+        meta = transformers.AutoModelForCausalLM.from_config(hf_cfg)
+    shapes = {k: tuple(v.shape) for k, v in meta.state_dict().items()}
+
+    mc = config_from_hf(hf_cfg, dtype=jnp.bfloat16,
+                        param_dtype=jnp.bfloat16)
+    validate_checkpoint_header(shapes, mc)
+
+    cfg = ta.Config(dist=ta.DistConfig(
+        fsdp=ta.FSDPConfig(size=4, min_weight_size=0),
+        tp=ta.TPConfig(size=2)))
+    model = TransformerLM(apply_config_to_model(mc, cfg))
+    import optax
+    trainer = Trainer(model, cfg, optimizer=optax.adamw(1e-4))
+    trainer.resolve_shardings()  # abstract only: nothing materialises
+    sh = trainer.state_shardings.params
+
+    plan = ingestion_plan(mc)
+    total = 0
+    for name, ent in plan.items():
+        leaf_sh = _tree_get(sh, ent.path)  # every plan path must resolve
+        assert leaf_sh is not None, name
+        total += int(np.prod(ent.hf_shape))
+    assert total == 70_553_706_496  # llama-3-70b exact param count

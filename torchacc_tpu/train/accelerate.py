@@ -12,6 +12,7 @@ is the model's ``attention_impl`` and the ops dispatch layer.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Iterable, Optional, Tuple
 
 import jax.numpy as jnp
@@ -85,18 +86,53 @@ def accelerate(
         None if config.compute.matmul_precision == "default"
         else config.compute.matmul_precision)
     hf_params = None
+    stream_files = None
+    if isinstance(model, str):
+        # safetensors checkpoints stream tensor-by-tensor into the
+        # target shardings (bounded host memory — the 70B-scale path;
+        # reference capability: LOW_CPU_MEM_USAGE deferred init,
+        # accelerate.py:13-17,114-119).  Only the config is read here;
+        # weights stream AFTER the trainer resolves shardings.
+        from torchacc_tpu.models.hf_stream import resolve_checkpoint_files
+        stream_files = resolve_checkpoint_files(model)
+        if stream_files is None and not os.path.isdir(model):
+            from torchacc_tpu.utils.logger import logger
+            logger.warning(
+                f"{model!r} is not a local directory — falling back to "
+                f"the materialising from_pretrained load (full model in "
+                f"host RAM).  For bounded-memory streamed ingestion, "
+                f"download the snapshot and pass its local path.")
+        if stream_files is not None:
+            import transformers
+
+            from torchacc_tpu.models.hf import config_from_hf
+            mc = config_from_hf(
+                transformers.AutoConfig.from_pretrained(model),
+                dtype=_DTYPES[config.compute.dtype],
+                param_dtype=_DTYPES[config.compute.param_dtype])
+            model = mc
     if isinstance(model, str) or hasattr(model, "state_dict"):
-        # HF torch model or checkpoint path: convert, then fold the
-        # framework config in exactly like the zoo path
+        # HF torch model (or a .bin-only checkpoint path): materialising
+        # conversion, then fold the framework config in like the zoo path
         from torchacc_tpu.models.hf import load_hf_model
         mc, hf_params = load_hf_model(
             model, dtype=_DTYPES[config.compute.dtype],
             param_dtype=_DTYPES[config.compute.param_dtype])
         model = mc
     if isinstance(model, ModelConfig):
+        mc = model
         model = TransformerLM(apply_config_to_model(model, config))
     trainer = Trainer(model, config, optimizer=optimizer, **trainer_kwargs)
-    if hf_params is not None:
+    if stream_files is not None:
+        from torchacc_tpu.models.hf_stream import stream_params
+        trainer.resolve_shardings()
+        with jax.sharding.set_mesh(trainer.mesh):
+            params = stream_params(
+                stream_files, mc,
+                shardings=trainer.state_shardings.params,
+                param_dtype=_DTYPES[config.compute.param_dtype])
+        trainer.init_from_params(params)
+    elif hf_params is not None:
         trainer.init_from_params(hf_params)
     loader = None
     if dataloader is not None:

@@ -161,7 +161,11 @@ class Trainer:
         weights): params land directly in their shards, optimizer state
         initialises sharded, step starts at 0.  Replaces the manual
         resolve_shardings + device_put + TrainState dance."""
-        self.resolve_shardings()
+        if self.state_shardings is None:
+            # the streamed-ingestion path resolves shardings up front
+            # (to place weights as they arrive) — don't repeat the full
+            # abstract-init trace of an 80-layer state tree here
+            self.resolve_shardings()
         sh = self.state_shardings
         params = jax.device_put(params, sh.params)
         use_scaler = self.config.compute.dtype == "float16"
