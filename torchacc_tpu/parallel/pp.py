@@ -48,6 +48,29 @@ def _boundary_needs_f32(dtype) -> bool:
     return dtype != jnp.float32 and not on_tpu()
 
 
+def _per_slot_blocks(apply_block, per_stage, unroll_stage):
+    """Heterogeneous-layer support (gemma2/3 layer_pattern): the block
+    applier may be a SEQUENCE of per-slot callables — slot j of every
+    stage chunk applies blocks[j], so a pattern whose period divides the
+    chunk length runs its per-layer static configs (window, rope base)
+    inside each stage.  Returns the tuple, or None for the uniform case.
+
+    Requires the unrolled stage body: lax.scan cannot vary a static
+    config across iterations (the same reason the non-pp pattern path is
+    a python loop, models/transformer.py)."""
+    if not isinstance(apply_block, (list, tuple)):
+        return None
+    if not unroll_stage:
+        raise ValueError(
+            "a per-slot apply_block sequence requires unroll_stage=True "
+            "(scan cannot vary static per-layer configs)")
+    if len(apply_block) != per_stage:
+        raise ValueError(
+            f"apply_block sequence length {len(apply_block)} != layers "
+            f"per stage chunk {per_stage}")
+    return tuple(apply_block)
+
+
 def pipeline_blocks(
     apply_block: Callable[[Any, Tuple], Tuple],
     stacked_params: Any,
@@ -109,6 +132,7 @@ def pipeline_blocks(
         raise ValueError(f"num_layers {L} not divisible by pp size "
                          f"{pp_size} x virtual_stages {V}")
     per_stage = L // (pp_size * V)
+    blocks = _per_slot_blocks(apply_block, per_stage, unroll_stage)
     M, Pn = num_micro, pp_size
     mb = B // M
     # schedule regime (docstring): M-periodic with a device-0 wait queue
@@ -149,26 +173,29 @@ def pipeline_blocks(
         T = (V - 1) * period + Pn - 1 + M
 
         def stage(chunk_params, carry):
-            def one(c, p):
-                if aux_from_block:
-                    return apply_block(p, c)
-                return apply_block(p, c), jnp.zeros((), jnp.float32)
-            body = (jax.checkpoint(one, policy=remat_policy)
-                    if remat else one)
+            def mk(fn):
+                def one(c, p):
+                    if aux_from_block:
+                        return fn(p, c)
+                    return fn(p, c), jnp.zeros((), jnp.float32)
+                return (jax.checkpoint(one, policy=remat_policy)
+                        if remat else one)
             if unroll_stage:
                 # unrolled layer application (scan_layers=False): static
                 # per-layer slices keep each layer's policy-saved
                 # residuals as separate buffers — no [L/P, ...] DUS
                 # stacking in the stage's autodiff (docs/PERF.md, the
-                # scan-stacking tax)
+                # scan-stacking tax).  Per-slot fns (layer_pattern)
+                # apply each slot's own static block here.
                 aux_total = jnp.zeros((), jnp.float32)
                 for j in range(per_stage):
+                    body = mk(apply_block if blocks is None else blocks[j])
                     carry, aux = body(
                         carry,
                         jax.tree.map(lambda a, j=j: a[j], chunk_params))
                     aux_total = aux_total + aux
                 return carry, aux_total
-            carry, auxs = jax.lax.scan(body, carry, chunk_params)
+            carry, auxs = jax.lax.scan(mk(apply_block), carry, chunk_params)
             return carry, jnp.sum(auxs)
 
         # Feed micro-batches as scan xs (padded with T-M dead ticks) and
@@ -380,6 +407,7 @@ def pipeline_train_1f1b(
             f"divisible by pp size ({pp_size}) — the Megatron group "
             "schedule runs micro groups of P through the V chunks")
     per_stage = L // (pp_size * V)
+    blocks = _per_slot_blocks(apply_block, per_stage, unroll_stage)
     M, Pn = num_micro, pp_size
     mb = B // M
     VP = V * Pn
@@ -489,23 +517,31 @@ def pipeline_train_1f1b(
                 lambda a: jax.lax.dynamic_index_in_dim(
                     a, c_idx, 0, keepdims=False), tree)
 
-        def call_block(pl, c, xl):
-            out = (apply_block(pl, c, xl) if layer_xs is not None
-                   else apply_block(pl, c))
-            if aux_from_block:
-                return out
-            return out, jnp.zeros((), jnp.float32)
+        def mk_one(fn):
+            def one(c, pxs):
+                pl, xl = pxs
+                out = (fn(pl, c, xl) if layer_xs is not None
+                       else fn(pl, c))
+                if aux_from_block:
+                    return out
+                return out, jnp.zeros((), jnp.float32)
+            return one
 
-        def one(c, pxs):
-            pl, xl = pxs
-            return call_block(pl, c, xl)
+        # scan path only (unreachable with per-slot blocks: they force
+        # unroll_stage) — None rather than a blocks[0] fallback, so any
+        # future misuse fails loudly instead of applying slot 0's
+        # static config to every layer
+        one = mk_one(apply_block) if blocks is None else None
 
-        def _stage_unrolled(body, p, xs_c, carry):
+        def _stage_unrolled(wrap, p, xs_c, carry):
             # unrolled layer application (scan_layers=False): static
             # slices keep per-layer saved residuals as separate buffers
-            # (no [L/P, ...] DUS stacking — docs/PERF.md)
+            # (no [L/P, ...] DUS stacking — docs/PERF.md); per-slot fns
+            # (layer_pattern) pick slot j's static block
             aux_total = jnp.zeros((), jnp.float32)
             for j in range(per_stage):
+                body = wrap(mk_one(apply_block if blocks is None
+                                   else blocks[j]))
                 pj = jax.tree.map(lambda a, j=j: a[j], p)
                 xj = jax.tree.map(lambda a, j=j: a[j], xs_c)
                 carry, aux = body(carry, (pj, xj))
@@ -514,7 +550,7 @@ def pipeline_train_1f1b(
 
         def stage(p, xs_c, carry):
             if unroll_stage:
-                return _stage_unrolled(one, p, xs_c, carry)
+                return _stage_unrolled(lambda f: f, p, xs_c, carry)
             carry, auxs = jax.lax.scan(one, carry, (p, xs_c))
             return carry, jnp.sum(auxs)
 
@@ -523,11 +559,11 @@ def pipeline_train_1f1b(
             # are the small inter-layer carries, not every layer's
             # attention internals stacked [L/P, ...] at once (that stack
             # is what would erase 1F1B's memory win)
-            body = jax.checkpoint(one, policy=remat_policy,
-                                  prevent_cse=False)
+            ck = lambda f: jax.checkpoint(f, policy=remat_policy,
+                                          prevent_cse=False)
             if unroll_stage:
-                return _stage_unrolled(body, p, xs_c, carry)
-            carry, auxs = jax.lax.scan(body, carry, (p, xs_c))
+                return _stage_unrolled(ck, p, xs_c, carry)
+            carry, auxs = jax.lax.scan(ck(one), carry, (p, xs_c))
             return carry, jnp.sum(auxs)
 
         micro_stack = tuple(micro_local)        # each [M, mb, ...]
