@@ -293,3 +293,63 @@ def test_accelerate_hf_model_one_call(devices):
     loss = float(trainer.step({"input_ids": jnp.asarray(ids, jnp.int32)})
                  ["loss"])
     assert np.isfinite(loss)
+
+
+def _tiny_mixtral(**kw):
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, sliding_window=None,
+        tie_word_embeddings=False, attn_implementation="eager")
+    base.update(kw)
+    return transformers.MixtralConfig(**base)
+
+
+def test_mixtral_logits_match():
+    """Mixtral (VERDICT r4 next-4, BASELINE config 5): llama attention +
+    top-k sparse MoE.  HF's softmax-then-topk-then-renormalise routing
+    equals the zoo's topk-then-softmax exactly, and the dense dispatch
+    (no capacity, no drops) reproduces the sparse computation — logits
+    match to float32 rounding."""
+    torch.manual_seed(10)
+    hf_model = transformers.MixtralForCausalLM(_tiny_mixtral()).eval()
+    assert hf_model.config.model_type == "mixtral"
+    ids = np.random.default_rng(10).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=3e-4)
+
+
+def test_mixtral_ep_pp_trains(devices):
+    """Ingested Mixtral composes with EP x PP x DP: experts shard over
+    'ep' inside pipeline stages, router aux flows, losses match a
+    dp-only run of the same weights."""
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    torch.manual_seed(11)
+    hf_model = transformers.MixtralForCausalLM(
+        _tiny_mixtral(num_hidden_layers=2)).eval()
+    rng = np.random.default_rng(11)
+    batches = [{"input_ids": rng.integers(0, 128, size=(8, 32)).astype(np.int32)}
+               for _ in range(3)]
+
+    losses = {}
+    for name, dist in (
+        ("ep_pp", ta.DistConfig(pp=ta.PPConfig(size=2, num_micro_batches=2),
+                                ep=ta.EPConfig(size=2),
+                                dp=ta.DPConfig(size=2))),
+        ("dp", ta.DistConfig(dp=ta.DPConfig(size=8))),
+    ):
+        cfg = ta.Config(dist=dist)
+        cfg.compute.dtype = "float32"
+        cfg.compute.param_dtype = "float32"
+        trainer, _ = accelerate(hf_model, None, cfg,
+                                optimizer=optax.adam(1e-3))
+        if name == "ep_pp":
+            w = trainer.state.params["layers"]["block"]["moe"]
+            spec = str(w["experts/gate"].sharding.spec)
+            assert "ep" in spec and "pp" in spec, spec
+        losses[name] = [float(trainer.step(b)["loss"]) for b in batches]
+    np.testing.assert_allclose(losses["ep_pp"], losses["dp"], rtol=2e-4)

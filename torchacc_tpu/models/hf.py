@@ -9,9 +9,11 @@ framework feature (FSDP/TP/PP/CP shardings, Pallas kernels, remat,
 checkpointing) applies with zero model-specific code.
 
 Supported families: Llama (1/2/3), Qwen2 (qkv bias), Mistral (sliding
-window), Gemma v1 (1+w RMSNorm, geglu, scaled embeddings) — the
-reference's patched set (utils/patch.py:224-301) plus Gemma.  GPT-2
-uses the 'learned' position variant.
+window), Gemma v1 (1+w RMSNorm, geglu, scaled embeddings), Gemma2/3
+(layer patterns, sandwich norms, softcaps), Mixtral (top-k sparse MoE
+-> models/moe.py) — the reference's patched set (utils/patch.py:224-301)
+plus the Gemma and Mixtral families.  GPT-2 uses the 'learned' position
+variant.
 """
 
 from __future__ import annotations
@@ -84,6 +86,16 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             # reset to 1 in pattern_cfg) — real gemma3 >=4B checkpoints
             # ship factor 8
             kw["rope_scale"] = float(rs["factor"])
+    if mt == "mixtral":
+        # Mixtral 8x7B/8x22B: llama attention + top-k sparse MoE MLP.
+        # HF routes softmax-then-topk-then-renormalise, which equals the
+        # zoo's topk-then-softmax exactly (softmax is monotonic, and
+        # renormalising the selected probs reproduces softmax over the
+        # selected logits) — so logits match with dense dispatch.
+        kw.update(
+            num_experts=int(get("num_local_experts")),
+            num_experts_per_tok=int(get("num_experts_per_tok", 2)),
+            router_aux_weight=float(get("router_aux_loss_coef", 0.01)))
     if get("final_logit_softcapping"):
         kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):
@@ -173,17 +185,39 @@ def params_from_hf_state_dict(
 
     block = {
         "attn": attn,
-        "mlp": {
+        "ln1": {"scale": stack("layers.{i}.input_layernorm.weight",
+                               lambda w: w)},
+    }
+    if cfg.num_experts > 0:
+        # Mixtral block_sparse_moe -> MoEMlp: gate.weight is the router
+        # ([e, h] -> [h, e] kernel); experts j carry w1 (gate), w3 (up),
+        # w2 (down), stacked [L, e, ...] to the zoo's expert-major layout
+        E = cfg.num_experts
+
+        def experts_stack(wn):
+            return np.stack([
+                np.stack([
+                    get(f"layers.{i}.block_sparse_moe.experts.{j}."
+                        f"{wn}.weight").T
+                    for j in range(E)]) for i in range(L)])
+
+        block["moe"] = {
+            "router": {"kernel": stack(
+                "layers.{i}.block_sparse_moe.gate.weight",
+                lambda w: w.T)},
+            "experts/gate": experts_stack("w1"),
+            "experts/up": experts_stack("w3"),
+            "experts/down": experts_stack("w2"),
+        }
+    else:
+        block["mlp"] = {
             "gate_proj": {"kernel": stack(
                 "layers.{i}.mlp.gate_proj.weight", lambda w: w.T)},
             "up_proj": {"kernel": stack(
                 "layers.{i}.mlp.up_proj.weight", lambda w: w.T)},
             "down_proj": {"kernel": stack(
                 "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
-        },
-        "ln1": {"scale": stack("layers.{i}.input_layernorm.weight",
-                               lambda w: w)},
-    }
+        }
     if cfg.sandwich_norms:
         # gemma2 norm naming: post_attention_layernorm is the POST-attn
         # sandwich norm; the pre-mlp norm is pre_feedforward_layernorm

@@ -52,14 +52,16 @@ _IGNORE = re.compile(
 
 class PlanEntry(NamedTuple):
     path: Tuple[str, ...]          # pytree path in TransformerLM params
-    layer: Optional[int]           # index into the scan-stacked dim
+    idx: Optional[Tuple[int, ...]]  # position in the leaf's leading dims
+    lead: Tuple[int, ...]          # leading dims: (L,) stacked layers,
+    #                                (L, E) stacked experts, () whole
     hf_shape: Tuple[int, ...]      # expected shape IN THE CHECKPOINT
     transform: Callable[[np.ndarray], np.ndarray]
 
 
 def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
     """HF tensor name (without the ``model.`` prefix) -> PlanEntry for
-    the llama/qwen2/mistral/gemma families (same mapping as
+    the llama/qwen2/mistral/gemma/mixtral families (same mapping as
     hf.params_from_hf_state_dict, expressed per-tensor so it can run
     shard-by-shard and be checked against a header without data)."""
     h, L = cfg.hidden_size, cfg.num_layers
@@ -71,8 +73,12 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
 
     plan: Dict[str, PlanEntry] = {}
 
-    def add(name, path, layer, shape, tr):
-        plan[name] = PlanEntry(tuple(path), layer, tuple(shape), tr)
+    def add(name, path, layer, shape, tr, lead=None):
+        idx = ((layer,) if isinstance(layer, int) else layer)
+        if lead is None:
+            lead = () if idx is None else (L,)
+        plan[name] = PlanEntry(tuple(path), idx, tuple(lead),
+                               tuple(shape), tr)
 
     add("embed_tokens.weight", ("embed_tokens", "embedding"), None,
         (v, h), lambda w: w)
@@ -110,13 +116,31 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
                 (d,), lambda w: w)
             add(p + "self_attn.k_norm.weight", a + ("k_norm", "scale"), i,
                 (d,), lambda w: w)
-        m = ("layers", "block", "mlp")
-        add(p + "mlp.gate_proj.weight", m + ("gate_proj", "kernel"), i,
-            (inter, h), lambda w: np.ascontiguousarray(w.T))
-        add(p + "mlp.up_proj.weight", m + ("up_proj", "kernel"), i,
-            (inter, h), lambda w: np.ascontiguousarray(w.T))
-        add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
-            (h, inter), lambda w: np.ascontiguousarray(w.T))
+        if cfg.num_experts > 0:
+            # Mixtral sparse-MoE block: router + per-(layer, expert)
+            # FFN weights land in the [L, E, ...] stacked expert leaves
+            E = cfg.num_experts
+            moe = ("layers", "block", "moe")
+            add(p + "block_sparse_moe.gate.weight",
+                moe + ("router", "kernel"), i, (E, h),
+                lambda w: np.ascontiguousarray(w.T))
+            for j in range(E):
+                q = p + f"block_sparse_moe.experts.{j}."
+                tT = lambda w: np.ascontiguousarray(w.T)
+                add(q + "w1.weight", moe + ("experts/gate",), (i, j),
+                    (inter, h), tT, lead=(L, E))
+                add(q + "w3.weight", moe + ("experts/up",), (i, j),
+                    (inter, h), tT, lead=(L, E))
+                add(q + "w2.weight", moe + ("experts/down",), (i, j),
+                    (h, inter), tT, lead=(L, E))
+        else:
+            m = ("layers", "block", "mlp")
+            add(p + "mlp.gate_proj.weight", m + ("gate_proj", "kernel"), i,
+                (inter, h), lambda w: np.ascontiguousarray(w.T))
+            add(p + "mlp.up_proj.weight", m + ("up_proj", "kernel"), i,
+                (inter, h), lambda w: np.ascontiguousarray(w.T))
+            add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
+                (h, inter), lambda w: np.ascontiguousarray(w.T))
         b = ("layers", "block")
         add(p + "input_layernorm.weight", b + ("ln1", "scale"), i, (h,),
             lambda w: w)
@@ -209,7 +233,6 @@ def stream_params(
 
     param_dtype = param_dtype or cfg.param_dtype
     plan = ingestion_plan(cfg)
-    L = cfg.num_layers
 
     params: Dict[str, Any] = {}
     filled: Dict[Tuple[str, ...], np.ndarray] = {}  # stacked-leaf masks
@@ -234,20 +257,21 @@ def stream_params(
 
     def setter_for(path, sh):
         if path not in setters:
-            def _set(buf, layer, i):
-                return buf.at[i].set(layer.astype(buf.dtype))
+            def _set(buf, piece, *idx):
+                return buf.at[idx].set(piece.astype(buf.dtype))
             kw = {} if sh is None else {"out_shardings": sh}
             setters[path] = jax.jit(_set, donate_argnums=0, **kw)
         return setters[path]
 
-    def piece_sharding(sh):
-        # a single layer's slice of a stacked leaf: same placement with
-        # the leading (layer) dim dropped, so the host->device transfer
-        # of each arriving layer is already per-shard
+    def piece_sharding(sh, n_lead):
+        # a single piece of a stacked leaf: same placement with the
+        # leading (layer / layer,expert) dims dropped, so the
+        # host->device transfer of each arriving piece is already
+        # per-shard
         if sh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec
-        return NamedSharding(sh.mesh, PartitionSpec(*sh.spec[1:]))
+        return NamedSharding(sh.mesh, PartitionSpec(*sh.spec[n_lead:]))
 
     for fpath in files:
         # framework="pt": numpy framework cannot decode bf16 shards;
@@ -277,7 +301,7 @@ def stream_params(
                 arr = ent.transform(arr)
                 del t
                 sh = leaf_sharding(ent.path)
-                if ent.layer is None:
+                if ent.idx is None:
                     _tree_set(params, ent.path, place(arr, sh))
                     continue
                 buf = None
@@ -286,16 +310,16 @@ def stream_params(
                 except KeyError:
                     pass
                 if buf is None:
-                    shape = (L,) + arr.shape
+                    shape = ent.lead + arr.shape
                     mk = jax.jit(
                         lambda: jnp.zeros(shape, param_dtype),
                         **({} if sh is None else {"out_shardings": sh}))
                     buf = mk()
-                    filled[ent.path] = np.zeros(L, bool)
+                    filled[ent.path] = np.zeros(ent.lead, bool)
                 st = setter_for(ent.path, sh)
-                layer = place(arr, piece_sharding(sh))
-                buf = st(buf, layer, jnp.int32(ent.layer))
-                filled[ent.path][ent.layer] = True
+                piece = place(arr, piece_sharding(sh, len(ent.lead)))
+                buf = st(buf, piece, *(jnp.int32(i) for i in ent.idx))
+                filled[ent.path][ent.idx] = True
                 _tree_set(params, ent.path, buf)
                 # per-tensor trim: the torch copy + transform buffer +
                 # donated-out leaf all freed this iteration; without a
@@ -318,8 +342,8 @@ def stream_params(
     for path, mask in filled.items():
         if not mask.all():
             raise ValueError(
-                f"leaf {'/'.join(path)}: layers "
-                f"{np.nonzero(~mask)[0].tolist()} never arrived")
+                f"leaf {'/'.join(path)}: positions "
+                f"{np.argwhere(~mask).tolist()[:8]} never arrived")
     return params
 
 
