@@ -112,6 +112,68 @@ def converted_curve(hf_model, ids, steps, lr, heldout, optimizer="sgd",
     return losses, sum(ev) / len(ev)
 
 
+def generation_parity(hf_model, prompts, gen_tokens):
+    """Generation-quality leg (reference: the accuracy benchmark scores
+    the TUNED model with MT-bench via FastChat,
+    benchmarks/accuracy/README.md:103-105 — needs serving infra; the
+    self-contained analogue is greedy-decode agreement): the TUNED torch
+    model is converted through models/hf.py and both sides greedy-decode
+    the same prompts in f32.  Identical weights, so a mismatch means the
+    conversion or KV-cache decode stack changed the model — training
+    drift is gated separately by the curve and heldout legs, which keeps
+    this leg deterministic (token-for-token) in CI.
+
+    Returns (token_match_frac, logprob_dev): exact-agreement fraction
+    over generated positions, and — teacher-forcing the torch
+    continuation through both models — the max abs deviation of the
+    next-token log-probs at torch's chosen tokens (the tight
+    logit-divergence diagnostic)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import torch
+
+    from torchacc_tpu.models.generate import generate
+    from torchacc_tpu.models.hf import load_hf_model
+    from torchacc_tpu.models.transformer import TransformerLM
+
+    b, p = prompts.shape
+    model = hf_model.eval()
+    with torch.no_grad():
+        # eos_token_id=None on the torch side + no eos_id on the jax
+        # side: SYMMETRIC no-early-stop greedy decode.  (min_new_tokens
+        # would instead suppress the eos LOGIT on the torch side only —
+        # an asymmetry that flips tokens when the tuned argmax is eos.)
+        t_out = model.generate(
+            torch.from_numpy(prompts), max_new_tokens=gen_tokens,
+            do_sample=False, eos_token_id=None, pad_token_id=0)
+    t_toks = t_out.numpy()                       # [b, p + G]
+
+    mc, params = load_hf_model(model, dtype=jnp.float32,
+                               param_dtype=jnp.float32)
+    eval_model = TransformerLM(mc)
+    ours = np.asarray(generate(eval_model, params,
+                               jnp.asarray(prompts, jnp.int32),
+                               max_new_tokens=gen_tokens))
+    match = float((ours[:, p:] == t_toks[:, p:]).mean())
+
+    # teacher-forced log-prob deviation on the torch continuation
+    with torch.no_grad():
+        t_logits = model(torch.from_numpy(t_toks)).logits.float().numpy()
+    j_logits = np.asarray(eval_model.apply(
+        {"params": params}, jnp.asarray(t_toks, jnp.int32)), np.float32)
+
+    def logprob_at_next(logits):
+        m = logits.max(axis=-1, keepdims=True)
+        lp = logits - (m + np.log(np.exp(logits - m).sum(-1,
+                                                         keepdims=True)))
+        nxt = t_toks[:, 1:]
+        return np.take_along_axis(lp[:, :-1], nxt[..., None], -1)[..., 0]
+
+    lp_dev = float(np.max(np.abs(logprob_at_next(t_logits)[:, p - 1:]
+                                 - logprob_at_next(j_logits)[:, p - 1:])))
+    return match, lp_dev
+
+
 def _build_hf(family: str, seq: int, hidden: int = 64, layers: int = 2,
               vocab: int = 256):
     import torch
@@ -169,6 +231,19 @@ def main(argv=None) -> int:
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--vocab", type=int, default=256)
+    ap.add_argument("--gen-tokens", type=int, default=24,
+                    help="greedy-decode length for the generation-"
+                         "quality leg (0 disables)")
+    ap.add_argument("--gen-tol", type=float, default=2e-3,
+                    help="accept a greedy-token mismatch iff the max "
+                         "next-token log-prob deviation (teacher-forced "
+                         "on the torch continuation) stays under this "
+                         "bound.  Identical converted weights measure "
+                         "~0 here, but a short-SFT model's near-flat "
+                         "distribution has exact argmax ties that f32 "
+                         "conversion rounding (~1e-7) can flip — token "
+                         "equality alone is not a deterministic gate.  "
+                         "0 = require token-for-token match.")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -198,6 +273,22 @@ def main(argv=None) -> int:
         hf_model, ids, args.steps, args.lr, heldout,
         optimizer=args.optimizer, dtype=args.dtype)
 
+    gen = None
+    if args.gen_tokens > 0:
+        # prompts drawn from the trained token distribution, never seen
+        prompts = heldout[0][:, :16].astype(np.int64)
+        if 16 + args.gen_tokens > args.seq:
+            raise SystemExit(
+                f"--gen-tokens {args.gen_tokens} + 16-token prompts "
+                f"exceeds --seq {args.seq} (the position range both "
+                f"models were configured for)")
+        match, lp_dev = generation_parity(hf_model, prompts,
+                                          args.gen_tokens)
+        gen_ok = bool(match == 1.0 or lp_dev <= args.gen_tol)
+        gen = {"token_match_frac": round(match, 4),
+               "next_logprob_max_dev": round(lp_dev, 5),
+               "gen_tokens": args.gen_tokens, "ok": gen_ok}
+
     devs = [abs(a - b) / max(abs(b), 1e-6) for a, b in zip(ours, theirs)]
     max_dev = max(devs)
     # gate the downstream leg on heldout LOSS deviation (the same scale
@@ -207,7 +298,8 @@ def main(argv=None) -> int:
     import math
     ppl_ours, ppl_torch = math.exp(ev_ours), math.exp(ev_torch)
     improved = ours[-1] < ours[0]
-    ok = bool(max_dev <= args.tol and ev_dev <= args.tol and improved)
+    ok = bool(max_dev <= args.tol and ev_dev <= args.tol and improved
+              and (gen is None or gen["ok"]))
     print(json.dumps({
         "metric": (f"accuracy_parity_{args.family}_{args.optimizer}"
                    f"_{args.dtype}_sft"),
@@ -223,6 +315,7 @@ def main(argv=None) -> int:
                     "loss_rel_dev": round(ev_dev, 5),
                     "ppl_torch": round(ppl_torch, 4),
                     "ppl_torchacc_tpu": round(ppl_ours, 4)},
+        "generation": gen,
         "steps": args.steps,
     }))
     return 0 if ok else 1
