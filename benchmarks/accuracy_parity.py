@@ -143,8 +143,13 @@ def generation_parity(hf_model, prompts, gen_tokens):
         # side: SYMMETRIC no-early-stop greedy decode.  (min_new_tokens
         # would instead suppress the eos LOGIT on the torch side only —
         # an asymmetry that flips tokens when the tuned argmax is eos.)
+        # explicit all-ones mask: with pad_token_id set and no mask, HF
+        # INFERS attention_mask = inputs.ne(pad) and would mask real
+        # 0-tokens mid-prompt — an asymmetry the jax side doesn't have
         t_out = model.generate(
-            torch.from_numpy(prompts), max_new_tokens=gen_tokens,
+            torch.from_numpy(prompts),
+            attention_mask=torch.ones_like(torch.from_numpy(prompts)),
+            max_new_tokens=gen_tokens,
             do_sample=False, eos_token_id=None, pad_token_id=0)
     t_toks = t_out.numpy()                       # [b, p + G]
 
@@ -245,6 +250,10 @@ def main(argv=None) -> int:
                          "equality alone is not a deterministic gate.  "
                          "0 = require token-for-token match.")
     args = ap.parse_args(argv)
+    if args.gen_tokens > 0 and 16 + args.gen_tokens > args.seq:
+        ap.error(f"--gen-tokens {args.gen_tokens} + 16-token prompts "
+                 f"exceeds --seq {args.seq} (the position range both "
+                 f"models are configured for)")
 
     import numpy as np
 
@@ -277,11 +286,6 @@ def main(argv=None) -> int:
     if args.gen_tokens > 0:
         # prompts drawn from the trained token distribution, never seen
         prompts = heldout[0][:, :16].astype(np.int64)
-        if 16 + args.gen_tokens > args.seq:
-            raise SystemExit(
-                f"--gen-tokens {args.gen_tokens} + 16-token prompts "
-                f"exceeds --seq {args.seq} (the position range both "
-                f"models were configured for)")
         match, lp_dev = generation_parity(hf_model, prompts,
                                           args.gen_tokens)
         gen_ok = bool(match == 1.0 or lp_dev <= args.gen_tol)
