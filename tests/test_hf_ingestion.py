@@ -353,3 +353,72 @@ def test_mixtral_ep_pp_trains(devices):
             assert "ep" in spec and "pp" in spec, spec
         losses[name] = [float(trainer.step(b)["loss"]) for b in batches]
     np.testing.assert_allclose(losses["ep_pp"], losses["dp"], rtol=2e-4)
+
+
+def test_qwen3_logits_match():
+    """Qwen3: llama layout + per-head-dim q/k RMSNorm before rope
+    (standard rmsnorm, unlike gemma3's 1+w variant) + explicit
+    head_dim, no qkv bias."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=64, rope_theta=10000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(12)
+    hf_model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "qwen3"
+    ids = np.random.default_rng(12).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_llama31_rope_scaling_logits_match():
+    """Llama-3.1's frequency-banded rope scaling (rope_type='llama3' —
+    shipped by every 3.1+ release): the converted model must reproduce
+    HF's banded inv_freq transform, not silently drop it."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=500000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        rms_norm_eps=1e-5, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(13)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.rope_llama3 == (8.0, 1.0, 4.0, 64.0)
+    # positions PAST the original context length, where the banding bites
+    ids = np.random.default_rng(13).integers(0, 128, size=(2, 96)).astype(np.int32)
+    _compare(hf_model, ids, atol=3e-4)
+
+
+def test_unsupported_rope_scaling_raises():
+    """yarn/dynamic rope scaling must fail loudly, not convert wrong."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=64,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0})
+    with pytest.raises(NotImplementedError, match="yarn"):
+        config_from_hf(hf_cfg)
+
+
+def test_olmo2_logits_match():
+    """OLMo2 (the modern revision of the reference's example-notebook
+    family, examples/train_olmo.ipynb): POST-norm residual placement
+    (x + norm(f(x)), no pre-norms) and RMSNorm over the FLAT q/k
+    projections."""
+    hf_cfg = transformers.Olmo2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(14)
+    hf_model = transformers.Olmo2ForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "olmo2"
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.norm_placement == "post" and cfg.qk_norm_proj
+    ids = np.random.default_rng(14).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)

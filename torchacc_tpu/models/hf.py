@@ -8,12 +8,13 @@ it shares torch's module system.  The TPU-native framework instead
 framework feature (FSDP/TP/PP/CP shardings, Pallas kernels, remat,
 checkpointing) applies with zero model-specific code.
 
-Supported families: Llama (1/2/3), Qwen2 (qkv bias), Mistral (sliding
-window), Gemma v1 (1+w RMSNorm, geglu, scaled embeddings), Gemma2/3
-(layer patterns, sandwich norms, softcaps), Mixtral (top-k sparse MoE
--> models/moe.py) — the reference's patched set (utils/patch.py:224-301)
-plus the Gemma and Mixtral families.  GPT-2 uses the 'learned' position
-variant.
+Supported families: Llama (1/2/3, incl. 3.1's banded rope scaling),
+Qwen2 (qkv bias), Qwen3 (qk-norm), Mistral (sliding window), Gemma v1
+(1+w RMSNorm, geglu, scaled embeddings), Gemma2/3 (layer patterns,
+sandwich norms, softcaps), Mixtral (top-k sparse MoE -> models/moe.py),
+OLMo2 (post-norm placement, flat-projection qk-norm) — the reference's
+patched set (utils/patch.py:224-301) plus the Qwen3/Gemma/Mixtral/OLMo2
+families.  GPT-2 uses the 'learned' position variant.
 """
 
 from __future__ import annotations
@@ -86,6 +87,18 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             # reset to 1 in pattern_cfg) — real gemma3 >=4B checkpoints
             # ship factor 8
             kw["rope_scale"] = float(rs["factor"])
+    if mt == "olmo2":
+        # OLMo2 (the modern revision of the reference's example-notebook
+        # family, examples/train_olmo.ipynb): llama MLP + POST-norm
+        # residual placement (x + norm(f(x)), no pre-norms) and RMSNorm
+        # over the FLAT q/k projections
+        kw.update(qk_norm=True, qk_norm_proj=True, norm_placement="post")
+    if mt == "qwen3":
+        # Qwen3: llama layout + per-head-dim RMSNorm on q/k before rope
+        # (same q_norm/k_norm tensors as gemma3, but with the standard
+        # RMSNorm — cfg.norm stays 'rmsnorm') and explicit head_dim; no
+        # qkv bias (unlike qwen2)
+        kw.update(qk_norm=True)
     if mt == "mixtral":
         # Mixtral 8x7B/8x22B: llama attention + top-k sparse MoE MLP.
         # HF routes softmax-then-topk-then-renormalise, which equals the
@@ -96,6 +109,27 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             num_experts=int(get("num_local_experts")),
             num_experts_per_tok=int(get("num_experts_per_tok", 2)),
             router_aux_weight=float(get("router_aux_loss_coef", 0.01)))
+    if mt not in ("gemma3", "gemma3_text"):
+        # generic rope_scaling (gemma3 parses its own above): 'linear'
+        # divides positions; 'llama3' is the Llama-3.1 frequency-banded
+        # transform every 3.1+ release ships.  Anything else
+        # (yarn/dynamic/longrope) fails LOUDLY — silently dropping the
+        # scaling would make long-context logits quietly wrong.
+        rs = get("rope_scaling")
+        if rs:
+            rt = rs.get("rope_type", rs.get("type", "default"))
+            if rt == "linear":
+                kw["rope_scale"] = float(rs["factor"])
+            elif rt == "llama3":
+                kw["rope_llama3"] = (
+                    float(rs["factor"]),
+                    float(rs["low_freq_factor"]),
+                    float(rs["high_freq_factor"]),
+                    float(rs["original_max_position_embeddings"]))
+            elif rt != "default":
+                raise NotImplementedError(
+                    f"rope_scaling type {rt!r} is not implemented "
+                    f"(linear and llama3 are)")
     if get("final_logit_softcapping"):
         kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):
@@ -183,10 +217,16 @@ def params_from_hf_state_dict(
         attn["k_norm"] = {"scale": stack(
             "layers.{i}.self_attn.k_norm.weight", lambda w: w)}
 
+    # OLMo2 post-norm placement renames both block norms; decide the
+    # source tensors once so pre/post stay in one place
+    post = cfg.norm_placement == "post"
+    ln1_src = ("layers.{i}.post_attention_layernorm.weight" if post
+               else "layers.{i}.input_layernorm.weight")
+    ln2_src = ("layers.{i}.post_feedforward_layernorm.weight" if post
+               else "layers.{i}.post_attention_layernorm.weight")
     block = {
         "attn": attn,
-        "ln1": {"scale": stack("layers.{i}.input_layernorm.weight",
-                               lambda w: w)},
+        "ln1": {"scale": stack(ln1_src, lambda w: w)},
     }
     if cfg.num_experts > 0:
         # Mixtral block_sparse_moe -> MoEMlp: gate.weight is the router
@@ -228,8 +268,7 @@ def params_from_hf_state_dict(
         block["ln2_post"] = {"scale": stack(
             "layers.{i}.post_feedforward_layernorm.weight", lambda w: w)}
     else:
-        block["ln2"] = {"scale": stack(
-            "layers.{i}.post_attention_layernorm.weight", lambda w: w)}
+        block["ln2"] = {"scale": stack(ln2_src, lambda w: w)}
     params: Dict[str, Any] = {
         "embed_tokens": {"embedding": get("embed_tokens.weight")},
         "layers": {"block": block},

@@ -61,7 +61,7 @@ class PlanEntry(NamedTuple):
 
 def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
     """HF tensor name (without the ``model.`` prefix) -> PlanEntry for
-    the llama/qwen2/mistral/gemma/mixtral families (same mapping as
+    the llama/qwen2/qwen3/mistral/gemma/mixtral/olmo2 families (same mapping as
     hf.params_from_hf_state_dict, expressed per-tensor so it can run
     shard-by-shard and be checked against a header without data)."""
     h, L = cfg.hidden_size, cfg.num_layers
@@ -112,10 +112,13 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
                     (heads * d,),
                     lambda b, heads=heads: b.reshape(heads, d))
         if cfg.qk_norm:
+            # per-head-dim (gemma3/qwen3) vs flat-projection (OLMo2)
+            qn = (nh * d,) if cfg.qk_norm_proj else (d,)
+            kn = (nk * d,) if cfg.qk_norm_proj else (d,)
             add(p + "self_attn.q_norm.weight", a + ("q_norm", "scale"), i,
-                (d,), lambda w: w)
+                qn, lambda w: w)
             add(p + "self_attn.k_norm.weight", a + ("k_norm", "scale"), i,
-                (d,), lambda w: w)
+                kn, lambda w: w)
         if cfg.num_experts > 0:
             # Mixtral sparse-MoE block: router + per-(layer, expert)
             # FFN weights land in the [L, E, ...] stacked expert leaves
@@ -142,6 +145,13 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
             add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
                 (h, inter), lambda w: np.ascontiguousarray(w.T))
         b = ("layers", "block")
+        if cfg.norm_placement == "post":
+            # OLMo2: no input_layernorm; ln1/ln2 are post-sublayer norms
+            add(p + "post_attention_layernorm.weight",
+                b + ("ln1", "scale"), i, (h,), lambda w: w)
+            add(p + "post_feedforward_layernorm.weight",
+                b + ("ln2", "scale"), i, (h,), lambda w: w)
+            continue
         add(p + "input_layernorm.weight", b + ("ln1", "scale"), i, (h,),
             lambda w: w)
         if cfg.sandwich_norms:

@@ -79,6 +79,16 @@ class ModelConfig:
     # Gemma3 qk-norm: per-head-dim RMSNorm on q and k after projection,
     # before rope (adds q_norm / k_norm params to each attention)
     qk_norm: bool = False
+    # OLMo2 variant of qk_norm: the RMSNorm runs over the FLAT q/k
+    # projection (heads*head_dim jointly, one scale vector per
+    # projection) instead of per-head-dim
+    qk_norm_proj: bool = False
+    # 'pre' (llama: x + f(norm(x))) or 'post' (OLMo2: x + norm(f(x)));
+    # gemma2's sandwich_norms composes with 'pre' only
+    norm_placement: str = "pre"
+    # Llama-3.1 frequency-banded rope scaling (HF rope_type='llama3'):
+    # (factor, low_freq_factor, high_freq_factor, original_max_pos)
+    rope_llama3: Optional[Tuple[float, float, float, float]] = None
     # Gemma3 dual rope bases: 'sliding' pattern layers use this theta
     # (local 10k) while 'global' layers use cfg.rope_theta (1M);
     # None = every layer uses cfg.rope_theta
@@ -189,11 +199,29 @@ def softcap(logits: jax.Array, cap: float) -> jax.Array:
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
-          theta: float) -> Tuple[jax.Array, jax.Array]:
+          theta: float, llama3: Optional[Tuple[float, float, float, float]]
+          = None) -> Tuple[jax.Array, jax.Array]:
     """Rotary embeddings, llama convention (half-split, not interleaved —
-    matches HF transformers so converted weights agree)."""
+    matches HF transformers so converted weights agree).
+
+    ``llama3`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings): the Llama-3.1 frequency-banded
+    scaling (HF ``rope_type='llama3'``) — long wavelengths divide by
+    ``factor``, short ones stay, the band between interpolates smoothly.
+    Every Llama-3.1+ release ships this; without it converted logits
+    silently diverge."""
     d = q.shape[-1]
     freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if llama3 is not None:
+        import math as _math
+        factor, lo, hi, old_len = llama3
+        wavelen = 2.0 * _math.pi / freqs
+        low_wl, high_wl = old_len / lo, old_len / hi
+        smooth = (old_len / wavelen - lo) / (hi - lo)
+        scaled = jnp.where(wavelen > low_wl, freqs / factor, freqs)
+        smoothed = ((1.0 - smooth) / factor + smooth) * freqs
+        freqs = jnp.where((wavelen >= high_wl) & (wavelen <= low_wl),
+                          smoothed, scaled)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -286,14 +314,24 @@ class Attention(nn.Module):
         k = activation_constraint(k, ("batch", "seq", "heads", None), rules)
         v = activation_constraint(v, ("batch", "seq", "heads", None), rules)
         if cfg.qk_norm:
-            # Gemma3: per-head-dim RMSNorm on q and k after projection,
-            # BEFORE rope (HF Gemma3Attention q_norm/k_norm)
-            q = Norm(cfg, name="q_norm")(q)
-            k = Norm(cfg, name="k_norm")(k)
+            if cfg.qk_norm_proj:
+                # OLMo2: RMSNorm over the FLAT projection (heads*d
+                # jointly, scale of nh*d / nk*d) before the head split's
+                # rope — HF Olmo2Attention norms the projection output
+                bq, sq_ = q.shape[:2]
+                q = Norm(cfg, name="q_norm")(
+                    q.reshape(bq, sq_, -1)).reshape(q.shape)
+                k = Norm(cfg, name="k_norm")(
+                    k.reshape(bq, sq_, -1)).reshape(k.shape)
+            else:
+                # Gemma3/Qwen3: per-head-dim RMSNorm on q and k after
+                # projection, BEFORE rope (HF q_norm/k_norm)
+                q = Norm(cfg, name="q_norm")(q)
+                k = Norm(cfg, name="k_norm")(k)
         if cfg.pos_emb == "rope":
             rp = (positions.astype(jnp.float32) / cfg.rope_scale
                   if cfg.rope_scale != 1.0 else positions)
-            q, k = _rope(q, k, rp, cfg.rope_theta)
+            q, k = _rope(q, k, rp, cfg.rope_theta, cfg.rope_llama3)
         # names for the selective-remat policies (utils/remat.py): saving
         # post-rope q/k/v means the backward recomputes only the cheap
         # norms/elementwise ops, never the projections or the rope
@@ -491,17 +529,30 @@ class Block(nn.Module):
                 attn_cls = nn.remat(attn_cls, policy=pol, prevent_cse=False)
             if mlp_cls.__name__ in cfg.remat_cls or "Mlp" in cfg.remat_cls:
                 mlp_cls = nn.remat(mlp_cls, policy=pol, prevent_cse=False)
+        post = cfg.norm_placement == "post"
+        if post and cfg.sandwich_norms:
+            raise ValueError("norm_placement='post' (OLMo2) does not "
+                             "compose with sandwich_norms (gemma2)")
+        if cfg.norm_placement not in ("pre", "post"):
+            raise ValueError(f"norm_placement must be 'pre' | 'post', "
+                             f"got {cfg.norm_placement!r}")
         attn_out = attn_cls(cfg, name="attn")(
-            Norm(cfg, name="ln1")(x), positions, segment_ids, dropout_seed)
+            x if post else Norm(cfg, name="ln1")(x),
+            positions, segment_ids, dropout_seed)
         if cfg.sandwich_norms:
             # Gemma2: post-attention norm before the residual add
             attn_out = Norm(cfg, name="ln1_post")(attn_out)
+        if post:
+            # OLMo2: the sublayer OUTPUT is normed (no pre-norm at all)
+            attn_out = Norm(cfg, name="ln1")(attn_out)
         # names referenced by the 'offload_dots' remat policy (utils/remat.py)
         h = x + checkpoint_name(attn_out, "attn_out")
         mlp_out = mlp_cls(cfg, name="moe" if cfg.num_experts > 0 else "mlp")(
-            Norm(cfg, name="ln2")(h))
+            h if post else Norm(cfg, name="ln2")(h))
         if cfg.sandwich_norms:
             mlp_out = Norm(cfg, name="ln2_post")(mlp_out)
+        if post:
+            mlp_out = Norm(cfg, name="ln2")(mlp_out)
         return h + checkpoint_name(mlp_out, "mlp_out")
 
 
