@@ -422,3 +422,60 @@ def test_olmo2_logits_match():
     assert cfg.norm_placement == "post" and cfg.qk_norm_proj
     ids = np.random.default_rng(14).integers(0, 128, size=(2, 16)).astype(np.int32)
     _compare(hf_model, ids, atol=2e-4)
+
+
+def test_phi3_logits_match():
+    """Phi-3/3.5/4-mini: llama-style block with PACKED qkv_proj and
+    gate_up_proj weights — split at conversion."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(15)
+    hf_model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "phi3"
+    ids = np.random.default_rng(15).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_phi3_longrope_and_partial_rotary_logits_match():
+    """The REAL Phi-3.5/4 checkpoint shapes: 'longrope' rope_scaling
+    (per-dim divisors, long set past the original context, attention
+    factor) and phi-4-mini's partial_rotary_factor.  Prompts on both
+    sides of the original context exercise the traced factor switch."""
+    d2 = 8  # head_dim 16 -> half-split length
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, original_max_position_embeddings=32,
+        pad_token_id=0, tie_word_embeddings=False,
+        attn_implementation="eager",
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0 + 0.1 * i for i in range(d2)],
+                      "long_factor": [2.0 + 0.3 * i for i in range(d2)]})
+    torch.manual_seed(16)
+    hf_model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.rope_longrope is not None and cfg.rope_longrope[2] == 32.0
+    model = TransformerLM(cfg)
+    params = params_from_hf_state_dict(hf_model.state_dict(), cfg)
+    for s in (16, 96):  # short regime / long regime
+        ids = np.random.default_rng(s).integers(0, 128, size=(2, s)).astype(np.int32)
+        ours = model.apply({"params": params}, jnp.asarray(ids))
+        with torch.no_grad():
+            theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+        np.testing.assert_allclose(np.asarray(ours), theirs, atol=3e-4)
+
+    hf_cfg2 = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0,
+        tie_word_embeddings=False, attn_implementation="eager",
+        partial_rotary_factor=0.75)
+    torch.manual_seed(17)
+    m2 = transformers.Phi3ForCausalLM(hf_cfg2).eval()
+    cfg2 = config_from_hf(hf_cfg2, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg2.partial_rotary == 0.75
+    ids = np.random.default_rng(17).integers(0, 128, size=(2, 24)).astype(np.int32)
+    _compare(m2, ids, atol=2e-4)

@@ -184,7 +184,7 @@ def test_streamed_into_fsdp_shardings(tmp_path, devices):
 def test_header_validation_catches_mismatch():
     cfg = config_from_hf(_tiny_llama_cfg())
     plan = ingestion_plan(cfg)
-    shapes = {n: e.hf_shape for n, e in plan.items()}
+    shapes = {n: e[0].hf_shape for n, e in plan.items()}
     validate_checkpoint_header(shapes, cfg)  # clean header passes
 
     bad = dict(shapes)
@@ -222,7 +222,7 @@ def test_streamed_peak_rss_bounded(tmp_path):
     n_shards, weight_map = 3, {}
     for s in range(n_shards):
         part = {f"model.{n}": rng.standard_normal(
-                    plan[n].hf_shape).astype(np.float32) * 0.02
+                    plan[n][0].hf_shape).astype(np.float32) * 0.02
                 for n in names[s::n_shards]}
         fname = f"model-{s + 1:05d}-of-{n_shards:05d}.safetensors"
         save_file(part, os.path.join(path, fname))
@@ -329,10 +329,10 @@ def test_llama3_70b_abstract_ingestion_dryrun(devices):
 
     plan = ingestion_plan(mc)
     total = 0
-    for name, ent in plan.items():
-        leaf_sh = _tree_get(sh, ent.path)  # every plan path must resolve
-        assert leaf_sh is not None, name
-        total += int(np.prod(ent.hf_shape))
+    for name, ents in plan.items():
+        for ent in ents:  # every plan path must resolve
+            assert _tree_get(sh, ent.path) is not None, name
+        total += int(np.prod(ents[0].hf_shape))
     assert total == 70_553_706_496  # llama-3-70b exact param count
 
 
@@ -408,9 +408,10 @@ def test_mixtral_8x7b_abstract_ingestion_dryrun(devices):
 
     plan = ingestion_plan(mc)
     total = 0
-    for name, ent in plan.items():
-        assert _tree_get(sh, ent.path) is not None, name
-        total += int(np.prod(ent.hf_shape))
+    for name, ents in plan.items():
+        for ent in ents:
+            assert _tree_get(sh, ent.path) is not None, name
+        total += int(np.prod(ents[0].hf_shape))
     assert total == 46_702_792_704  # mixtral-8x7b exact param count
 
 
@@ -503,3 +504,29 @@ def test_streamed_olmo2(tmp_path):
     with torch.no_grad():
         theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_streamed_phi3_packed(tmp_path):
+    """Phi-3's packed qkv_proj / gate_up_proj: one checkpoint tensor
+    feeds several leaves (multi-entry plan), detected from the header."""
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(9)
+    hf_model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    ids = np.random.default_rng(9).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+    # abstract header validation sees the packed layout too
+    validate_checkpoint_header(
+        {k: tuple(v.shape) for k, v in hf_model.state_dict().items()}, cfg)

@@ -304,3 +304,47 @@ def test_layer_pattern_generate_cached_matches_recompute(devices):
     slow = generate(model, params, prompt, max_new_tokens=10,
                     use_cache=False)
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+@pytest.mark.slow
+def test_longrope_composes_with_parallelism(devices):
+    """Phi-3.5-style longrope's traced factor switch (jnp.max over
+    positions, a reduction that lowers to a small collective when
+    positions shard) must compile and run under pp x dp, 1f1b and
+    cp-ring, with identical losses — the regression guard for the
+    sharding-hazard analysis in _rope's docstring."""
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    d2 = 8
+    mc = get_preset(
+        "llama-tiny", vocab_size=128, hidden_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        dtype=jnp.float32, max_seq_len=128,
+        rope_longrope=(tuple(1.0 + 0.1 * i for i in range(d2)),
+                       tuple(2.0 + 0.3 * i for i in range(d2)), 32.0, None))
+    ids = np.random.default_rng(0).integers(0, 128, size=(8, 48)).astype(np.int32)
+
+    losses = {}
+    for name, dist in (
+        ("pp_dp", ta.DistConfig(pp=ta.PPConfig(size=2, num_micro_batches=2),
+                                dp=ta.DPConfig(size=2),
+                                fsdp=ta.FSDPConfig(size=2,
+                                                   min_weight_size=0))),
+        ("1f1b", ta.DistConfig(pp=ta.PPConfig(size=2, num_micro_batches=2,
+                                              schedule="1f1b"),
+                               fsdp=ta.FSDPConfig(size=4,
+                                                  min_weight_size=0))),
+        ("cp", ta.DistConfig(sp=ta.SPConfig(size=4, mode="ring"),
+                             dp=ta.DPConfig(size=2))),
+    ):
+        cfg = ta.Config(dist=dist)
+        cfg.compute.dtype = "float32"
+        cfg.compute.param_dtype = "float32"
+        t, _ = accelerate(mc, None, cfg, optimizer=optax.adam(1e-3))
+        t.init()
+        losses[name] = float(t.step({"input_ids": jnp.asarray(ids)})["loss"])
+    vals = list(losses.values())
+    np.testing.assert_allclose(vals, [vals[0]] * len(vals), rtol=2e-4)

@@ -12,9 +12,10 @@ Supported families: Llama (1/2/3, incl. 3.1's banded rope scaling),
 Qwen2 (qkv bias), Qwen3 (qk-norm), Mistral (sliding window), Gemma v1
 (1+w RMSNorm, geglu, scaled embeddings), Gemma2/3 (layer patterns,
 sandwich norms, softcaps), Mixtral (top-k sparse MoE -> models/moe.py),
-OLMo2 (post-norm placement, flat-projection qk-norm) — the reference's
-patched set (utils/patch.py:224-301) plus the Qwen3/Gemma/Mixtral/OLMo2
-families.  GPT-2 uses the 'learned' position variant.
+OLMo2 (post-norm placement, flat-projection qk-norm), Phi-3/3.5/4-mini
+(packed qkv/gate_up weights, split at conversion) — the reference's
+patched set (utils/patch.py:224-301) plus the Qwen3/Gemma/Mixtral/
+OLMo2/Phi-3 families.  GPT-2 uses the 'learned' position variant.
 """
 
 from __future__ import annotations
@@ -87,6 +88,14 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             # reset to 1 in pattern_cfg) — real gemma3 >=4B checkpoints
             # ship factor 8
             kw["rope_scale"] = float(rs["factor"])
+    if mt == "phi3":
+        # Phi-3/3.5/4-mini: llama-style pre-norm block with PACKED
+        # qkv_proj / gate_up_proj weights (split at conversion);
+        # phi-4-mini's partial rotary and the 128k variants' 'longrope'
+        # scaling are both supported (the generic rope chain below)
+        prf = float(get("partial_rotary_factor", 1.0) or 1.0)
+        if prf != 1.0:
+            kw["partial_rotary"] = prf
     if mt == "olmo2":
         # OLMo2 (the modern revision of the reference's example-notebook
         # family, examples/train_olmo.ipynb): llama MLP + POST-norm
@@ -126,10 +135,22 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
                     float(rs["low_freq_factor"]),
                     float(rs["high_freq_factor"]),
                     float(rs["original_max_position_embeddings"]))
+            elif rt == "longrope":
+                # Phi-3.5/4 128k: per-dim divisors; the original
+                # context length comes from the config (NOT inside
+                # rope_scaling in HF's phi3 configs)
+                orig = float(get("original_max_position_embeddings")
+                             or rs.get("original_max_position_embeddings")
+                             or kw["max_seq_len"])
+                af = rs.get("attention_factor")
+                kw["rope_longrope"] = (
+                    tuple(float(x) for x in rs["short_factor"]),
+                    tuple(float(x) for x in rs["long_factor"]),
+                    orig, None if af is None else float(af))
             elif rt != "default":
                 raise NotImplementedError(
                     f"rope_scaling type {rt!r} is not implemented "
-                    f"(linear and llama3 are)")
+                    f"(linear, llama3 and longrope are)")
     if get("final_logit_softcapping"):
         kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):
@@ -196,16 +217,35 @@ def params_from_hf_state_dict(
 
     qkv = lambda w, heads: w.T.reshape(h, heads, d)
 
-    attn = {
-        "q_proj": {"kernel": stack("layers.{i}.self_attn.q_proj.weight",
-                                   lambda w: qkv(w, nh))},
-        "k_proj": {"kernel": stack("layers.{i}.self_attn.k_proj.weight",
-                                   lambda w: qkv(w, nk))},
-        "v_proj": {"kernel": stack("layers.{i}.self_attn.v_proj.weight",
-                                   lambda w: qkv(w, nk))},
-        "o_proj": {"kernel": stack("layers.{i}.self_attn.o_proj.weight",
-                                   lambda w: w.T.reshape(nh, d, h))},
-    }
+    def has(name):
+        return any(p + name in state_dict for p in ("model.", ""))
+
+    if has("layers.0.self_attn.qkv_proj.weight"):
+        # Phi-3 packed attention: qkv_proj rows are [q | k | v]
+        qr, kr = nh * d, nk * d
+        attn = {
+            "q_proj": {"kernel": stack(
+                "layers.{i}.self_attn.qkv_proj.weight",
+                lambda w: qkv(w[:qr], nh))},
+            "k_proj": {"kernel": stack(
+                "layers.{i}.self_attn.qkv_proj.weight",
+                lambda w: qkv(w[qr:qr + kr], nk))},
+            "v_proj": {"kernel": stack(
+                "layers.{i}.self_attn.qkv_proj.weight",
+                lambda w: qkv(w[qr + kr:], nk))},
+        }
+    else:
+        attn = {
+            "q_proj": {"kernel": stack("layers.{i}.self_attn.q_proj.weight",
+                                       lambda w: qkv(w, nh))},
+            "k_proj": {"kernel": stack("layers.{i}.self_attn.k_proj.weight",
+                                       lambda w: qkv(w, nk))},
+            "v_proj": {"kernel": stack("layers.{i}.self_attn.v_proj.weight",
+                                       lambda w: qkv(w, nk))},
+        }
+    attn["o_proj"] = {"kernel": stack(
+        "layers.{i}.self_attn.o_proj.weight",
+        lambda w: w.T.reshape(nh, d, h))}
     if cfg.qkv_bias:
         for name, heads in (("q_proj", nh), ("k_proj", nk), ("v_proj", nk)):
             attn[name]["bias"] = stack(
@@ -248,6 +288,19 @@ def params_from_hf_state_dict(
             "experts/gate": experts_stack("w1"),
             "experts/up": experts_stack("w3"),
             "experts/down": experts_stack("w2"),
+        }
+    elif has("layers.0.mlp.gate_up_proj.weight"):
+        # Phi-3 packed MLP: gate_up_proj rows are [gate | up]
+        inter = cfg.intermediate_size
+        block["mlp"] = {
+            "gate_proj": {"kernel": stack(
+                "layers.{i}.mlp.gate_up_proj.weight",
+                lambda w: w[:inter].T)},
+            "up_proj": {"kernel": stack(
+                "layers.{i}.mlp.gate_up_proj.weight",
+                lambda w: w[inter:].T)},
+            "down_proj": {"kernel": stack(
+                "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
         }
     else:
         block["mlp"] = {

@@ -59,11 +59,21 @@ class PlanEntry(NamedTuple):
     transform: Callable[[np.ndarray], np.ndarray]
 
 
-def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
-    """HF tensor name (without the ``model.`` prefix) -> PlanEntry for
-    the llama/qwen2/qwen3/mistral/gemma/mixtral/olmo2 families (same mapping as
-    hf.params_from_hf_state_dict, expressed per-tensor so it can run
-    shard-by-shard and be checked against a header without data)."""
+def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
+                   packed_mlp: bool = False
+                   ) -> Dict[str, Tuple[PlanEntry, ...]]:
+    """HF tensor name (without the ``model.`` prefix) -> tuple of
+    PlanEntries for the llama/qwen2/qwen3/mistral/gemma/mixtral/olmo2/
+    phi3 families (same mapping as hf.params_from_hf_state_dict,
+    expressed per-tensor so it can run shard-by-shard and be checked
+    against a header without data).
+
+    A checkpoint tensor usually feeds ONE leaf; Phi-3's packed
+    ``qkv_proj`` / ``gate_up_proj`` feed several (each entry slices its
+    rows out of the same tensor) — hence the tuple values.
+    ``packed_qkv`` / ``packed_mlp`` select those layouts; they are a
+    property of the CHECKPOINT, detected from its tensor names
+    (:func:`_detect_packed`), not of the model config."""
     h, L = cfg.hidden_size, cfg.num_layers
     nh, nk, d = cfg.num_heads, cfg.kv_heads, cfg.head_size
     inter, v = cfg.intermediate_size, cfg.vocab_size
@@ -71,14 +81,14 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
     def qkv(heads):
         return lambda w: np.ascontiguousarray(w.T).reshape(h, heads, d)
 
-    plan: Dict[str, PlanEntry] = {}
+    plan: Dict[str, Tuple[PlanEntry, ...]] = {}
 
     def add(name, path, layer, shape, tr, lead=None):
         idx = ((layer,) if isinstance(layer, int) else layer)
         if lead is None:
             lead = () if idx is None else (L,)
-        plan[name] = PlanEntry(tuple(path), idx, tuple(lead),
-                               tuple(shape), tr)
+        ent = PlanEntry(tuple(path), idx, tuple(lead), tuple(shape), tr)
+        plan[name] = plan.get(name, ()) + (ent,)
 
     add("embed_tokens.weight", ("embed_tokens", "embedding"), None,
         (v, h), lambda w: w)
@@ -96,12 +106,25 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
     for i in range(L):
         p = f"layers.{i}."
         a = ("layers", "block", "attn")
-        add(p + "self_attn.q_proj.weight", a + ("q_proj", "kernel"), i,
-            (nh * d, h), qkv(nh))
-        add(p + "self_attn.k_proj.weight", a + ("k_proj", "kernel"), i,
-            (nk * d, h), qkv(nk))
-        add(p + "self_attn.v_proj.weight", a + ("v_proj", "kernel"), i,
-            (nk * d, h), qkv(nk))
+        if packed_qkv:
+            # Phi-3: qkv_proj rows are [q | k | v]; three entries slice
+            # the same tensor
+            qr, kr = nh * d, nk * d
+            nm = p + "self_attn.qkv_proj.weight"
+            shp = (qr + 2 * kr, h)
+            add(nm, a + ("q_proj", "kernel"), i, shp,
+                lambda w: qkv(nh)(w[:qr]))
+            add(nm, a + ("k_proj", "kernel"), i, shp,
+                lambda w: qkv(nk)(w[qr:qr + kr]))
+            add(nm, a + ("v_proj", "kernel"), i, shp,
+                lambda w: qkv(nk)(w[qr + kr:]))
+        else:
+            add(p + "self_attn.q_proj.weight", a + ("q_proj", "kernel"), i,
+                (nh * d, h), qkv(nh))
+            add(p + "self_attn.k_proj.weight", a + ("k_proj", "kernel"), i,
+                (nk * d, h), qkv(nk))
+            add(p + "self_attn.v_proj.weight", a + ("v_proj", "kernel"), i,
+                (nk * d, h), qkv(nk))
         add(p + "self_attn.o_proj.weight", a + ("o_proj", "kernel"), i,
             (h, nh * d),
             lambda w: np.ascontiguousarray(w.T).reshape(nh, d, h))
@@ -136,6 +159,16 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
                     (inter, h), tT, lead=(L, E))
                 add(q + "w2.weight", moe + ("experts/down",), (i, j),
                     (h, inter), tT, lead=(L, E))
+        elif packed_mlp:
+            # Phi-3: gate_up_proj rows are [gate | up]
+            m = ("layers", "block", "mlp")
+            nm = p + "mlp.gate_up_proj.weight"
+            add(nm, m + ("gate_proj", "kernel"), i, (2 * inter, h),
+                lambda w: np.ascontiguousarray(w[:inter].T))
+            add(nm, m + ("up_proj", "kernel"), i, (2 * inter, h),
+                lambda w: np.ascontiguousarray(w[inter:].T))
+            add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
+                (h, inter), lambda w: np.ascontiguousarray(w.T))
         else:
             m = ("layers", "block", "mlp")
             add(p + "mlp.gate_proj.weight", m + ("gate_proj", "kernel"), i,
@@ -167,6 +200,15 @@ def ingestion_plan(cfg: ModelConfig) -> Dict[str, PlanEntry]:
     return plan
 
 
+def _detect_packed(names) -> Tuple[bool, bool]:
+    """(packed_qkv, packed_mlp) from checkpoint tensor names — Phi-3
+    ships fused qkv_proj / gate_up_proj; packing is a checkpoint
+    property, not a model-config one."""
+    pk = any(n.endswith("self_attn.qkv_proj.weight") for n in names)
+    pm = any(n.endswith("mlp.gate_up_proj.weight") for n in names)
+    return pk, pm
+
+
 def resolve_checkpoint_files(path: str) -> Optional[List[str]]:
     """safetensors shard files under ``path``, or None when the
     checkpoint has no safetensors (caller falls back to the
@@ -180,6 +222,27 @@ def resolve_checkpoint_files(path: str) -> Optional[List[str]]:
     if os.path.exists(single):
         return [single]
     return None
+
+
+def checkpoint_tensor_names(path: str) -> Optional[List[str]]:
+    """All tensor names in the checkpoint: free from the index json
+    when one exists (its weight_map keys ARE the names), else from the
+    shard headers.  Layout resolution is shared with
+    :func:`resolve_checkpoint_files` — one place knows what a
+    safetensors checkpoint looks like."""
+    idx = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(idx):
+        with open(idx) as f:
+            return sorted(json.load(f)["weight_map"])
+    files = resolve_checkpoint_files(path)
+    if files is None:
+        return None
+    from safetensors import safe_open
+    names: List[str] = []
+    for fpath in files:
+        with safe_open(fpath, framework="pt") as f:
+            names.extend(f.keys())
+    return names
 
 
 def _np_from_torch(t) -> np.ndarray:
@@ -230,6 +293,7 @@ def stream_params(
     *,
     shardings: Any = None,
     param_dtype=None,
+    tensor_names: Optional[List[str]] = None,
 ) -> Dict[str, Any]:
     """Assemble TransformerLM params from safetensors shards, one tensor
     at a time.
@@ -237,12 +301,24 @@ def stream_params(
     ``shardings``: optional pytree of NamedShardings matching the param
     tree (e.g. ``trainer.state_shardings.params``) — each tensor is
     placed into its shard as it is read.  Without it, leaves land on the
-    default device.
+    default device.  ``tensor_names``: the checkpoint's tensor names if
+    the caller already has them (``checkpoint_tensor_names`` reads them
+    from the index json for free); otherwise a header-only pre-scan of
+    the shard files collects them.
     """
     from safetensors import safe_open
 
     param_dtype = param_dtype or cfg.param_dtype
-    plan = ingestion_plan(cfg)
+    names = tensor_names
+    if names is None:
+        # header-only pre-scan: which packed layouts this checkpoint
+        # uses decides the plan shape
+        names = []
+        for fpath in files:
+            with safe_open(fpath, framework="pt") as f:
+                names.extend(f.keys())
+    pk, pm = _detect_packed(names)
+    plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm)
 
     params: Dict[str, Any] = {}
     filled: Dict[Tuple[str, ...], np.ndarray] = {}  # stacked-leaf masks
@@ -291,8 +367,8 @@ def stream_params(
                 base = name[6:] if name.startswith("model.") else name
                 if _IGNORE.search(base):
                     continue
-                ent = plan.get(base)
-                if ent is None:
+                ents = plan.get(base)
+                if ents is None:
                     raise KeyError(
                         f"checkpoint tensor {name!r} has no mapping for "
                         f"this ModelConfig (family unsupported by the "
@@ -300,37 +376,41 @@ def stream_params(
                 if base in seen:
                     raise ValueError(f"duplicate tensor {name!r}")
                 seen.add(base)
-                if not ent.path:  # mapped-to-discard (de-aliased tied head)
+                if not ents[0].path:  # mapped-to-discard (tied head)
                     continue
                 t = f.get_tensor(name)
-                arr = _np_from_torch(t)
-                if tuple(arr.shape) != ent.hf_shape:
-                    raise ValueError(
-                        f"{name}: checkpoint shape {tuple(arr.shape)} != "
-                        f"expected {ent.hf_shape}")
-                arr = ent.transform(arr)
+                raw = _np_from_torch(t)
                 del t
-                sh = leaf_sharding(ent.path)
-                if ent.idx is None:
-                    _tree_set(params, ent.path, place(arr, sh))
-                    continue
-                buf = None
-                try:
-                    buf = _tree_get(params, ent.path)
-                except KeyError:
-                    pass
-                if buf is None:
-                    shape = ent.lead + arr.shape
-                    mk = jax.jit(
-                        lambda: jnp.zeros(shape, param_dtype),
-                        **({} if sh is None else {"out_shardings": sh}))
-                    buf = mk()
-                    filled[ent.path] = np.zeros(ent.lead, bool)
-                st = setter_for(ent.path, sh)
-                piece = place(arr, piece_sharding(sh, len(ent.lead)))
-                buf = st(buf, piece, *(jnp.int32(i) for i in ent.idx))
-                filled[ent.path][ent.idx] = True
-                _tree_set(params, ent.path, buf)
+                if tuple(raw.shape) != ents[0].hf_shape:
+                    raise ValueError(
+                        f"{name}: checkpoint shape {tuple(raw.shape)} != "
+                        f"expected {ents[0].hf_shape}")
+                for ent in ents:  # packed tensors feed several leaves
+                    arr = ent.transform(raw)
+                    sh = leaf_sharding(ent.path)
+                    if ent.idx is None:
+                        _tree_set(params, ent.path, place(arr, sh))
+                        continue
+                    buf = None
+                    try:
+                        buf = _tree_get(params, ent.path)
+                    except KeyError:
+                        pass
+                    if buf is None:
+                        shape = ent.lead + arr.shape
+                        mk = jax.jit(
+                            lambda shape=shape: jnp.zeros(shape,
+                                                          param_dtype),
+                            **({} if sh is None
+                               else {"out_shardings": sh}))
+                        buf = mk()
+                        filled[ent.path] = np.zeros(ent.lead, bool)
+                    st = setter_for(ent.path, sh)
+                    piece = place(arr, piece_sharding(sh, len(ent.lead)))
+                    buf = st(buf, piece, *(jnp.int32(i) for i in ent.idx))
+                    filled[ent.path][ent.idx] = True
+                    _tree_set(params, ent.path, buf)
+                del raw
                 # per-tensor trim: the torch copy + transform buffer +
                 # donated-out leaf all freed this iteration; without a
                 # trim glibc's arenas retain them nondeterministically
@@ -365,18 +445,19 @@ def validate_checkpoint_header(
     unmappable.  ``shapes``: HF tensor name -> shape, e.g. read from
     safetensors headers.  This is what the 70B ingestion dryrun runs —
     it needs only the index/header, never the 140 GB of weights."""
-    plan = ingestion_plan(cfg)
+    pk, pm = _detect_packed(shapes)
+    plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm)
     seen = set()
     for name, shape in shapes.items():
         base = name[6:] if name.startswith("model.") else name
         if _IGNORE.search(base):
             continue
-        ent = plan.get(base)
-        if ent is None:
+        ents = plan.get(base)
+        if ents is None:
             raise KeyError(f"unmappable checkpoint tensor {name!r}")
-        if tuple(shape) != ent.hf_shape:
+        if tuple(shape) != ents[0].hf_shape:
             raise ValueError(f"{name}: shape {tuple(shape)} != expected "
-                             f"{ent.hf_shape}")
+                             f"{ents[0].hf_shape}")
         seen.add(base)
     missing = set(plan) - seen
     if cfg.tie_embeddings:
@@ -415,5 +496,6 @@ def load_hf_model_streamed(
     cfg = config_from_hf(hf_cfg, **overrides)
     logger.info(f"streaming {len(files)} safetensors shard(s) from {path}")
     params = stream_params(files, cfg, shardings=shardings,
-                           param_dtype=param_dtype)
+                           param_dtype=param_dtype,
+                           tensor_names=checkpoint_tensor_names(path))
     return cfg, params

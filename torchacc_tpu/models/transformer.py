@@ -89,6 +89,15 @@ class ModelConfig:
     # Llama-3.1 frequency-banded rope scaling (HF rope_type='llama3'):
     # (factor, low_freq_factor, high_freq_factor, original_max_pos)
     rope_llama3: Optional[Tuple[float, float, float, float]] = None
+    # Phi-3.5/4 'longrope': (short_factor, long_factor,
+    # original_max_pos, attention_factor) — per-dim inv_freq divisors,
+    # long set active once positions exceed original_max_pos, cos/sin
+    # scaled by attention_factor (None = HF's sqrt(1+ln(s)/ln(orig)))
+    rope_longrope: Optional[Tuple[Tuple[float, ...], Tuple[float, ...],
+                                  float, Optional[float]]] = None
+    # fraction of head_dim that rotates (phi-4-mini: 0.75); the
+    # remaining dims pass through rope untouched
+    partial_rotary: float = 1.0
     # Gemma3 dual rope bases: 'sliding' pattern layers use this theta
     # (local 10k) while 'global' layers use cfg.rope_theta (1M);
     # None = every layer uses cfg.rope_theta
@@ -199,22 +208,39 @@ def softcap(logits: jax.Array, cap: float) -> jax.Array:
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
-          theta: float, llama3: Optional[Tuple[float, float, float, float]]
-          = None) -> Tuple[jax.Array, jax.Array]:
+          cfg: "ModelConfig") -> Tuple[jax.Array, jax.Array]:
     """Rotary embeddings, llama convention (half-split, not interleaved —
     matches HF transformers so converted weights agree).
 
-    ``llama3`` = (factor, low_freq_factor, high_freq_factor,
-    original_max_position_embeddings): the Llama-3.1 frequency-banded
-    scaling (HF ``rope_type='llama3'``) — long wavelengths divide by
-    ``factor``, short ones stay, the band between interpolates smoothly.
-    Every Llama-3.1+ release ships this; without it converted logits
-    silently diverge."""
+    Scaling variants (all from the per-layer cfg, so gemma3's dual-base
+    pattern composes):
+
+    - ``rope_llama3`` — Llama-3.1 frequency banding: long wavelengths
+      divide by ``factor``, short ones stay, the band between
+      interpolates smoothly.  Every 3.1+ release ships this.
+    - ``rope_longrope`` — Phi-3.5/4: per-dim inv_freq divisors with the
+      LONG set activating once any position exceeds the original
+      context (a traced switch: both static sets are built, jnp.where
+      selects), and cos/sin scaled by the attention factor.  The
+      ``jnp.max(positions)`` is a reduction that can lower to a small
+      collective when positions are sharded (cp) — measured harmless
+      (compiles+runs under pp×dp, 1f1b and cp-ring;
+      test_longrope_composes_with_parallelism) and CSE dedupes it in
+      the unrolled-layer path; revisit only if a partitioner change
+      breaks that test.
+    - ``partial_rotary`` < 1 — only the first ``d * partial`` head dims
+      rotate (phi-4-mini: 0.75); the rest pass through.
+    """
+    import math as _math
+
     d = q.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    if llama3 is not None:
-        import math as _math
-        factor, lo, hi, old_len = llama3
+    rot_d = int(d * cfg.partial_rotary)
+    theta = cfg.rope_theta
+    freqs = 1.0 / (theta ** (jnp.arange(0, rot_d, 2, dtype=jnp.float32)
+                             / rot_d))
+    scale = jnp.float32(1.0)
+    if cfg.rope_llama3 is not None:
+        factor, lo, hi, old_len = cfg.rope_llama3
         wavelen = 2.0 * _math.pi / freqs
         low_wl, high_wl = old_len / lo, old_len / hi
         smooth = (old_len / wavelen - lo) / (hi - lo)
@@ -222,13 +248,33 @@ def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
         smoothed = ((1.0 - smooth) / factor + smooth) * freqs
         freqs = jnp.where((wavelen >= high_wl) & (wavelen <= low_wl),
                           smoothed, scaled)
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, d/2]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
+    if cfg.rope_longrope is not None:
+        short_f, long_f, old_len, attn_f = cfg.rope_longrope
+        short = freqs / jnp.asarray(short_f, jnp.float32)
+        long = freqs / jnp.asarray(long_f, jnp.float32)
+        # HF switches factor sets when the sequence grows past the
+        # original context; positions are traced, so build both static
+        # sets and select (one jnp.where, no retrace)
+        is_long = jnp.max(positions) + 1 > old_len
+        freqs = jnp.where(is_long, long, short)
+        if attn_f is None:
+            s = cfg.max_seq_len / old_len
+            attn_f = (1.0 if s <= 1.0
+                      else _math.sqrt(1.0 + _math.log(s)
+                                      / _math.log(old_len)))
+        scale = jnp.float32(attn_f)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b,s,rd/2]
+    cos = (jnp.cos(angles) * scale)[:, :, None, :]
+    sin = (jnp.sin(angles) * scale)[:, :, None, :]
 
     def rot(x):
-        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        xf = x.astype(jnp.float32)
+        xr, xp = xf[..., :rot_d], xf[..., rot_d:]
+        x1, x2 = jnp.split(xr, 2, axis=-1)
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        if rot_d < d:
+            out = jnp.concatenate([out, xp], axis=-1)
         return out.astype(x.dtype)
 
     return rot(q), rot(k)
@@ -331,7 +377,7 @@ class Attention(nn.Module):
         if cfg.pos_emb == "rope":
             rp = (positions.astype(jnp.float32) / cfg.rope_scale
                   if cfg.rope_scale != 1.0 else positions)
-            q, k = _rope(q, k, rp, cfg.rope_theta, cfg.rope_llama3)
+            q, k = _rope(q, k, rp, cfg)
         # names for the selective-remat policies (utils/remat.py): saving
         # post-rope q/k/v means the backward recomputes only the cheap
         # norms/elementwise ops, never the projections or the rope

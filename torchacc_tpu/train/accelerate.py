@@ -106,6 +106,10 @@ def accelerate(
             import transformers
 
             from torchacc_tpu.models.hf import config_from_hf
+            from torchacc_tpu.models.hf_stream import (
+                checkpoint_tensor_names,
+            )
+            stream_names = checkpoint_tensor_names(model)
             mc = config_from_hf(
                 transformers.AutoConfig.from_pretrained(model),
                 dtype=_DTYPES[config.compute.dtype],
@@ -130,7 +134,8 @@ def accelerate(
             params = stream_params(
                 stream_files, mc,
                 shardings=trainer.state_shardings.params,
-                param_dtype=_DTYPES[config.compute.param_dtype])
+                param_dtype=_DTYPES[config.compute.param_dtype],
+                tensor_names=stream_names)
         trainer.init_from_params(params)
     elif hf_params is not None:
         trainer.init_from_params(hf_params)
