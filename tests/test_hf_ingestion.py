@@ -395,14 +395,34 @@ def test_llama31_rope_scaling_logits_match():
 
 
 def test_unsupported_rope_scaling_raises():
-    """yarn/dynamic rope scaling must fail loudly, not convert wrong."""
+    """Unknown rope scaling types must fail loudly, not convert wrong."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)
+    hf_cfg.rope_scaling = {"rope_type": "dynamic", "factor": 4.0}
+    with pytest.raises(NotImplementedError, match="dynamic"):
+        config_from_hf(hf_cfg)
+
+
+def test_qwen3_yarn_logits_match():
+    """YaRN (the qwen 128k recipe): NTK-by-parts inv_freq interpolation
+    + attention factor; parity inside and beyond the original context."""
     hf_cfg = transformers.Qwen3Config(
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        head_dim=32, max_position_embeddings=64,
-        rope_scaling={"rope_type": "yarn", "factor": 4.0})
-    with pytest.raises(NotImplementedError, match="yarn"):
-        config_from_hf(hf_cfg)
+        head_dim=32, max_position_embeddings=256,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0,
+                      "original_max_position_embeddings": 64},
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(18)
+    hf_model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.rope_yarn == (4.0, 64.0, 32.0, 1.0, None, True)
+    for s in (32, 192):
+        ids = np.random.default_rng(s).integers(0, 128, size=(2, s)).astype(np.int32)
+        _compare(hf_model, ids, atol=3e-4)
 
 
 def test_olmo2_logits_match():
@@ -458,6 +478,7 @@ def test_phi3_longrope_and_partial_rotary_logits_match():
     hf_model = transformers.Phi3ForCausalLM(hf_cfg).eval()
     cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
     assert cfg.rope_longrope is not None and cfg.rope_longrope[2] == 32.0
+    assert cfg.rope_longrope[3] is not None  # attention factor resolved at parse
     model = TransformerLM(cfg)
     params = params_from_hf_state_dict(hf_model.state_dict(), cfg)
     for s in (16, 96):  # short regime / long regime
@@ -479,3 +500,22 @@ def test_phi3_longrope_and_partial_rotary_logits_match():
     assert cfg2.partial_rotary == 0.75
     ids = np.random.default_rng(17).integers(0, 128, size=(2, 24)).astype(np.int32)
     _compare(m2, ids, atol=2e-4)
+
+
+def test_qwen3_yarn_default_original_max():
+    """YaRN without original_max_position_embeddings: HF falls back to
+    max_position_embeddings itself (NOT max/factor) — the correction
+    dims shift by ~46% relative if this fallback is wrong."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=256,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(19)
+    hf_model = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.rope_yarn[1] == 256.0
+    ids = np.random.default_rng(19).integers(0, 128, size=(2, 64)).astype(np.int32)
+    _compare(hf_model, ids, atol=3e-4)

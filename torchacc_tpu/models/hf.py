@@ -120,10 +120,11 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             router_aux_weight=float(get("router_aux_loss_coef", 0.01)))
     if mt not in ("gemma3", "gemma3_text"):
         # generic rope_scaling (gemma3 parses its own above): 'linear'
-        # divides positions; 'llama3' is the Llama-3.1 frequency-banded
-        # transform every 3.1+ release ships.  Anything else
-        # (yarn/dynamic/longrope) fails LOUDLY — silently dropping the
-        # scaling would make long-context logits quietly wrong.
+        # divides positions, 'llama3' is Llama-3.1's frequency banding,
+        # 'longrope' is Phi-3.5/4's per-dim divisors, 'yarn' is the
+        # qwen 128k recipe.  Anything else fails LOUDLY — silently
+        # dropping a scaling would make long-context logits quietly
+        # wrong.
         rs = get("rope_scaling")
         if rs:
             rt = rs.get("rope_type", rs.get("type", "default"))
@@ -136,21 +137,50 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
                     float(rs["high_freq_factor"]),
                     float(rs["original_max_position_embeddings"]))
             elif rt == "longrope":
-                # Phi-3.5/4 128k: per-dim divisors; the original
-                # context length comes from the config (NOT inside
-                # rope_scaling in HF's phi3 configs)
-                orig = float(get("original_max_position_embeddings")
-                             or rs.get("original_max_position_embeddings")
-                             or kw["max_seq_len"])
+                # Phi-3.5/4 128k: per-dim divisors.  HF semantics: the
+                # original context comes from the CONFIG ATTR when
+                # present (factor = max_pos / orig); otherwise orig =
+                # max_pos and the rs-level 'factor' drives the default
+                # attention factor.  Compute that default HERE so _rope
+                # never has to guess the effective factor.
+                import math as _m
+                attr_orig = get("original_max_position_embeddings")
+                orig = float(attr_orig or kw["max_seq_len"])
+                f_eff = (kw["max_seq_len"] / orig if attr_orig
+                         else float(rs.get("factor") or 1.0))
                 af = rs.get("attention_factor")
+                if af is None:
+                    af = (1.0 if f_eff <= 1.0
+                          else _m.sqrt(1.0 + _m.log(f_eff)
+                                       / _m.log(orig)))
                 kw["rope_longrope"] = (
                     tuple(float(x) for x in rs["short_factor"]),
                     tuple(float(x) for x in rs["long_factor"]),
-                    orig, None if af is None else float(af))
+                    orig, float(af))
+            elif rt == "yarn":
+                # qwen 128k variants.  Fallbacks mirror HF
+                # _compute_yarn_parameters exactly: original_max falls
+                # back to max_position_embeddings (NOT divided by
+                # factor, and the top-level config attr is not
+                # consulted); beta defaults use `or` (an explicit null
+                # still means 32/1)
+                orig = float(rs.get("original_max_position_embeddings")
+                             or kw["max_seq_len"])
+                af = rs.get("attention_factor")
+                if rs.get("mscale") or rs.get("mscale_all_dim"):
+                    raise NotImplementedError(
+                        "yarn mscale variants (deepseek) are not "
+                        "implemented")
+                kw["rope_yarn"] = (
+                    float(rs["factor"]), orig,
+                    float(rs.get("beta_fast") or 32.0),
+                    float(rs.get("beta_slow") or 1.0),
+                    None if af is None else float(af),
+                    bool(rs.get("truncate", True)))
             elif rt != "default":
                 raise NotImplementedError(
                     f"rope_scaling type {rt!r} is not implemented "
-                    f"(linear, llama3 and longrope are)")
+                    f"(linear, llama3, longrope and yarn are)")
     if get("final_logit_softcapping"):
         kw["logit_softcap"] = float(get("final_logit_softcapping"))
     if get("sliding_window") and get("use_sliding_window", True):

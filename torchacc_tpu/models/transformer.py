@@ -98,6 +98,13 @@ class ModelConfig:
     # fraction of head_dim that rotates (phi-4-mini: 0.75); the
     # remaining dims pass through rope untouched
     partial_rotary: float = 1.0
+    # YaRN (qwen 128k variants): (factor, original_max_pos, beta_fast,
+    # beta_slow, attention_factor, truncate) — NTK-by-parts inv_freq
+    # interpolation with a linear ramp between the correction dims,
+    # cos/sin scaled by the attention factor (None = HF's
+    # 0.1*ln(factor)+1 for factor > 1, else 1)
+    rope_yarn: Optional[Tuple[float, float, float, float,
+                              Optional[float], bool]] = None
     # Gemma3 dual rope bases: 'sliding' pattern layers use this theta
     # (local 10k) while 'global' layers use cfg.rope_theta (1M);
     # None = every layer uses cfg.rope_theta
@@ -248,6 +255,32 @@ def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
         smoothed = ((1.0 - smooth) / factor + smooth) * freqs
         freqs = jnp.where((wavelen >= high_wl) & (wavelen <= low_wl),
                           smoothed, scaled)
+    if cfg.rope_yarn is not None:
+        # YaRN NTK-by-parts (HF _compute_yarn_parameters): interpolate
+        # per-dim between the original freqs (short wavelengths) and
+        # position-interpolated freqs (long), with a linear ramp
+        # between the beta_fast/beta_slow correction dims
+        factor, old_len, bfast, bslow, attn_f, truncate = cfg.rope_yarn
+
+        def corr_dim(beta):
+            return (rot_d * _math.log(old_len / (beta * 2 * _math.pi))
+                    / (2 * _math.log(theta)))
+
+        low, high = corr_dim(bfast), corr_dim(bslow)
+        if truncate:
+            low, high = _math.floor(low), _math.ceil(high)
+        low, high = max(low, 0), min(high, rot_d - 1)
+        if low == high:
+            high += 0.001  # HF's singularity guard
+        ramp = jnp.clip(
+            (jnp.arange(rot_d // 2, dtype=jnp.float32) - low)
+            / (high - low), 0.0, 1.0)
+        mask = 1.0 - ramp                       # 1 = keep original
+        freqs = (freqs / factor) * (1.0 - mask) + freqs * mask
+        if attn_f is None:
+            attn_f = (1.0 if factor <= 1.0
+                      else 0.1 * _math.log(factor) + 1.0)
+        scale = jnp.float32(attn_f)
     if cfg.rope_longrope is not None:
         short_f, long_f, old_len, attn_f = cfg.rope_longrope
         short = freqs / jnp.asarray(short_f, jnp.float32)
