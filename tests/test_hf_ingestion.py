@@ -519,3 +519,24 @@ def test_qwen3_yarn_default_original_max():
     assert cfg.rope_yarn[1] == 256.0
     ids = np.random.default_rng(19).integers(0, 128, size=(2, 64)).astype(np.int32)
     _compare(hf_model, ids, atol=3e-4)
+
+
+def test_qwen3_moe_logits_match():
+    """Qwen3-MoE (30B-A3B family): qwen3 attention + per-expert llama
+    FFNs at moe_intermediate_size, under BOTH combine-weight
+    conventions (norm_topk_prob true/false — false uses the
+    un-renormalised full-softmax probs)."""
+    for ntp in (False, True):
+        hf_cfg = transformers.Qwen3MoeConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=96, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            num_experts=4, num_experts_per_tok=2, norm_topk_prob=ntp,
+            max_position_embeddings=64, rms_norm_eps=1e-6,
+            tie_word_embeddings=False, attn_implementation="eager")
+        torch.manual_seed(20)
+        hf_model = transformers.Qwen3MoeForCausalLM(hf_cfg).eval()
+        cfg = config_from_hf(hf_cfg)
+        assert cfg.ffn_size == 96 and cfg.moe_renorm_topk is ntp
+        ids = np.random.default_rng(20).integers(0, 128, size=(2, 16)).astype(np.int32)
+        _compare(hf_model, ids, atol=2e-4)

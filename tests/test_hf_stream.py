@@ -530,3 +530,28 @@ def test_streamed_phi3_packed(tmp_path):
     # abstract header validation sees the packed layout too
     validate_checkpoint_header(
         {k: tuple(v.shape) for k, v in hf_model.state_dict().items()}, cfg)
+
+
+def test_streamed_qwen3_moe(tmp_path):
+    """Qwen3-MoE streams: the qwen expert naming (mlp.experts.N.*)
+    detected from the header feeds the [L, E, ...] stacked leaves."""
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=96, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(10)
+    hf_model = transformers.Qwen3MoeForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    ids = np.random.default_rng(10).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)

@@ -11,11 +11,13 @@ checkpointing) applies with zero model-specific code.
 Supported families: Llama (1/2/3, incl. 3.1's banded rope scaling),
 Qwen2 (qkv bias), Qwen3 (qk-norm), Mistral (sliding window), Gemma v1
 (1+w RMSNorm, geglu, scaled embeddings), Gemma2/3 (layer patterns,
-sandwich norms, softcaps), Mixtral (top-k sparse MoE -> models/moe.py),
-OLMo2 (post-norm placement, flat-projection qk-norm), Phi-3/3.5/4-mini
-(packed qkv/gate_up weights, split at conversion) — the reference's
-patched set (utils/patch.py:224-301) plus the Qwen3/Gemma/Mixtral/
-OLMo2/Phi-3 families.  GPT-2 uses the 'learned' position variant.
+sandwich norms, softcaps), Mixtral and Qwen3-MoE (top-k sparse MoE -> models/moe.py, incl. the
+un-renormalised combine-weight convention), OLMo2 (post-norm placement,
+flat-projection qk-norm), Phi-3/3.5/4-mini (packed qkv/gate_up weights,
+longrope, partial rotary) — the reference's patched set
+(utils/patch.py:224-301) plus the Qwen3/Gemma/Mixtral/OLMo2/Phi-3
+families.  Rope scaling: linear, llama3, longrope, yarn (others fail
+loudly).  GPT-2 uses the 'learned' position variant.
 """
 
 from __future__ import annotations
@@ -108,6 +110,23 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         # RMSNorm — cfg.norm stays 'rmsnorm') and explicit head_dim; no
         # qkv bias (unlike qwen2)
         kw.update(qk_norm=True)
+    if mt == "qwen3_moe":
+        # Qwen3-MoE (30B-A3B family): qwen3 attention + per-expert
+        # llama FFNs at moe_intermediate_size; norm_topk_prob picks the
+        # combine-weight convention
+        if int(get("decoder_sparse_step", 1) or 1) != 1 \
+                or get("mlp_only_layers"):
+            raise NotImplementedError(
+                "qwen3_moe mixed dense/sparse layer schedules "
+                "(decoder_sparse_step != 1 / mlp_only_layers) are not "
+                "implemented")
+        kw.update(
+            qk_norm=True,
+            num_experts=int(get("num_experts")),
+            num_experts_per_tok=int(get("num_experts_per_tok", 2)),
+            router_aux_weight=float(get("router_aux_loss_coef", 0.001)),
+            intermediate_size=int(get("moe_intermediate_size")),
+            moe_renorm_topk=bool(get("norm_topk_prob", False)))
     if mt == "mixtral":
         # Mixtral 8x7B/8x22B: llama attention + top-k sparse MoE MLP.
         # HF routes softmax-then-topk-then-renormalise, which equals the
@@ -299,25 +318,34 @@ def params_from_hf_state_dict(
         "ln1": {"scale": stack(ln1_src, lambda w: w)},
     }
     if cfg.num_experts > 0:
-        # Mixtral block_sparse_moe -> MoEMlp: gate.weight is the router
-        # ([e, h] -> [h, e] kernel); experts j carry w1 (gate), w3 (up),
-        # w2 (down), stacked [L, e, ...] to the zoo's expert-major layout
+        # Sparse MoE -> MoEMlp: router [e, h] -> [h, e] kernel; expert
+        # FFNs stack [L, e, ...] to the zoo's expert-major layout.
+        # Mixtral names them block_sparse_moe.{gate, experts.j.w1/w3/w2};
+        # qwen3_moe uses mlp.{gate, experts.j.gate_proj/up_proj/down_proj}
         E = cfg.num_experts
+        # one detector shared with the streaming path so the two cannot
+        # diverge on a future naming style
+        from torchacc_tpu.models.hf_stream import _detect_moe_style
+        if _detect_moe_style(state_dict) == "qwen":
+            moe_mod, wg, wu, wd = ("mlp", "gate_proj", "up_proj",
+                                   "down_proj")
+        else:
+            moe_mod, wg, wu, wd = "block_sparse_moe", "w1", "w3", "w2"
 
         def experts_stack(wn):
             return np.stack([
                 np.stack([
-                    get(f"layers.{i}.block_sparse_moe.experts.{j}."
+                    get(f"layers.{i}.{moe_mod}.experts.{j}."
                         f"{wn}.weight").T
                     for j in range(E)]) for i in range(L)])
 
         block["moe"] = {
             "router": {"kernel": stack(
-                "layers.{i}.block_sparse_moe.gate.weight",
+                "layers.{{i}}.{}.gate.weight".format(moe_mod),
                 lambda w: w.T)},
-            "experts/gate": experts_stack("w1"),
-            "experts/up": experts_stack("w3"),
-            "experts/down": experts_stack("w2"),
+            "experts/gate": experts_stack(wg),
+            "experts/up": experts_stack(wu),
+            "experts/down": experts_stack(wd),
         }
     elif has("layers.0.mlp.gate_up_proj.weight"):
         # Phi-3 packed MLP: gate_up_proj rows are [gate | up]

@@ -60,7 +60,7 @@ class PlanEntry(NamedTuple):
 
 
 def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
-                   packed_mlp: bool = False
+                   packed_mlp: bool = False, moe_style: str = "mixtral"
                    ) -> Dict[str, Tuple[PlanEntry, ...]]:
     """HF tensor name (without the ``model.`` prefix) -> tuple of
     PlanEntries for the llama/qwen2/qwen3/mistral/gemma/mixtral/olmo2/
@@ -143,21 +143,29 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
             add(p + "self_attn.k_norm.weight", a + ("k_norm", "scale"), i,
                 kn, lambda w: w)
         if cfg.num_experts > 0:
-            # Mixtral sparse-MoE block: router + per-(layer, expert)
-            # FFN weights land in the [L, E, ...] stacked expert leaves
+            # Sparse-MoE block: router + per-(layer, expert) FFN
+            # weights land in the [L, E, ...] stacked expert leaves.
+            # moe_style picks the checkpoint naming (mixtral
+            # block_sparse_moe.w1/w3/w2 vs qwen3_moe
+            # mlp.gate_proj/up_proj/down_proj)
             E = cfg.num_experts
             moe = ("layers", "block", "moe")
-            add(p + "block_sparse_moe.gate.weight",
+            if moe_style == "qwen":
+                mod, wg, wu, wd = ("mlp", "gate_proj", "up_proj",
+                                   "down_proj")
+            else:
+                mod, wg, wu, wd = "block_sparse_moe", "w1", "w3", "w2"
+            add(p + f"{mod}.gate.weight",
                 moe + ("router", "kernel"), i, (E, h),
                 lambda w: np.ascontiguousarray(w.T))
             for j in range(E):
-                q = p + f"block_sparse_moe.experts.{j}."
+                q = p + f"{mod}.experts.{j}."
                 tT = lambda w: np.ascontiguousarray(w.T)
-                add(q + "w1.weight", moe + ("experts/gate",), (i, j),
+                add(q + f"{wg}.weight", moe + ("experts/gate",), (i, j),
                     (inter, h), tT, lead=(L, E))
-                add(q + "w3.weight", moe + ("experts/up",), (i, j),
+                add(q + f"{wu}.weight", moe + ("experts/up",), (i, j),
                     (inter, h), tT, lead=(L, E))
-                add(q + "w2.weight", moe + ("experts/down",), (i, j),
+                add(q + f"{wd}.weight", moe + ("experts/down",), (i, j),
                     (h, inter), tT, lead=(L, E))
         elif packed_mlp:
             # Phi-3: gate_up_proj rows are [gate | up]
@@ -207,6 +215,14 @@ def _detect_packed(names) -> Tuple[bool, bool]:
     pk = any(n.endswith("self_attn.qkv_proj.weight") for n in names)
     pm = any(n.endswith("mlp.gate_up_proj.weight") for n in names)
     return pk, pm
+
+
+def _detect_moe_style(names) -> str:
+    """'qwen' (mlp.experts.N.gate_proj) vs 'mixtral'
+    (block_sparse_moe.experts.N.w1), from checkpoint tensor names."""
+    if any(".mlp.experts." in n for n in names):
+        return "qwen"
+    return "mixtral"
 
 
 def resolve_checkpoint_files(path: str) -> Optional[List[str]]:
@@ -318,7 +334,8 @@ def stream_params(
             with safe_open(fpath, framework="pt") as f:
                 names.extend(f.keys())
     pk, pm = _detect_packed(names)
-    plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm)
+    plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm,
+                          moe_style=_detect_moe_style(names))
 
     params: Dict[str, Any] = {}
     filled: Dict[Tuple[str, ...], np.ndarray] = {}  # stacked-leaf masks
@@ -446,7 +463,8 @@ def validate_checkpoint_header(
     safetensors headers.  This is what the 70B ingestion dryrun runs —
     it needs only the index/header, never the 140 GB of weights."""
     pk, pm = _detect_packed(shapes)
-    plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm)
+    plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm,
+                          moe_style=_detect_moe_style(shapes))
     seen = set()
     for name, shape in shapes.items():
         base = name[6:] if name.startswith("model.") else name

@@ -90,8 +90,17 @@ class MoEMlp(nn.Module):
                           dtype=jnp.float32, param_dtype=cfg.param_dtype,
                           kernel_init=nn.initializers.normal(0.02))
         logits = router(x.astype(jnp.float32))            # [b, s, e]
-        weights, sel = jax.lax.top_k(logits, k)           # [b, s, k]
-        weights = jax.nn.softmax(weights, axis=-1)
+        if cfg.moe_renorm_topk:
+            # mixtral: softmax over the selected logits (== HF's
+            # softmax-then-topk-then-renormalise)
+            weights, sel = jax.lax.top_k(logits, k)       # [b, s, k]
+            weights = jax.nn.softmax(weights, axis=-1)
+        else:
+            # qwen3-moe norm_topk_prob=false: weights are the plain
+            # full-softmax probs of the selected experts (they do NOT
+            # sum to 1); top_k on probs picks the same experts
+            probs = jax.nn.softmax(logits, axis=-1)
+            weights, sel = jax.lax.top_k(probs, k)
 
         init = nn.initializers.normal(0.02)
         w_gate = self.param("experts/gate", init, (e, h, f), cfg.param_dtype)

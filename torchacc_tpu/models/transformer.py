@@ -158,6 +158,11 @@ class ModelConfig:
     # argsort/scatter (no [n, e, cap] materialisation — the Mixtral-scale
     # answer), 'auto' = sort above ~2^24 dispatch elements
     moe_dispatch: str = "auto"
+    # True (mixtral): softmax over the selected top-k logits (equals
+    # HF's softmax-then-topk-then-renormalise).  False (qwen3-moe with
+    # norm_topk_prob=false): combine weights are the UN-renormalised
+    # full-softmax probs of the selected experts.
+    moe_renorm_topk: bool = True
     # None = exact capacity-free dense dispatch (every token through
     # every expert — right for small e).  A float (e.g. 1.25) switches
     # to switch-transformer capacity dispatch: per-expert buffers of
