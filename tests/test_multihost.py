@@ -63,12 +63,13 @@ print(f"proc {pid} ok loss={loss:.4f} primary={is_primary()}", flush=True)
 """
 
 
-def _run_two_procs(mode):
+def _run_two_procs(mode, worker_src=None):
+    worker_src = worker_src or _WORKER
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     procs = [subprocess.Popen(
-        [sys.executable, "-c", _WORKER, str(port), str(i), mode],
+        [sys.executable, "-c", worker_src, str(port), str(i), mode],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     outs = []
@@ -99,6 +100,66 @@ def test_two_process_pp_1f1b_step(tmp_path):
     pp/p2p.py)."""
     outs = _run_two_procs("pp")
     # one SPMD program: both processes report the identical loss
+    l0 = outs[0].split("proc 0 ok loss=")[1].split()[0]
+    l1 = outs[1].split("proc 1 ok loss=")[1].split()[0]
+    assert l0 == l1, (l0, l1)
+
+
+_STREAM_WORKER = """
+import os, sys
+port, pid, path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from torchacc_tpu.parallel.distributed import initialize_distributed
+initialize_distributed(coordinator_address=f"localhost:{port}",
+                       num_processes=2, process_id=pid)
+import numpy as np
+import optax
+import torchacc_tpu as ta
+from torchacc_tpu.train import accelerate
+
+# fsdp=4 spans BOTH processes: every streamed tensor must land with
+# shards on non-addressable devices too
+cfg = ta.Config(dist=ta.DistConfig(fsdp=ta.FSDPConfig(size=4,
+                                                      min_weight_size=0)))
+cfg.compute.dtype = "float32"
+cfg.compute.param_dtype = "float32"
+trainer, _ = accelerate(path, None, cfg, optimizer=optax.sgd(1e-2))
+emb = trainer.state.params["embed_tokens"]["embedding"]
+assert "fsdp" in str(emb.sharding.spec), emb.sharding.spec
+
+from jax.experimental import multihost_utils
+from jax.sharding import PartitionSpec as PS
+# each process feeds its local half of the fsdp-sharded global batch
+local = np.random.default_rng(pid).integers(0, 128, (4, 16)).astype(np.int32)
+arr = multihost_utils.host_local_array_to_global_array(
+    local, trainer.mesh, PS(("dp", "fsdp"), ("sp", "spu")))
+loss = float(trainer.step({"input_ids": arr})["loss"])
+assert np.isfinite(loss), loss
+print(f"proc {pid} ok loss={loss:.4f}", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_streamed_ingestion(tmp_path):
+    """Streamed safetensors ingestion onto a mesh that SPANS processes:
+    every tensor's device_put targets shards this process cannot
+    address — the multi-host half of the 70B ingestion story."""
+    import torch
+    import transformers
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    outs = _run_two_procs(path, worker_src=_STREAM_WORKER)
     l0 = outs[0].split("proc 0 ok loss=")[1].split()[0]
     l1 = outs[1].split("proc 1 ok loss=")[1].split()[0]
     assert l0 == l1, (l0, l1)
