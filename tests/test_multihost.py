@@ -63,13 +63,14 @@ print(f"proc {pid} ok loss={loss:.4f} primary={is_primary()}", flush=True)
 """
 
 
-def _run_two_procs(mode, worker_src=None):
+def _run_two_procs(worker_arg, worker_src=None):
     worker_src = worker_src or _WORKER
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
     procs = [subprocess.Popen(
-        [sys.executable, "-c", worker_src, str(port), str(i), mode],
+        [sys.executable, "-c", worker_src, str(port), str(i),
+         worker_arg],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     outs = []
@@ -115,6 +116,8 @@ jax.config.update("jax_platforms", "cpu")
 from torchacc_tpu.parallel.distributed import initialize_distributed
 initialize_distributed(coordinator_address=f"localhost:{port}",
                        num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, len(jax.devices())
 import numpy as np
 import optax
 import torchacc_tpu as ta
