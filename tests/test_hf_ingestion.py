@@ -540,3 +540,70 @@ def test_qwen3_moe_logits_match():
         assert cfg.ffn_size == 96 and cfg.moe_renorm_topk is ntp
         ids = np.random.default_rng(20).integers(0, 128, size=(2, 16)).astype(np.int32)
         _compare(hf_model, ids, atol=2e-4)
+
+
+def test_gpt2_logits_match():
+    """GPT-2 (the reference's own CLM benchmark model,
+    benchmarks/transformer.py): learned positions, biased LayerNorms,
+    gelu_new MLP, packed Conv1D qkv (columns [q|k|v], weights already
+    [in, out]), biases on every projection, tied head."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        attn_implementation="eager")
+    torch.manual_seed(21)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    assert hf_model.config.model_type == "gpt2"
+    cfg = config_from_hf(hf_cfg)
+    assert (cfg.norm, cfg.activation, cfg.pos_emb) == \
+        ("layernorm", "gelu", "learned")
+    assert cfg.o_bias and cfg.mlp_bias and cfg.qkv_bias \
+        and cfg.tie_embeddings
+    ids = np.random.default_rng(21).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_gpt2_safetensors_falls_back_to_materialising(tmp_path):
+    """A GPT-2 safetensors dir must NOT crash the streamed route: its
+    Conv1D layout is unmappable by the stream plan, so accelerate()
+    falls back to the materialising converter and still trains."""
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64)
+    torch.manual_seed(22)
+    hf_model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    hf_model.save_pretrained(path, safe_serialization=True)
+
+    cfg = ta.Config()
+    cfg.compute.dtype = "float32"
+    cfg.compute.param_dtype = "float32"
+    trainer, _ = accelerate(path, None, cfg, optimizer=optax.adam(1e-3))
+    ids = np.random.default_rng(22).integers(0, 128, size=(8, 16)).astype(np.int32)
+    with torch.no_grad():
+        want = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    got = np.asarray(trainer.model.apply({"params": trainer.state.params},
+                                         jnp.asarray(ids)))
+    np.testing.assert_allclose(got, want, atol=2e-4)
+    assert np.isfinite(float(trainer.step(
+        {"input_ids": jnp.asarray(ids)})["loss"]))
+
+
+def test_llama_attention_and_mlp_bias_logits_match():
+    """attention_bias=True puts a bias on o_proj TOO (unlike qwen2's
+    qkv-only bias) and mlp_bias biases the gate/up/down denses — both
+    must convert, not silently drop."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_bias=True, mlp_bias=True,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(23)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    assert cfg.qkv_bias and cfg.o_bias and cfg.mlp_bias
+    ids = np.random.default_rng(23).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)

@@ -606,3 +606,22 @@ def test_streamed_full_lifecycle(tmp_path, devices):
     assert int(t2.state.step) == 2
     resumed = [float(t2.step(b)["loss"]) for b in batches[2:]]
     np.testing.assert_allclose(cont, resumed, rtol=1e-6)
+
+
+def test_streamed_llama_with_biases(tmp_path):
+    """attention_bias + mlp_bias checkpoints stream (o_proj and mlp
+    bias plan entries)."""
+    hf_cfg = _tiny_llama_cfg(attention_bias=True, mlp_bias=True)
+    torch.manual_seed(12)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    ids = np.random.default_rng(12).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)

@@ -25,8 +25,11 @@ TRANSFORMER_AXES: Tuple[AxesRule, ...] = (
     (r"(q_proj|k_proj|v_proj)/kernel$", ("embed", "heads", "kv")),
     (r"(q_proj|k_proj|v_proj)/bias$", ("heads", "kv")),
     (r"o_proj/kernel$", ("heads", "kv", "embed")),
+    (r"o_proj/bias$", ("embed",)),
     (r"(gate_proj|up_proj)/kernel$", ("embed", "mlp")),
+    (r"(gate_proj|up_proj)/bias$", ("mlp",)),
     (r"down_proj/kernel$", ("mlp", "embed")),
+    (r"down_proj/bias$", ("embed",)),
     (r"router/kernel$", ("embed", "expert")),
     (r"experts/(gate|up)$", ("expert", "embed", "expert_mlp")),
     (r"experts/down$", ("expert", "expert_mlp", "embed")),

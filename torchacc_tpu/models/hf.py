@@ -17,7 +17,10 @@ flat-projection qk-norm), Phi-3/3.5/4-mini (packed qkv/gate_up weights,
 longrope, partial rotary) — the reference's patched set
 (utils/patch.py:224-301) plus the Qwen3/Gemma/Mixtral/OLMo2/Phi-3
 families.  Rope scaling: linear, llama3, longrope, yarn (others fail
-loudly).  GPT-2 uses the 'learned' position variant.
+loudly).  GPT-2 (the reference's own CLM benchmark model,
+benchmarks/transformer.py) converts too: learned positions, biased
+LayerNorms, packed Conv1D qkv, gelu_new, tied head — plus llama
+attention_bias/mlp_bias variants.
 """
 
 from __future__ import annotations
@@ -47,6 +50,10 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         rope_theta=float(get("rope_theta", 10000.0)),
         norm_eps=float(get("rms_norm_eps", 1e-5)),
         qkv_bias=bool(get("attention_bias", False) or mt == "qwen2"),
+        # llama's attention_bias puts a bias on o_proj too (qwen2's qkv
+        # bias does NOT); mlp_bias is llama's separate knob
+        o_bias=bool(get("attention_bias", False)),
+        mlp_bias=bool(get("mlp_bias", False)),
         tie_embeddings=bool(get("tie_word_embeddings", False)),
     )
     if mt == "gemma":
@@ -90,6 +97,26 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
             # reset to 1 in pattern_cfg) — real gemma3 >=4B checkpoints
             # ship factor 8
             kw["rope_scale"] = float(rs["factor"])
+    if mt == "gpt2":
+        # GPT-2 class: learned positions, biased LayerNorms, gelu_new
+        # MLP, packed Conv1D qkv, biases on every projection, tied head.
+        # GPT2Config's attribute_map already aliases hidden_size /
+        # num_attention_heads / num_hidden_layers /
+        # max_position_embeddings onto n_embd / n_head / n_layer /
+        # n_positions, so the generic reads above populated them.
+        act = get("activation_function", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            # our 'gelu' is the tanh approximation; exact-erf gelu or
+            # relu variants would convert silently wrong
+            raise NotImplementedError(
+                f"gpt2 activation_function {act!r} is not implemented "
+                f"(gelu_new is)")
+        kw.update(norm="layernorm", activation="gelu",
+                  pos_emb="learned", qkv_bias=True, o_bias=True,
+                  mlp_bias=True,
+                  norm_eps=float(get("layer_norm_epsilon", 1e-5)))
+        if get("n_inner"):
+            kw["intermediate_size"] = int(get("n_inner"))
     if mt == "phi3":
         # Phi-3/3.5/4-mini: llama-style pre-norm block with PACKED
         # qkv_proj / gate_up_proj weights (split at conversion);
@@ -238,6 +265,70 @@ def _t(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _params_from_gpt2(state_dict, cfg: ModelConfig, dtype):
+    """GPT-2 state dict -> TransformerLM params.  GPT-2 uses Conv1D
+    layers whose weights are already [in, out] (no transpose), a packed
+    c_attn with COLUMNS [q | k | v], and biases everywhere."""
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, d = cfg.num_heads, cfg.head_size
+    f = cfg.ffn_size
+
+    def get(name):
+        for prefix in ("transformer.", ""):
+            if prefix + name in state_dict:
+                return _t(state_dict[prefix + name])
+        raise KeyError(f"missing weight {name!r} in state_dict")
+
+    def stack(fmt, transform):
+        return np.stack([transform(get(fmt.format(i=i))) for i in range(L)])
+
+    # one fetch + torch->numpy conversion of each packed c_attn per
+    # layer (gpt2-xl's is ~29 MB); slice the cached array three ways
+    qw, kw_, vw, qb, kb, vb = ([] for _ in range(6))
+    for i in range(L):
+        w = get(f"h.{i}.attn.c_attn.weight")   # [h, 3h], cols [q|k|v]
+        b = get(f"h.{i}.attn.c_attn.bias")
+        qw.append(w[:, :h].reshape(h, nh, d))
+        kw_.append(w[:, h:2 * h].reshape(h, nh, d))
+        vw.append(w[:, 2 * h:].reshape(h, nh, d))
+        qb.append(b[:h].reshape(nh, d))
+        kb.append(b[h:2 * h].reshape(nh, d))
+        vb.append(b[2 * h:].reshape(nh, d))
+    attn = {
+        "q_proj": {"kernel": np.stack(qw), "bias": np.stack(qb)},
+        "k_proj": {"kernel": np.stack(kw_), "bias": np.stack(kb)},
+        "v_proj": {"kernel": np.stack(vw), "bias": np.stack(vb)},
+        "o_proj": {"kernel": stack("h.{i}.attn.c_proj.weight",
+                                   lambda w: w.reshape(nh, d, h)),
+                   "bias": stack("h.{i}.attn.c_proj.bias", lambda b: b)},
+    }
+    block = {
+        "attn": attn,
+        "mlp": {
+            "up_proj": {"kernel": stack("h.{i}.mlp.c_fc.weight",
+                                        lambda w: w.reshape(h, f)),
+                        "bias": stack("h.{i}.mlp.c_fc.bias", lambda b: b)},
+            "down_proj": {"kernel": stack("h.{i}.mlp.c_proj.weight",
+                                          lambda w: w.reshape(f, h)),
+                          "bias": stack("h.{i}.mlp.c_proj.bias",
+                                        lambda b: b)},
+        },
+        "ln1": {"scale": stack("h.{i}.ln_1.weight", lambda w: w),
+                "bias": stack("h.{i}.ln_1.bias", lambda b: b)},
+        "ln2": {"scale": stack("h.{i}.ln_2.weight", lambda w: w),
+                "bias": stack("h.{i}.ln_2.bias", lambda b: b)},
+    }
+    params: Dict[str, Any] = {
+        "embed_tokens": {"embedding": get("wte.weight")},
+        "pos_embed": get("wpe.weight")[:cfg.max_seq_len],
+        "layers": {"block": block},
+        "final_norm": {"scale": get("ln_f.weight"),
+                       "bias": get("ln_f.bias")},
+    }
+    import jax
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+
 def params_from_hf_state_dict(
     state_dict: Mapping[str, Any],
     cfg: ModelConfig,
@@ -248,8 +339,15 @@ def params_from_hf_state_dict(
     HF linear weights are [out, in]; flax kernels are [in, out] (and
     DenseGeneral splits heads), so weights are transposed/reshaped.
     Layers are stacked on a leading dim for scan-over-layers.
+    GPT-2 checkpoints (Conv1D packed weights, ``transformer.``-prefixed
+    names) take their own mapping.
     """
     dtype = dtype or cfg.param_dtype
+    # the Conv1D-packed c_attn is specific to the gpt2 layout (GPT-J /
+    # GPT-Neo also have wte but different attention naming — those are
+    # unsupported and will fail on their attention tensors loudly)
+    if any(k.endswith("attn.c_attn.weight") for k in state_dict):
+        return _params_from_gpt2(state_dict, cfg, dtype)
     L = cfg.num_layers
     h = cfg.hidden_size
     nh, nk, d = cfg.num_heads, cfg.kv_heads, cfg.head_size
@@ -300,6 +398,9 @@ def params_from_hf_state_dict(
             attn[name]["bias"] = stack(
                 f"layers.{{i}}.self_attn.{name}.bias",
                 lambda b, heads=heads: b.reshape(heads, d))
+    if cfg.o_bias:
+        attn["o_proj"]["bias"] = stack(
+            "layers.{i}.self_attn.o_proj.bias", lambda b: b)
     if cfg.qk_norm:
         attn["q_norm"] = {"scale": stack(
             "layers.{i}.self_attn.q_norm.weight", lambda w: w)}
@@ -369,6 +470,10 @@ def params_from_hf_state_dict(
             "down_proj": {"kernel": stack(
                 "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
         }
+        if cfg.mlp_bias:
+            for nm in ("gate_proj", "up_proj", "down_proj"):
+                block["mlp"][nm]["bias"] = stack(
+                    f"layers.{{i}}.mlp.{nm}.bias", lambda b: b)
     if cfg.sandwich_norms:
         # gemma2 norm naming: post_attention_layernorm is the POST-attn
         # sandwich norm; the pre-mlp norm is pre_feedforward_layernorm

@@ -134,6 +134,9 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
                 add(p + f"self_attn.{nm}.bias", a + (nm, "bias"), i,
                     (heads * d,),
                     lambda b, heads=heads: b.reshape(heads, d))
+        if cfg.o_bias:
+            add(p + "self_attn.o_proj.bias", a + ("o_proj", "bias"), i,
+                (h,), lambda b: b)
         if cfg.qk_norm:
             # per-head-dim (gemma3/qwen3) vs flat-projection (OLMo2)
             qn = (nh * d,) if cfg.qk_norm_proj else (d,)
@@ -185,6 +188,13 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
                 (inter, h), lambda w: np.ascontiguousarray(w.T))
             add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
                 (h, inter), lambda w: np.ascontiguousarray(w.T))
+            if cfg.mlp_bias:
+                add(p + "mlp.gate_proj.bias", m + ("gate_proj", "bias"),
+                    i, (inter,), lambda b: b)
+                add(p + "mlp.up_proj.bias", m + ("up_proj", "bias"), i,
+                    (inter,), lambda b: b)
+                add(p + "mlp.down_proj.bias", m + ("down_proj", "bias"),
+                    i, (h,), lambda b: b)
         b = ("layers", "block")
         if cfg.norm_placement == "post":
             # OLMo2: no input_layernorm; ln1/ln2 are post-sublayer norms
@@ -215,6 +225,16 @@ def _detect_packed(names) -> Tuple[bool, bool]:
     pk = any(n.endswith("self_attn.qkv_proj.weight") for n in names)
     pm = any(n.endswith("mlp.gate_up_proj.weight") for n in names)
     return pk, pm
+
+
+def streamable_names(names) -> bool:
+    """Whether the checkpoint uses the llama-family tensor layout the
+    stream plan maps (separate or phi3-packed attention projections).
+    GPT-2-style checkpoints (Conv1D ``h.N.attn.c_attn``) are NOT — the
+    caller should fall back to the materialising converter."""
+    return any(n.endswith(("self_attn.q_proj.weight",
+                           "self_attn.qkv_proj.weight"))
+               for n in names)
 
 
 def _detect_moe_style(names) -> str:

@@ -49,6 +49,10 @@ class ModelConfig:
     # (ops/fused.py) and the 1F1B last-stage head alike.
     logit_softcap: float = 0.0
     qkv_bias: bool = False                  # Qwen2 style
+    o_bias: bool = False                    # bias on o_proj (llama
+    #                                         attention_bias covers it;
+    #                                         qwen2's does not)
+    mlp_bias: bool = False                  # biases on the mlp denses
     tie_embeddings: bool = False
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
@@ -483,7 +487,8 @@ class Attention(nn.Module):
                     q_offset=pos - (kv_len - s),
                     logit_softcap=cfg.attn_logit_softcap)
                 return nn.DenseGeneral(
-                    features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                    features=cfg.hidden_size, axis=(-2, -1),
+                    use_bias=cfg.o_bias,
                     name="o_proj", dtype=cfg.dtype,
                     param_dtype=cfg.param_dtype,
                     kernel_init=nn.initializers.normal(0.02))(out)
@@ -542,7 +547,8 @@ class Attention(nn.Module):
                             impl=cfg.attention_impl,
                             logit_softcap=cfg.attn_logit_softcap)
         out = nn.DenseGeneral(
-            features=cfg.hidden_size, axis=(-2, -1), use_bias=False,
+            features=cfg.hidden_size, axis=(-2, -1),
+            use_bias=cfg.o_bias,
             name="o_proj", dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02))(out)
         return out
@@ -555,7 +561,7 @@ class Mlp(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         dense = lambda name, feat: nn.Dense(
-            feat, use_bias=False, name=name, dtype=cfg.dtype,
+            feat, use_bias=cfg.mlp_bias, name=name, dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(0.02))
         from torchacc_tpu.parallel.sharding import (

@@ -103,13 +103,20 @@ def accelerate(
                 f"host RAM).  For bounded-memory streamed ingestion, "
                 f"download the snapshot and pass its local path.")
         if stream_files is not None:
+            from torchacc_tpu.models.hf_stream import (
+                checkpoint_tensor_names,
+                streamable_names,
+            )
+            stream_names = checkpoint_tensor_names(model)
+            if stream_names is not None \
+                    and not streamable_names(stream_names):
+                # e.g. GPT-2's Conv1D layout — the stream plan does not
+                # map it; the materialising converter below does
+                stream_files = None
+        if stream_files is not None:
             import transformers
 
             from torchacc_tpu.models.hf import config_from_hf
-            from torchacc_tpu.models.hf_stream import (
-                checkpoint_tensor_names,
-            )
-            stream_names = checkpoint_tensor_names(model)
             mc = config_from_hf(
                 transformers.AutoConfig.from_pretrained(model),
                 dtype=_DTYPES[config.compute.dtype],
