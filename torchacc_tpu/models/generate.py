@@ -229,6 +229,51 @@ def generate(
         raise ValueError(
             f"prompt + max_new_tokens = {total} exceeds the learned "
             f"position table max_seq_len {cfg.max_seq_len}")
+    lr = getattr(cfg, "rope_longrope", None) if can_cache else None
+    if lr is not None and p <= int(lr[2]) < p + max_new_tokens - 1:
+        # Phi-3.5/4 longrope CACHE REBUILD at the original-context
+        # crossing: keys banked under the short factors become invalid
+        # once the sequence exceeds original_max — phi3's intended
+        # behaviour (Phi3ForCausalLM.prepare_inputs_for_generation
+        # invalidates past_key_values at input length original_max+1)
+        # is to re-run the whole prefix under the LONG factors and
+        # continue from that cache.  Decode up to the boundary, then
+        # recurse with the tokens so far as the prompt: the re-prefill's
+        # seq_len exceeds original_max, so it banks long-roped keys.
+        # Hoisted ABOVE the pp / layer_pattern dispatches so every
+        # cached path gets the rebuild (each phase re-enters the full
+        # dispatch).  (transformers 4.57.6's own rebuild runs with a
+        # stale single-element cache_position whose causal mask
+        # degenerates to full attention over the re-fed prefix —
+        # verified acausal; we implement the INTENDED semantics, which
+        # equal HF's correct full forward at every step.)
+        old_len = int(lr[2])
+        n1 = old_len + 1 - p
+        rng, r1, r2 = jax.random.split(rng, 3)
+        first = generate(model, params, prompt_ids, max_new_tokens=n1,
+                         temperature=temperature, rng=r1, eos_id=eos_id,
+                         use_cache=True, prompt_mask=prompt_mask,
+                         top_k=top_k, top_p=top_p)
+        mask2 = None
+        if prompt_mask is not None:
+            mask2 = jnp.concatenate(
+                [jnp.asarray(prompt_mask, jnp.int32),
+                 jnp.ones((b, n1), jnp.int32)], axis=1)
+        out = generate(model, params, first,
+                       max_new_tokens=max_new_tokens - n1,
+                       temperature=temperature, rng=r2, eos_id=eos_id,
+                       use_cache=True, prompt_mask=mask2,
+                       top_k=top_k, top_p=top_p)
+        if eos_id is not None:
+            # rows frozen at eos in phase 1 (their last token is eos:
+            # freezing pins everything after the first eos) must stay
+            # frozen — phase 2 has no done-state and would resume them
+            done1 = first[:, -1] == eos_id
+            tail = jnp.where(done1[:, None], jnp.int32(eos_id),
+                             out[:, p + n1:])
+            out = jnp.concatenate([out[:, :p + n1], tail], axis=1)
+        return out
+
     if (can_cache and pp_live
             and (not cp_cfg or _mesh_extent("sp", "spu") > 1)):
         # pp x cp composes: the cp attention shard_map nests inside the
@@ -256,6 +301,7 @@ def generate(
                  and (not cp_cfg or _mesh_extent("sp", "spu") > 1))
     if can_cache:
         from torchacc_tpu.models.transformer import TransformerLM
+
         # cache_len=total: short generations allocate (and attend over)
         # prompt+new positions, not a max_seq_len-sized cache
         pre_model = TransformerLM(dataclasses.replace(cfg, cache_len=total))
@@ -393,12 +439,32 @@ def _generate_cached_pattern(cfg, params, prompt_ids, prompt_mask, rng,
                                              "top_k", "top_p"))
 def _decode_step(model, params, tokens, mask_full, cur, rng, temperature,
                  top_k, top_p):
-    b = tokens.shape[0]
+    """One full-prefix recompute step over the fixed [b, total] buffer.
+
+    Positions of slots past the live prefix CLAMP to the current
+    position: those slots are causally invisible to the logits read at
+    ``cur - 1``, and clamping keeps length-dependent rope variants
+    (longrope's short/long regime switch keys off ``max(positions)``)
+    seeing the CURRENT sequence length instead of the padded buffer —
+    HF full-forward semantics."""
+    b, total = tokens.shape
     if mask_full is not None:
         positions = jnp.clip(jnp.cumsum(mask_full, axis=1) - 1, 0, None)
+        # per-row cap at the position of the newest live slot (positions
+        # are non-decreasing along the row)
+        cap = jnp.take_along_axis(
+            positions, (cur - 1)[None, None].repeat(b, 0), axis=1)
+        positions = jnp.minimum(positions, cap)
         logits = model.apply({"params": params}, tokens,
                              positions=positions, segment_ids=mask_full)
+    elif getattr(model, "cfg", None) is not None:
+        positions = jnp.minimum(jnp.arange(total), cur - 1)
+        positions = jnp.broadcast_to(positions[None], (b, total))
+        logits = model.apply({"params": params}, tokens,
+                             positions=positions)
     else:
+        # bare (input_ids) -> logits models take no positions kwarg
+        # (and have no length-dependent rope to clamp for)
         logits = model.apply({"params": params}, tokens)
     # logits at position cur-1 predict token cur
     next_logits = jnp.take_along_axis(
