@@ -459,6 +459,21 @@ def test_phi3_logits_match():
     _compare(hf_model, ids, atol=2e-4)
 
 
+def _tiny_phi3_longrope(**kw):
+    d2 = 8
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=96, original_max_position_embeddings=24,
+        pad_token_id=0, tie_word_embeddings=False,
+        attn_implementation="eager",
+        rope_scaling={"type": "longrope",
+                      "short_factor": [1.0 + 0.1 * i for i in range(d2)],
+                      "long_factor": [2.0 + 0.3 * i for i in range(d2)]})
+    base.update(kw)
+    return transformers.Phi3Config(**base)
+
+
 def test_phi3_longrope_and_partial_rotary_logits_match():
     """The REAL Phi-3.5/4 checkpoint shapes: 'longrope' rope_scaling
     (per-dim divisors, long set past the original context, attention
@@ -679,3 +694,49 @@ def test_new_family_cached_decode_matches_recompute(family):
             cur = np.concatenate(
                 [cur, lg.argmax(-1, keepdim=True).numpy()], axis=1)
         np.testing.assert_array_equal(fast, cur)
+
+
+def test_longrope_rebuild_eos_freeze_and_ragged():
+    """The longrope cache-rebuild recursion must keep eos-frozen rows
+    frozen across the phase boundary, and thread ragged prompt masks
+    into phase 2 (generated tokens become real mask entries)."""
+    from torchacc_tpu.models.generate import generate
+
+    hf_cfg = _tiny_phi3_longrope()
+    torch.manual_seed(31)
+    hf_model = transformers.Phi3ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    params = params_from_hf_state_dict(hf_model.state_dict(), cfg)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(31)
+    prompts = jnp.asarray(rng.integers(1, 128, size=(2, 16)), jnp.int32)
+
+    # pick row 0's greedy token at the FIRST decode step as the eos id:
+    # that row freezes immediately, well before the crossing at 24
+    probe = np.asarray(generate(model, params, prompts, max_new_tokens=1))
+    eos = int(probe[0, 16])
+    out = np.asarray(generate(model, params, prompts, max_new_tokens=16,
+                              eos_id=eos))
+    assert (out[0, 16:] == eos).all(), out[0, 16:]
+
+    # ragged: left-pad row 1 by 4; the rebuild must extend the mask and
+    # keep the ragged geometry consistent across the phases
+    padded = np.asarray(prompts).copy()
+    padded[1, :4] = 0
+    padded[1, 4:] = np.asarray(prompts)[1, :12]
+    mask = np.ones((2, 16), np.int32)
+    mask[1, :4] = 0
+    outs = np.asarray(generate(model, params,
+                               jnp.asarray(padded, jnp.int32),
+                               prompt_mask=jnp.asarray(mask),
+                               max_new_tokens=16))
+    assert outs.shape == (2, 32)
+    # row 0 is unpadded: its ragged-mode tokens must equal the plain run
+    plain = np.asarray(generate(model, params, prompts, max_new_tokens=16))
+    np.testing.assert_array_equal(outs[0], plain[0])
+    # row 1's generated tokens must equal an UNPADDED single-row run of
+    # its real 12-token prompt (crossing at a different step than row 0)
+    solo = np.asarray(generate(
+        model, params, jnp.asarray(padded[1:2, 4:], jnp.int32),
+        max_new_tokens=16))
+    np.testing.assert_array_equal(outs[1, 16:], solo[0, 12:])
