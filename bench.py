@@ -160,7 +160,9 @@ class Watchdog:
 
 
 def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
+    """bf16 peak for a jax Device or a device_kind string."""
+    kind = (device if isinstance(device, str)
+            else getattr(device, "device_kind", "")).lower()
     for key, val in _PEAK.items():
         if key in kind:
             return val

@@ -32,20 +32,28 @@ _OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "docs", "bench_8b.json")
 
 
-def build_trainer(n_layers: int, seq: int, batch: int, gc_policy: str,
-                  scan_layers: bool, smoke: bool = False):
-    import optax
-
-    import torchacc_tpu as ta
+def make_config(n_layers: int, seq: int, scan_layers: bool,
+                smoke: bool = False):
+    """The 8B-geometry ModelConfig — single source for both the timed
+    trainer and the report's FLOPs math."""
     from torchacc_tpu.models import get_preset
-    from torchacc_tpu.train import accelerate
 
     kw = dict(num_layers=n_layers, max_seq_len=seq, tie_embeddings=True,
               scan_layers=scan_layers)
     if smoke:  # CPU-sized stand-in exercising the same control flow
         kw.update(hidden_size=256, num_heads=4, num_kv_heads=2,
                   intermediate_size=1024, vocab_size=4096)
-    mc = get_preset("llama3-8b", **kw)
+    return get_preset("llama3-8b", **kw)
+
+
+def build_trainer(n_layers: int, seq: int, batch: int, gc_policy: str,
+                  scan_layers: bool, smoke: bool = False):
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+
+    mc = make_config(n_layers, seq, scan_layers, smoke)
     cfg = ta.Config()
     cfg.memory.gc = True
     cfg.memory.gc_policy = gc_policy
@@ -91,14 +99,39 @@ def main() -> int:
     ap.add_argument("--gc_policy", default="save_attn")
     ap.add_argument("--scan", action="store_true",
                     help="scan-stacked layers (default: unrolled)")
-    ap.add_argument("--depths", type=int, nargs="+", default=[4, 3, 2],
+    ap.add_argument("--depths", type=int, nargs="+", default=[2, 1, 0],
                     help="layer depths to try, deepest first; first two "
-                         "that fit are differenced")
+                         "that fit are differenced.  Depth 0 (embed + "
+                         "fused-CE head only) is a valid rung: L1-L0 "
+                         "isolates exactly one true 8B layer.")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny stand-in geometry for CPU control-flow tests "
                          "(never writes docs/bench_8b.json)")
+    ap.add_argument("--one-depth", type=int, default=None,
+                    help="internal: time ONE depth in this process and "
+                         "print {'_depth', 'dt'}; used by the parent loop "
+                         "so an OOM'd depth's resident buffers (params + "
+                         "opt state survive the failed compile) cannot "
+                         "poison shallower attempts")
     args = ap.parse_args()
+
+    if args.one_depth is not None:
+        wd = Watchdog()
+        jax = _setup_jax(args)
+        try:
+            wd.stage("device_init", 120)
+            kind = getattr(jax.devices()[0], "device_kind", "")
+            dt, _ = run_depth(args.one_depth, args.seq, args.batch,
+                              args.iters, args.gc_policy, args.scan, wd,
+                              args.smoke)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"_depth": args.one_depth,
+                              "error": f"{type(e).__name__}: {e}"}))
+            return 1
+        print(json.dumps({"_depth": args.one_depth, "dt": dt,
+                          "device_kind": kind}))
+        return 0
 
     wd = Watchdog()
     try:
@@ -111,8 +144,7 @@ def main() -> int:
         return 1
 
 
-def _bench(args, wd: Watchdog) -> int:
-    wd.stage("import_jax", 120)
+def _setup_jax(args):
     cache_dir = os.path.expanduser("~/.cache/torchacc_tpu_bench")
     os.makedirs(cache_dir, exist_ok=True)
     import jax
@@ -122,35 +154,78 @@ def _bench(args, wd: Watchdog) -> int:
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    return jax
 
-    wd.stage("devices", 90)
-    dev = jax.devices()[0]
-    peak = peak_flops(dev)
-    print(f"[bench8b] device: {getattr(dev, 'device_kind', dev)}",
-          file=sys.stderr)
 
-    # deepest depth that fits: try descending; OOM -> next
-    depths = args.depths
+def _is_oom(msg: str) -> bool:
+    # The remote-compile tunnel (axon) surfaces HBM OOM as a
+    # JaxRuntimeError INTERNAL/HTTP-500 whose body says "Ran out of
+    # memory in memory space hbm" — match case-insensitively.
+    msg = msg.lower()
+    return ("resource_exhausted" in msg or "out of memory" in msg
+            or "exceeds the limit" in msg or "hbm capacity" in msg)
+
+
+def _bench(args, wd: Watchdog) -> int:
+    import subprocess
+
+    # Deepest two depths that fit, each timed in a FRESH subprocess: a
+    # depth whose compile OOMs leaves its params + opt state resident on
+    # the chip (the failed trainer is unreachable but the device buffers
+    # outlive the exception), which would turn every shallower attempt
+    # into a runtime OOM.  Process isolation makes the attempts
+    # independent; the persistent compile cache keeps retries cheap.
+    # The parent deliberately never initialises a JAX backend: on a
+    # locally-attached TPU (exclusive PJRT ownership, unlike the remote
+    # tunnel) a parent holding the chip would make every child fail.
     results = {}
-    mc = None
-    for L in depths:
+    device_kind = ""
+    for L in args.depths:
         if len(results) == 2:
             break
+        wd.stage(f"subproc_L{L}", 1900)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--one-depth", str(L), "--seq", str(args.seq),
+               "--batch", str(args.batch), "--iters", str(args.iters),
+               "--gc_policy", args.gc_policy]
+        if args.scan:
+            cmd.append("--scan")
+        if args.smoke:
+            cmd.append("--smoke")
+        if args.platform:
+            cmd += ["--platform", args.platform]
         try:
-            dt, mc = run_depth(L, args.seq, args.batch, args.iters,
-                               args.gc_policy, args.scan, wd, args.smoke)
-            results[L] = dt
-            print(f"[bench8b] L={L}: {dt*1e3:.1f} ms/step", file=sys.stderr)
-        except Exception as e:  # noqa: BLE001
-            msg = str(e)
-            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg \
-                    or "exceeds the limit" in msg:
-                print(f"[bench8b] L={L} OOM; trying shallower",
-                      file=sys.stderr)
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=1800)
+        except subprocess.TimeoutExpired:
+            raise RuntimeError(f"depth {L} subprocess hung (1800s)")
+        rec = None
+        for line in r.stdout.splitlines():
+            try:
+                cand = json.loads(line)
+            except ValueError:
                 continue
-            raise
+            if isinstance(cand, dict) and cand.get("_depth") == L:
+                rec = cand
+        if rec is not None and "dt" in rec:
+            results[L] = rec["dt"]
+            device_kind = rec.get("device_kind") or device_kind
+            print(f"[bench8b] L={L}: {rec['dt']*1e3:.1f} ms/step "
+                  f"({device_kind})", file=sys.stderr)
+        elif rec is not None and _is_oom(rec.get("error", "")):
+            print(f"[bench8b] L={L} OOM; trying shallower", file=sys.stderr)
+        elif rec is None and _is_oom(r.stderr or ""):
+            # OOM killed the child before it could print its JSON line
+            # (libtpu fatal abort / watchdog exit mid-OOM-stall).
+            print(f"[bench8b] L={L} OOM (child died); trying shallower",
+                  file=sys.stderr)
+        else:
+            err = (rec or {}).get("error") or r.stderr[-2000:]
+            raise RuntimeError(f"depth {L} subprocess failed: {err}")
     if len(results) < 2:
         raise RuntimeError(f"needed two depths, got {results}")
+    peak = peak_flops(device_kind)
+    mc = make_config(1, args.seq, args.scan, args.smoke)
 
     (L_hi, t_hi), (L_lo, t_lo) = sorted(results.items(), reverse=True)
     t_layer = (t_hi - t_lo) / (L_hi - L_lo)
@@ -186,7 +261,7 @@ def _bench(args, wd: Watchdog) -> int:
             "head_mfu_at_128k_vocab": round(float(mfu_head), 4),
             "gc_policy": args.gc_policy,
             "scan_layers": bool(args.scan),
-            "chip": getattr(dev, "device_kind", str(dev)),
+            "chip": device_kind,
         },
     }
     if not args.smoke:
