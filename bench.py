@@ -355,7 +355,10 @@ def _bench(args, wd: Watchdog) -> int:
         # Greedy KV-cache decode on the SAME trained model: batch 8,
         # prompt 128, 128 new tokens.  _generate_cached is jitted with
         # static model args, so call 1 compiles and call 2 times the
-        # steady-state prefill + decode scan.
+        # steady-state prefill + decode scan.  param_dtype=bf16 is the
+        # serving-precision cast: without it every decode step re-reads
+        # the f32 master weights (1.87 GB at this size) from HBM; bf16
+        # storage halves the traffic of the memory-bound decode loop.
         from torchacc_tpu.models.generate import generate
         dbatch, dprompt, dnew = 8, 128, 128
         prompts = jnp.asarray(
@@ -363,13 +366,22 @@ def _bench(args, wd: Watchdog) -> int:
             jnp.int32)
         try:
             wd.stage("decode_compile", args.compile_budget)
+            # pre-cast ONCE (what a serving loop would do) so the timed
+            # call measures steady state, not the tree cast; the
+            # generate(param_dtype=...) convenience is equivalent
+            # (tests/test_models.py::test_generate_param_dtype_cast) but
+            # re-casts eagerly per call
+            serve_params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                trainer.state.params)
             with jax.sharding.set_mesh(trainer.mesh):
-                out = generate(trainer.model, trainer.state.params,
+                out = generate(trainer.model, serve_params,
                                prompts, max_new_tokens=dnew)
                 jax.block_until_ready(out)
                 wd.stage("decode_timed", 120)
                 t0 = time.perf_counter()
-                out = generate(trainer.model, trainer.state.params,
+                out = generate(trainer.model, serve_params,
                                prompts, max_new_tokens=dnew)
                 jax.block_until_ready(out)
                 ddt = time.perf_counter() - t0

@@ -306,6 +306,28 @@ def test_layer_pattern_generate_cached_matches_recompute(devices):
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
 
+def test_generate_param_dtype_cast(devices):
+    """generate(param_dtype=bf16) == manually pre-cast params: the cast
+    is exactly one tree-wide storage cast (serving precision), applied
+    before dispatch so every decode path sees the same weights."""
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+
+    mc = get_preset("llama-tiny", vocab_size=97, hidden_size=64,
+                    num_layers=2, num_heads=4, num_kv_heads=2,
+                    intermediate_size=128, max_seq_len=64)
+    model = TransformerLM(mc)
+    prompt = jnp.asarray(np.random.default_rng(1).integers(1, 97, (2, 7)),
+                         jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    auto = generate(model, params, prompt, max_new_tokens=8,
+                    param_dtype=jnp.bfloat16)
+    pre = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    manual = generate(model, pre, prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(manual))
+
+
 @pytest.mark.slow
 def test_longrope_composes_with_parallelism(devices):
     """Phi-3.5-style longrope's traced factor switch (jnp.max over
