@@ -319,6 +319,9 @@ def _bench(args, wd: Watchdog) -> int:
     # best measured policy on v5e (docs/PERF.md): saves q/k/v + flash
     # residuals + ffn projections, recompute is elementwise-only
     cfg.memory.gc_policy = "save_attn_mlp"
+    # Megatron-style main-params AMP: bf16 shadow in opt_state kills the
+    # ~2.8 GB/step f32->bf16 param-cast traffic (docs/PERF.md)
+    cfg.compute.bf16_compute_params = True
 
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
     trainer.init()

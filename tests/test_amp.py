@@ -228,3 +228,134 @@ def test_gc_cnt_nonscan_path(devices):
     for b in loader:
         m = trainer.step(b)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_bf16_compute_params_matches_baseline(devices):
+    """The bf16 compute-params shadow (Megatron-style main params,
+    compute.bf16_compute_params): losses track the default path within
+    bf16 noise, step 1 exactly (the shadow IS the cast at init), and the
+    invariant shadow == bf16(cast of the f32 masters) holds bit-exactly
+    through donated steps — for both the plain and grad-accum steps."""
+    import optax
+
+    from torchacc_tpu.train.amp import shadow_params
+
+    mc = _model()
+    batches = list(_batches(5))
+
+    def run(flag, accum=1):
+        cfg = ta.Config(compute=ta.ComputeConfig(bf16_compute_params=flag))
+        cfg.grad_accum = accum
+        tr, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-3))
+        tr.init()
+        return tr, [float(tr.step(b)["loss"]) for b in batches]
+
+    tr0, l0 = run(False)
+    tr1, l1 = run(True)
+    assert l1[0] == l0[0]
+    np.testing.assert_allclose(l1, l0, rtol=2e-3)
+    sh = jax.tree.leaves(shadow_params(tr1.state.opt_state))
+    for s, p in zip(sh, jax.tree.leaves(tr1.state.params)):
+        assert s.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(s, np.float32),
+            np.asarray(p.astype(jnp.bfloat16), np.float32))
+    # masters stay f32 and actually move (training happens on masters)
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree.leaves(tr1.state.params))
+    _, l2 = run(True, accum=2)
+    np.testing.assert_allclose(l2, l0, rtol=2e-3)
+
+
+def test_bf16_compute_params_validation():
+    cfg = ta.Config(compute=ta.ComputeConfig(
+        dtype="float32", bf16_compute_params=True))
+    with pytest.raises(ta.config.ConfigError):
+        cfg.validate()
+
+
+def test_global_norm_f32_accumulates_in_f32():
+    """A large bf16 tree whose squared sum underflows/aggregates badly
+    in bf16 must still produce the f32-exact norm."""
+    from torchacc_tpu.train.amp import global_norm_f32
+    x = jnp.full((1 << 16,), 1e-2, jnp.bfloat16)
+    got = float(global_norm_f32({"w": x}))
+    want = float(np.sqrt((1 << 16) * (float(x[0]) ** 2)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bf16_compute_params_checkpoint_roundtrip(devices, tmp_path):
+    """The shadow rides opt_state through orbax save/restore unchanged
+    (no new checkpoint machinery), and training resumes bit-exact."""
+    import optax
+
+    from torchacc_tpu.train.amp import shadow_params
+
+    mc = _model()
+    cfg = lambda: ta.Config(compute=ta.ComputeConfig(
+        bf16_compute_params=True))
+    batches = list(_batches(4))
+    t, _ = accelerate(mc, None, cfg(), optimizer=optax.adamw(1e-3))
+    t.init()
+    for b in batches[:2]:
+        t.step(b)
+    ck = str(tmp_path / "ck")
+    t.save(ck)
+    cont = [float(t.step(b)["loss"]) for b in batches[2:]]
+
+    t2, _ = accelerate(mc, None, cfg(), optimizer=optax.adamw(1e-3))
+    t2.restore(ck)
+    sh = jax.tree.leaves(shadow_params(t2.state.opt_state))
+    assert all(s.dtype == jnp.bfloat16 for s in sh)
+    resumed = [float(t2.step(b)["loss"]) for b in batches[2:]]
+    assert resumed == cont
+
+
+def test_clip_by_global_norm_f32():
+    """The f32-accumulating clip: equals optax on f32 grads, and stays
+    correct on a large bf16 tree where optax's bf16 norm saturates."""
+    import optax
+
+    from torchacc_tpu.train.schedules import clip_by_global_norm_f32
+
+    rng = np.random.default_rng(0)
+    g32 = {"a": jnp.asarray(rng.normal(0, 1, (257, 129)), jnp.float32),
+           "b": jnp.asarray(rng.normal(0, 1, (63,)), jnp.float32)}
+    ours, _ = clip_by_global_norm_f32(1.0).update(
+        g32, optax.EmptyState(), None)
+    ref, _ = optax.clip_by_global_norm(1.0).update(
+        g32, optax.clip_by_global_norm(1.0).init(g32), None)
+    for a, b in zip(jax.tree.leaves(ours), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+    # 2^20 bf16 values of 0.01: true sumsq = 104.86, bf16 accumulation
+    # saturates far below it — our clip must scale by 1/norm = 0.0977
+    big = {"w": jnp.full((1 << 20,), 1e-2, jnp.bfloat16)}
+    clipped, _ = clip_by_global_norm_f32(1.0).update(
+        big, optax.EmptyState(), None)
+    want_scale = 1.0 / np.sqrt((1 << 20) * 1e-4)
+    got = float(jax.tree.leaves(clipped)[0][0])
+    np.testing.assert_allclose(got, 1e-2 * want_scale, rtol=1e-2)
+
+
+def test_bf16_compute_params_with_clipped_adamw(devices):
+    """The repo's own schedules.adamw (grad_clip_norm=1.0, the HFTrainer
+    default) under the shadow: bf16 grads meet the f32-safe clip, and
+    losses track the unshadowed run within bf16 noise."""
+    from torchacc_tpu.train import schedules
+
+    mc = _model()
+    batches = list(_batches(5))
+
+    def run(flag):
+        cfg = ta.Config(compute=ta.ComputeConfig(bf16_compute_params=flag))
+        tr, _ = accelerate(mc, None, cfg,
+                           optimizer=schedules.adamw(1e-3))
+        tr.init()
+        return [float(tr.step(b)["loss"]) for b in batches]
+
+    l0 = run(False)
+    l1 = run(True)
+    assert l1[0] == l0[0]
+    np.testing.assert_allclose(l1, l0, rtol=2e-3)

@@ -83,10 +83,25 @@ class ComputeConfig:
     deterministic: bool = False
     # 'default' | 'high' | 'highest' — jax default matmul precision
     matmul_precision: str = "default"
+    # Megatron-style main-params AMP: keep a bf16 copy of the f32 master
+    # params in the optimizer state; forward/backward read the copy (no
+    # per-step f32->bf16 cast of the full tree) and gradients flow in
+    # bf16 into per-element optimizer math against f32 moments.  Saves
+    # ~2.8 GB/step of cast traffic at 468M params (docs/PERF.md).
+    # Requires dtype=bfloat16 + param_dtype=float32 (train/amp.py
+    # bf16_param_shadow).
+    bf16_compute_params: bool = False
 
     def validate(self) -> None:
         _check(self.dtype in ("bfloat16", "float16", "float32"),
                f"compute.dtype must be bfloat16|float16|float32, got {self.dtype}")
+        _check(not self.bf16_compute_params
+               or (self.dtype == "bfloat16"
+                   and self.param_dtype == "float32"),
+               "compute.bf16_compute_params requires dtype=bfloat16 "
+               "with param_dtype=float32 (it IS the bf16-compute/"
+               "f32-master split; other combinations have no cast to "
+               "save)")
         _check(self.param_dtype in ("bfloat16", "float32"),
                f"compute.param_dtype must be bfloat16|float32, got {self.param_dtype}")
         _check(self.accum_dtype in ("bfloat16", "float32"),

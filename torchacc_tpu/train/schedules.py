@@ -10,6 +10,8 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
 
@@ -35,6 +37,32 @@ def warmup_linear(peak_lr: float, total_steps: int, warmup_steps: int = 0):
         [warmup_steps])
 
 
+def clip_by_global_norm_f32(max_norm: float) -> optax.GradientTransformation:
+    """`optax.clip_by_global_norm` with the norm accumulated in f32.
+
+    optax's version reduces in the grad dtype — under the bf16
+    compute-params shadow (`compute.bf16_compute_params`) grads arrive
+    bf16, and a bf16 sum over ~1e8 squared values saturates at ~256x its
+    increment, yielding a garbage norm and a garbage clip scale.  The
+    per-element upcast here fuses into the reduce (no materialised f32
+    copy), so the bf16-grad traffic win is preserved."""
+
+    def init(params):
+        del params
+        return optax.EmptyState()
+
+    def update(updates, state, params=None):
+        del params
+        from torchacc_tpu.train.amp import global_norm_f32
+        g_norm = global_norm_f32(updates)
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(g_norm, 1e-16))
+        return (jax.tree.map(lambda g: (g.astype(jnp.float32) * scale)
+                             .astype(g.dtype), updates),
+                state)
+
+    return optax.GradientTransformation(init, update)
+
+
 def adamw(
     lr,
     *,
@@ -45,8 +73,10 @@ def adamw(
     grad_clip_norm: Optional[float] = 1.0,
 ) -> optax.GradientTransformation:
     """AdamW with optional global-norm clipping (the LLM-training
-    default the reference benchmarks use)."""
-    tx = [optax.clip_by_global_norm(grad_clip_norm)] if grad_clip_norm else []
+    default the reference benchmarks use).  The clip accumulates its
+    norm in f32 so the chain is safe under bf16 grad trees
+    (compute.bf16_compute_params)."""
+    tx = [clip_by_global_norm_f32(grad_clip_norm)] if grad_clip_norm else []
     tx.append(optax.adamw(lr, b1=b1, b2=b2, eps=eps,
                           weight_decay=weight_decay))
     return optax.chain(*tx)

@@ -71,6 +71,20 @@ class Trainer:
         self.model = model
         self.config = config
         self.optimizer = optimizer or optax.adamw(1e-4)
+        # bf16 compute-params shadow (config.compute.bf16_compute_params):
+        # wrap BEFORE init so the shadow exists in opt_state from step 0
+        self._shadow_on = config.compute.bf16_compute_params
+        if self._shadow_on:
+            from torchacc_tpu.train.amp import bf16_param_shadow
+            if optimizer is not None:
+                # grads reach the chain in bf16 (grad_accum=1): any
+                # norm-reducing transform must upcast per element —
+                # optax.clip_by_global_norm does NOT
+                logger.info(
+                    "bf16_compute_params with a user optimizer: grads "
+                    "arrive bf16; use schedules.clip_by_global_norm_f32 "
+                    "(not optax.clip_by_global_norm) for norm clipping")
+            self.optimizer = bf16_param_shadow(self.optimizer)
         self.mesh = mesh if mesh is not None else config.get_mesh()
         self.rules = make_rules(config)
         self._axes_rules = axes_rules
@@ -302,7 +316,18 @@ class Trainer:
         from torchacc_tpu.utils.remat import offload_is_live
         offload_live = offload_is_live(self.config.memory)
 
+        shadow_on = self._shadow_on
+
         def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+            # bf16 compute-params: the forward differentiates the bf16
+            # shadow out of opt_state (no full-tree f32->bf16 cast in
+            # the step); the optimizer applies the bf16 grads to the f32
+            # masters and refreshes the shadow (amp.bf16_param_shadow)
+            if shadow_on:
+                from torchacc_tpu.train.amp import shadow_params
+                fwd_params = shadow_params(state.opt_state)
+            else:
+                fwd_params = state.params
             # train steps supply a per-step dropout seed (step * accum,
             # deterministic given the checkpointed step, advanced per
             # accumulation micro-step below so every forward draws a
@@ -339,7 +364,7 @@ class Trainer:
                 def micro(carry, xs):
                     mb, mi = xs
                     g_acc, l_acc, c_acc = carry
-                    (l, c), g = grad_sum(state.params, mb, mi)
+                    (l, c), g = grad_sum(fwd_params, mb, mi)
                     return (jax.tree.map(
                                 lambda a, b: a + b.astype(acc_dt), g_acc, g),
                             l_acc + l, c_acc + c), None
@@ -364,7 +389,7 @@ class Trainer:
                 def scalar(p):
                     l, c = fsc(p, batch)
                     return (l / jnp.maximum(c, 1.0)) * scale
-                loss_s, grads = jax.value_and_grad(scalar)(state.params)
+                loss_s, grads = jax.value_and_grad(scalar)(fwd_params)
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 loss_val = loss_s / scale
 
@@ -391,9 +416,12 @@ class Trainer:
                     grads, state.opt_state, state.params)
                 new_params = optax.apply_updates(state.params, updates)
 
+            from torchacc_tpu.train.amp import global_norm_f32
             metrics = {
                 "loss": loss_val,
-                "grad_norm": optax.global_norm(grads),
+                # f32-accumulated: bf16 grad trees (shadow mode) would
+                # otherwise norm-reduce in bf16
+                "grad_norm": global_norm_f32(grads),
             }
             if use_scaler:
                 metrics["loss_scale"] = new_scaler["scale"]
