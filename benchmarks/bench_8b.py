@@ -47,7 +47,8 @@ def make_config(n_layers: int, seq: int, scan_layers: bool,
 
 
 def build_trainer(n_layers: int, seq: int, batch: int, gc_policy: str,
-                  scan_layers: bool, smoke: bool = False):
+                  scan_layers: bool, smoke: bool = False,
+                  shadow: bool = True):
     import optax
 
     import torchacc_tpu as ta
@@ -57,6 +58,11 @@ def build_trainer(n_layers: int, seq: int, batch: int, gc_policy: str,
     cfg = ta.Config()
     cfg.memory.gc = True
     cfg.memory.gc_policy = gc_policy
+    # same main-params AMP as the headline bench (docs/PERF.md): at this
+    # geometry the f32->bf16 cast it removes is ~3 GB/step for the
+    # 525M-param embed/head alone.  --no-shadow reproduces the
+    # pre-shadow baseline rows.
+    cfg.compute.bf16_compute_params = shadow
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
     trainer.init()
     return trainer, mc
@@ -75,13 +81,13 @@ def time_step(trainer, batch_data, iters: int, warmup: int = 2) -> float:
 
 
 def run_depth(n_layers, seq, batch, iters, gc_policy, scan_layers, wd,
-              smoke=False):
+              smoke=False, shadow=True):
     import jax.numpy as jnp
     import numpy as np
 
     wd.stage(f"build_L{n_layers}", 180)
     trainer, mc = build_trainer(n_layers, seq, batch, gc_policy, scan_layers,
-                                smoke)
+                                smoke, shadow)
     rng = np.random.default_rng(0)
     batch_data = {"input_ids": jnp.asarray(
         rng.integers(0, mc.vocab_size, size=(batch, seq)), jnp.int32)}
@@ -108,6 +114,9 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="tiny stand-in geometry for CPU control-flow tests "
                          "(never writes docs/bench_8b.json)")
+    ap.add_argument("--no-shadow", action="store_true",
+                    help="disable compute.bf16_compute_params (the "
+                         "pre-shadow baseline precision mode)")
     ap.add_argument("--one-depth", type=int, default=None,
                     help="internal: time ONE depth in this process and "
                          "print {'_depth', 'dt'}; used by the parent loop "
@@ -124,7 +133,7 @@ def main() -> int:
             kind = getattr(jax.devices()[0], "device_kind", "")
             dt, _ = run_depth(args.one_depth, args.seq, args.batch,
                               args.iters, args.gc_policy, args.scan, wd,
-                              args.smoke)
+                              args.smoke, shadow=not args.no_shadow)
         except Exception as e:  # noqa: BLE001
             print(json.dumps({"_depth": args.one_depth,
                               "error": f"{type(e).__name__}: {e}"}))
@@ -188,6 +197,8 @@ def _bench(args, wd: Watchdog) -> int:
                "--one-depth", str(L), "--seq", str(args.seq),
                "--batch", str(args.batch), "--iters", str(args.iters),
                "--gc_policy", args.gc_policy]
+        if args.no_shadow:
+            cmd.append("--no-shadow")
         if args.scan:
             cmd.append("--scan")
         if args.smoke:
@@ -261,6 +272,7 @@ def _bench(args, wd: Watchdog) -> int:
             "head_mfu_at_128k_vocab": round(float(mfu_head), 4),
             "gc_policy": args.gc_policy,
             "scan_layers": bool(args.scan),
+            "bf16_compute_params": not args.no_shadow,
             "chip": device_kind,
         },
     }

@@ -359,3 +359,28 @@ def test_bf16_compute_params_with_clipped_adamw(devices):
     l1 = run(True)
     assert l1[0] == l0[0]
     np.testing.assert_allclose(l1, l0, rtol=2e-3)
+
+
+def test_bf16_compute_params_sharded_like_masters(devices):
+    """Under fsdp x tp the shadow leaves (matched to params by
+    state_logical_axes' trailing-path rule) carry the SAME PartitionSpec
+    as their masters, and sharded training runs."""
+    import optax
+
+    from torchacc_tpu.train.amp import shadow_params
+
+    mc = _model()
+    cfg = ta.Config(
+        dist=ta.DistConfig(fsdp=ta.FSDPConfig(size=4, min_weight_size=0),
+                           tp=ta.TPConfig(size=2)),
+        compute=ta.ComputeConfig(bf16_compute_params=True))
+    tr, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-3))
+    tr.init()
+    rng = np.random.default_rng(0)
+    b = {"input_ids": rng.integers(0, 128, size=(8, 32)).astype(np.int32)}
+    losses = [float(tr.step(b)["loss"]) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    for s, p in zip(jax.tree.leaves(shadow_params(tr.state.opt_state)),
+                    jax.tree.leaves(tr.state.params)):
+        assert s.dtype == jnp.bfloat16
+        assert s.sharding.spec == p.sharding.spec, (s.sharding, p.sharding)
