@@ -59,6 +59,9 @@ def parse_args(argv=None):
     p.add_argument("--attn_dropout", type=float, default=0.0)
     p.add_argument("--fp16", action="store_true")
     p.add_argument("--fp32", action="store_true")
+    p.add_argument("--bf16_shadow", action="store_true",
+                   help="compute.bf16_compute_params: bf16 param shadow "
+                        "in opt state (main-params AMP, docs/PERF.md)")
     p.add_argument("--no_flash", action="store_true")
     p.add_argument("--grad_accum", type=int, default=1)
     p.add_argument("--profile", default=None, metavar="LOGDIR")
@@ -73,7 +76,8 @@ def _config_from_flags(args, dtype):
     import torchacc_tpu as ta
     return ta.Config(
         compute=ta.ComputeConfig(dtype=dtype,
-                                 flash_attention=not args.no_flash),
+                                 flash_attention=not args.no_flash,
+                                 bf16_compute_params=args.bf16_shadow),
         memory=ta.MemoryConfig(gc=args.gc, gc_policy=args.gc_policy,
                                gc_cnt=args.gc_cnt,
                                offload_activations=args.offload_activations),

@@ -1,0 +1,93 @@
+"""Serving example: load a checkpoint (HF safetensors dir, orbax dir, or
+a fresh random model), cast to serving precision ONCE, and batch-decode
+prompts through the jitted KV-cache path.
+
+The reference defers all inference to vLLM; here decode is a product
+surface: prefill + single-scan greedy/top-p decode, ragged LEFT-padded
+batches, sliding-window/ALiBi/longrope models, pp stage-ring and
+cp sharded-cache paths (models/generate.py).
+
+Run:
+  python examples/serve_generate.py                       # random tiny model
+  python examples/serve_generate.py --hf_path /path/to/llama \
+      --prompt "The capital of France is" --max_new 64
+
+Serving precision (docs/PERF.md): training keeps f32 master weights, so
+decoding against them reads twice the bytes per step.  The one-time
+bf16 cast below measured +9% decode throughput at 468M on v5e (more at
+larger models, where decode is purely parameter-bandwidth-bound).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--hf_path", default=None,
+                   help="HF checkpoint dir (safetensors stream-ingested)")
+    p.add_argument("--prompt", nargs="*", default=["Once upon a time",
+                                                   "The TPU is"])
+    p.add_argument("--max_new", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top_p", type=float, default=1.0)
+    args = p.parse_args()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, generate, get_preset
+    from torchacc_tpu.train import accelerate
+
+    if args.hf_path:
+        # one-call ingestion: resolves shardings, streams safetensors
+        # shard-by-shard into them, and initialises trainer.state.
+        # optax.identity() keeps serving memory flat: the default adamw
+        # would allocate two f32 moment trees decode never reads.
+        import optax
+        trainer, _ = accelerate(args.hf_path, None, ta.Config(),
+                                optimizer=optax.identity())
+        model, params = trainer.model, trainer.state.params
+        from transformers import AutoTokenizer
+        tok = AutoTokenizer.from_pretrained(args.hf_path)
+        if tok.pad_token is None:
+            # pad ids never reach the model (prompt_mask masks them)
+            tok.pad_token = tok.eos_token
+        tok.padding_side = "left"  # generate()'s decode convention
+        enc = tok(args.prompt, return_tensors="np", padding=True)
+        ids = jnp.asarray(enc["input_ids"], jnp.int32)
+        mask = jnp.asarray(enc["attention_mask"], jnp.int32)
+    else:
+        mc = get_preset("llama-tiny", vocab_size=256, hidden_size=64,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        intermediate_size=128)
+        model = TransformerLM(mc)
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(1, 256, (len(args.prompt), 8)),
+                          jnp.int32)
+        mask = None
+        import jax
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        tok = None
+
+    # serving-precision cast happens once, inside generate()
+    out = generate(model, params, ids, prompt_mask=mask,
+                   max_new_tokens=args.max_new,
+                   temperature=args.temperature, top_p=args.top_p,
+                   param_dtype=jnp.bfloat16)
+    out = np.asarray(out)
+    for i, row in enumerate(out):
+        text = (tok.decode(row, skip_special_tokens=True)
+                if tok is not None else row.tolist())
+        print(f"[{i}] {text}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
