@@ -201,9 +201,14 @@ def test_offload_placement_executes(chip):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_train_step_and_decode(chip):
+@pytest.mark.parametrize("shadow", [False, True],
+                         ids=["default", "bf16_shadow"])
+def test_train_step_and_decode(chip, shadow):
     """One real optimizer step on the chip (finite loss, loss drops over
-    a few repeats of the same batch) and a cached greedy decode."""
+    a few repeats of the same batch) and a cached greedy decode — in the
+    default precision mode and in the headline bench's
+    compute.bf16_compute_params mode (bf16 shadow leaves in opt state,
+    serving-cast decode against the f32 masters)."""
     import optax
 
     import torchacc_tpu as ta
@@ -214,7 +219,7 @@ def test_train_step_and_decode(chip):
     mc = get_preset("llama-tiny", hidden_size=256, num_layers=2,
                     num_heads=4, num_kv_heads=4, intermediate_size=512,
                     vocab_size=1024, max_seq_len=256)
-    cfg = ta.Config()
+    cfg = ta.Config(compute=ta.ComputeConfig(bf16_compute_params=shadow))
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-3))
     trainer.init()
     rng = np.random.default_rng(4)
@@ -223,10 +228,15 @@ def test_train_step_and_decode(chip):
     losses = [float(trainer.step(batch)["loss"]) for _ in range(8)]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+    if shadow:
+        from torchacc_tpu.train.amp import shadow_params
+        sh = jax.tree.leaves(shadow_params(trainer.state.opt_state))
+        assert all(x.dtype == jnp.bfloat16 for x in sh)
 
     prompts = jnp.asarray(rng.integers(0, 1024, size=(2, 16)), jnp.int32)
+    decode_kwargs = {"param_dtype": jnp.bfloat16} if shadow else {}
     with jax.sharding.set_mesh(trainer.mesh):
         toks = generate(trainer.model, trainer.state.params, prompts,
-                        max_new_tokens=8)
+                        max_new_tokens=8, **decode_kwargs)
     assert toks.shape == (2, 16 + 8)
     assert bool(jnp.all(toks[:, :16] == prompts))
