@@ -384,3 +384,24 @@ def test_bf16_compute_params_sharded_like_masters(devices):
                     jax.tree.leaves(tr.state.params)):
         assert s.dtype == jnp.bfloat16
         assert s.sharding.spec == p.sharding.spec, (s.sharding, p.sharding)
+
+
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_bf16_compute_params_under_pp(devices, sched):
+    """The shadow composes with both pipeline schedules: the forward
+    reads bf16 shadow params through the stage ring (pp's custom VJP
+    hands the optimizer f32-cast grads, so only the fwd cast is saved
+    there — still the bulk of the win)."""
+    import optax
+
+    mc = _model()
+    cfg = ta.Config(
+        dist=ta.DistConfig(pp=ta.PPConfig(size=2, num_micro_batches=4,
+                                          schedule=sched)),
+        compute=ta.ComputeConfig(bf16_compute_params=True))
+    tr, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-3))
+    tr.init()
+    rng = np.random.default_rng(0)
+    b = {"input_ids": rng.integers(0, 128, size=(8, 32)).astype(np.int32)}
+    losses = [float(tr.step(b)["loss"]) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
