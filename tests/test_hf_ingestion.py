@@ -740,3 +740,37 @@ def test_longrope_rebuild_eos_freeze_and_ragged():
         model, params, jnp.asarray(padded[1:2, 4:], jnp.int32),
         max_new_tokens=16))
     np.testing.assert_array_equal(outs[1, 16:], solo[0, 12:])
+
+
+def test_starcoder2_logits_match():
+    """StarCoder2: rope + GQA + biased LayerNorms + NON-gated
+    gelu_pytorch_tanh MLP (c_fc/c_proj) + use_bias on every projection +
+    tied embeddings; the 7B/15B sliding_window rides the generic window
+    read.  Reference has no starcoder patch — zoo-beyond-reference
+    family."""
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, norm_epsilon=1e-5,
+        tie_word_embeddings=True, attn_implementation="eager",
+        residual_dropout=0.0, embedding_dropout=0.0)
+    torch.manual_seed(7)
+    hf_model = transformers.Starcoder2ForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "starcoder2"
+    ids = np.random.default_rng(7).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_starcoder2_sliding_window_logits_match():
+    """The 7B-style config: sliding_window=8 on a 16-token input makes
+    the window genuinely bind."""
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        tie_word_embeddings=True, attn_implementation="eager",
+        residual_dropout=0.0, embedding_dropout=0.0)
+    torch.manual_seed(8)
+    hf_model = transformers.Starcoder2ForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(8).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)

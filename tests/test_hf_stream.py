@@ -625,3 +625,31 @@ def test_streamed_llama_with_biases(tmp_path):
     with torch.no_grad():
         theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_streamed_starcoder2(tmp_path):
+    """StarCoder2 streams: non-gated c_fc/c_proj MLP entries, biased
+    LayerNorm entries (ln1/ln2/final_norm .bias leaves), biases on every
+    projection, tied embeddings."""
+    hf_cfg = transformers.Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, norm_epsilon=1e-5,
+        tie_word_embeddings=True, attn_implementation="eager",
+        residual_dropout=0.0, embedding_dropout=0.0)
+    torch.manual_seed(9)
+    hf_model = transformers.Starcoder2ForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    assert cfg.norm == "layernorm" and cfg.activation == "gelu"
+    assert "bias" in params["final_norm"]
+    assert "gate_proj" not in params["layers"]["block"]["mlp"]
+    ids = np.random.default_rng(9).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)

@@ -117,6 +117,20 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
                   norm_eps=float(get("layer_norm_epsilon", 1e-5)))
         if get("n_inner"):
             kw["intermediate_size"] = int(get("n_inner"))
+    if mt == "starcoder2":
+        # StarCoder2 (3B/7B/15B): rope + GQA + biased LayerNorms +
+        # NON-gated gelu_pytorch_tanh MLP named c_fc/c_proj + one
+        # use_bias knob driving qkv/o/mlp biases; 7B/15B configs carry
+        # sliding_window (picked up by the generic read below)
+        act = get("hidden_act", "gelu_pytorch_tanh")
+        if act not in ("gelu_pytorch_tanh", "gelu_new"):
+            raise NotImplementedError(
+                f"starcoder2 hidden_act {act!r} is not implemented "
+                f"(gelu_pytorch_tanh is)")
+        bias = bool(get("use_bias", True))
+        kw.update(norm="layernorm", activation="gelu",
+                  qkv_bias=bias, o_bias=bias, mlp_bias=bias,
+                  norm_eps=float(get("norm_epsilon", 1e-5)))
     if mt == "phi3":
         # Phi-3/3.5/4-mini: llama-style pre-norm block with PACKED
         # qkv_proj / gate_up_proj weights (split at conversion);
@@ -461,6 +475,20 @@ def params_from_hf_state_dict(
             "down_proj": {"kernel": stack(
                 "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
         }
+    elif has("layers.0.mlp.c_fc.weight"):
+        # StarCoder2 NON-gated MLP: c_fc -> up_proj, c_proj -> down_proj
+        # (activation='gelu' builds no gate_proj)
+        block["mlp"] = {
+            "up_proj": {"kernel": stack(
+                "layers.{i}.mlp.c_fc.weight", lambda w: w.T)},
+            "down_proj": {"kernel": stack(
+                "layers.{i}.mlp.c_proj.weight", lambda w: w.T)},
+        }
+        if cfg.mlp_bias:
+            block["mlp"]["up_proj"]["bias"] = stack(
+                "layers.{i}.mlp.c_fc.bias", lambda b: b)
+            block["mlp"]["down_proj"]["bias"] = stack(
+                "layers.{i}.mlp.c_proj.bias", lambda b: b)
     else:
         block["mlp"] = {
             "gate_proj": {"kernel": stack(
@@ -490,6 +518,13 @@ def params_from_hf_state_dict(
         "layers": {"block": block},
         "final_norm": {"scale": get("norm.weight")},
     }
+    if cfg.norm == "layernorm":
+        # biased LayerNorms (StarCoder2): same source names, .bias leaf
+        block["ln1"]["bias"] = stack(
+            ln1_src.replace(".weight", ".bias"), lambda b: b)
+        block["ln2"]["bias"] = stack(
+            ln2_src.replace(".weight", ".bias"), lambda b: b)
+        params["final_norm"]["bias"] = get("norm.bias")
     if not cfg.tie_embeddings:
         # lm_head lives at the top level in HF models
         head = state_dict.get("lm_head.weight")

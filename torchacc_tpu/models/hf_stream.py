@@ -60,7 +60,8 @@ class PlanEntry(NamedTuple):
 
 
 def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
-                   packed_mlp: bool = False, moe_style: str = "mixtral"
+                   packed_mlp: bool = False, nongated_mlp: bool = False,
+                   moe_style: str = "mixtral"
                    ) -> Dict[str, Tuple[PlanEntry, ...]]:
     """HF tensor name (without the ``model.`` prefix) -> tuple of
     PlanEntries for the llama/qwen2/qwen3/mistral/gemma/mixtral/olmo2/
@@ -93,6 +94,9 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
     add("embed_tokens.weight", ("embed_tokens", "embedding"), None,
         (v, h), lambda w: w)
     add("norm.weight", ("final_norm", "scale"), None, (h,), lambda w: w)
+    ln_bias = cfg.norm == "layernorm"   # biased LayerNorms (StarCoder2)
+    if ln_bias:
+        add("norm.bias", ("final_norm", "bias"), None, (h,), lambda b: b)
     if not cfg.tie_embeddings:
         add("lm_head.weight", ("lm_head", "kernel"), None, (v, h),
             lambda w: np.ascontiguousarray(w.T))
@@ -180,6 +184,19 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
                 lambda w: np.ascontiguousarray(w[inter:].T))
             add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
                 (h, inter), lambda w: np.ascontiguousarray(w.T))
+        elif nongated_mlp:
+            # StarCoder2 NON-gated MLP: c_fc -> up_proj, c_proj ->
+            # down_proj (activation='gelu' builds no gate_proj)
+            m = ("layers", "block", "mlp")
+            add(p + "mlp.c_fc.weight", m + ("up_proj", "kernel"), i,
+                (inter, h), lambda w: np.ascontiguousarray(w.T))
+            add(p + "mlp.c_proj.weight", m + ("down_proj", "kernel"), i,
+                (h, inter), lambda w: np.ascontiguousarray(w.T))
+            if cfg.mlp_bias:
+                add(p + "mlp.c_fc.bias", m + ("up_proj", "bias"), i,
+                    (inter,), lambda b: b)
+                add(p + "mlp.c_proj.bias", m + ("down_proj", "bias"), i,
+                    (h,), lambda b: b)
         else:
             m = ("layers", "block", "mlp")
             add(p + "mlp.gate_proj.weight", m + ("gate_proj", "kernel"), i,
@@ -205,6 +222,11 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
             continue
         add(p + "input_layernorm.weight", b + ("ln1", "scale"), i, (h,),
             lambda w: w)
+        if ln_bias and not cfg.sandwich_norms:
+            add(p + "input_layernorm.bias", b + ("ln1", "bias"), i, (h,),
+                lambda bb: bb)
+            add(p + "post_attention_layernorm.bias", b + ("ln2", "bias"),
+                i, (h,), lambda bb: bb)
         if cfg.sandwich_norms:
             add(p + "post_attention_layernorm.weight",
                 b + ("ln1_post", "scale"), i, (h,), lambda w: w)
@@ -225,6 +247,11 @@ def _detect_packed(names) -> Tuple[bool, bool]:
     pk = any(n.endswith("self_attn.qkv_proj.weight") for n in names)
     pm = any(n.endswith("mlp.gate_up_proj.weight") for n in names)
     return pk, pm
+
+
+def _detect_nongated(names) -> bool:
+    """StarCoder2's non-gated MLP naming (mlp.c_fc / mlp.c_proj)."""
+    return any(n.endswith("mlp.c_fc.weight") for n in names)
 
 
 def streamable_names(names) -> bool:
@@ -355,6 +382,7 @@ def stream_params(
                 names.extend(f.keys())
     pk, pm = _detect_packed(names)
     plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm,
+                          nongated_mlp=_detect_nongated(names),
                           moe_style=_detect_moe_style(names))
 
     params: Dict[str, Any] = {}
@@ -484,6 +512,7 @@ def validate_checkpoint_header(
     it needs only the index/header, never the 140 GB of weights."""
     pk, pm = _detect_packed(shapes)
     plan = ingestion_plan(cfg, packed_qkv=pk, packed_mlp=pm,
+                          nongated_mlp=_detect_nongated(shapes),
                           moe_style=_detect_moe_style(shapes))
     seen = set()
     for name, shape in shapes.items():
