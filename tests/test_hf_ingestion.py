@@ -774,3 +774,83 @@ def test_starcoder2_sliding_window_logits_match():
     hf_model = transformers.Starcoder2ForCausalLM(hf_cfg).eval()
     ids = np.random.default_rng(8).integers(0, 128, size=(2, 16)).astype(np.int32)
     _compare(hf_model, ids, atol=2e-4)
+
+
+def test_phi2_logits_match():
+    """Phi-1/1.5/2 (model_type 'phi'): PARALLEL residual block
+    (x + attn(ln(x)) + mlp(ln(x)), one shared biased LayerNorm, no
+    ln2), partial rotary, gelu_new fc1/fc2 MLP, self_attn.dense output
+    projection, final_layernorm, and a BIASED lm_head (which routes the
+    trainer off the fused-CE path)."""
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        tie_word_embeddings=False, attn_implementation="eager",
+        resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)
+    torch.manual_seed(11)
+    hf_model = transformers.PhiForCausalLM(hf_cfg).eval()
+    # HF zero-inits the lm_head bias; randomise it so a conversion that
+    # DROPPED the bias would actually fail
+    with torch.no_grad():
+        hf_model.lm_head.bias.normal_(0, 0.5)
+    assert hf_model.config.model_type == "phi"
+    ids = np.random.default_rng(11).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_phi2_trains_and_decodes(devices):
+    """The parallel-block + head-bias model trains through the
+    (unfused-head) trainer path and decodes through the cache; the 1F1B
+    last-stage head applies the lm_head BIAS too (step-1 loss parity vs
+    the non-pp path at f32 — a biasless pp head would differ by the
+    bias vector)."""
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import generate
+    from torchacc_tpu.train import accelerate
+
+    hf_cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+        resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0)
+    torch.manual_seed(12)
+    hf_model = transformers.PhiForCausalLM(hf_cfg).eval()
+    with torch.no_grad():   # zero-init bias would make the legs below
+        hf_model.lm_head.bias.normal_(0, 0.5)   # insensitive to a drop
+    f32 = ta.ComputeConfig(dtype="float32")
+    tr, _ = accelerate(hf_model, None, ta.Config(compute=f32),
+                       optimizer=optax.adamw(1e-3))
+    assert not tr._use_fused_ce
+    rng = np.random.default_rng(12)
+    b = {"input_ids": rng.integers(1, 128, size=(8, 16)).astype(np.int32)}
+    losses = [float(tr.step(b)["loss"]) for _ in range(3)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    prompts = jnp.asarray(rng.integers(1, 128, (2, 8)), jnp.int32)
+    with jax.sharding.set_mesh(tr.mesh):
+        out = generate(tr.model, tr.state.params, prompts, max_new_tokens=6)
+    assert out.shape == (2, 14)
+    assert bool(jnp.all(out[:, :8] == prompts))
+
+    tr_pp, _ = accelerate(
+        hf_model, None,
+        ta.Config(compute=f32,
+                  dist=ta.DistConfig(pp=ta.PPConfig(
+                      size=2, num_micro_batches=4, schedule="1f1b"))),
+        optimizer=optax.adamw(1e-3))
+    # pp stage-ring decode applies the head BIAS too (head_logits):
+    # same greedy tokens as a fresh non-pp conversion of the same model
+    tr2, _ = accelerate(hf_model, None, ta.Config(compute=f32),
+                        optimizer=optax.adamw(1e-3))
+    with jax.sharding.set_mesh(tr2.mesh):
+        ref_toks = generate(tr2.model, tr2.state.params, prompts,
+                            max_new_tokens=6)
+    with jax.sharding.set_mesh(tr_pp.mesh):
+        pp_toks = generate(tr_pp.model, tr_pp.state.params, prompts,
+                           max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
+    np.testing.assert_allclose(float(tr_pp.step(b)["loss"]), losses[0],
+                               rtol=1e-5)

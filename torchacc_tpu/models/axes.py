@@ -36,6 +36,7 @@ TRANSFORMER_AXES: Tuple[AxesRule, ...] = (
     (r"(ln1|ln2|ln1_post|ln2_post|final_norm|q_norm|k_norm)/(scale|bias)$",
      ("norm",)),
     (r"lm_head/kernel$", ("embed", "vocab")),
+    (r"lm_head/bias$", ("vocab",)),
 )
 
 

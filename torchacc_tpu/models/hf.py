@@ -131,6 +131,25 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         kw.update(norm="layernorm", activation="gelu",
                   qkv_bias=bias, o_bias=bias, mlp_bias=bias,
                   norm_eps=float(get("norm_epsilon", 1e-5)))
+    if mt == "phi":
+        # Phi-1/1.5/2: PARALLEL residual (x + attn(ln(x)) + mlp(ln(x)),
+        # one shared biased LayerNorm, no ln2), partial rotary,
+        # gelu_new fc1/fc2 MLP, biases everywhere INCLUDING the lm_head
+        act = get("hidden_act", "gelu_new")
+        if act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise NotImplementedError(
+                f"phi hidden_act {act!r} is not implemented (gelu_new is)")
+        if kw.get("tie_embeddings"):
+            # HF ties only lm_head.weight; its bias would survive in the
+            # state dict with no tied-head slot to land in — converting
+            # would silently drop it
+            raise NotImplementedError(
+                "phi with tie_word_embeddings=True is not supported "
+                "(the biased lm_head cannot ride the tied head)")
+        kw.update(norm="layernorm", activation="gelu", parallel_block=True,
+                  qkv_bias=True, o_bias=True, mlp_bias=True, head_bias=True,
+                  norm_eps=float(get("layer_norm_eps", 1e-5)),
+                  partial_rotary=float(get("partial_rotary_factor", 0.5)))
     if mt == "phi3":
         # Phi-3/3.5/4-mini: llama-style pre-norm block with PACKED
         # qkv_proj / gate_up_proj weights (split at conversion);
@@ -404,8 +423,11 @@ def params_from_hf_state_dict(
             "v_proj": {"kernel": stack("layers.{i}.self_attn.v_proj.weight",
                                        lambda w: qkv(w, nk))},
         }
+    # phi names the output projection self_attn.dense
+    o_name = ("dense" if has("layers.0.self_attn.dense.weight")
+              else "o_proj")
     attn["o_proj"] = {"kernel": stack(
-        "layers.{i}.self_attn.o_proj.weight",
+        f"layers.{{i}}.self_attn.{o_name}.weight",
         lambda w: w.T.reshape(nh, d, h))}
     if cfg.qkv_bias:
         for name, heads in (("q_proj", nh), ("k_proj", nk), ("v_proj", nk)):
@@ -414,7 +436,7 @@ def params_from_hf_state_dict(
                 lambda b, heads=heads: b.reshape(heads, d))
     if cfg.o_bias:
         attn["o_proj"]["bias"] = stack(
-            "layers.{i}.self_attn.o_proj.bias", lambda b: b)
+            f"layers.{{i}}.self_attn.{o_name}.bias", lambda b: b)
     if cfg.qk_norm:
         attn["q_norm"] = {"scale": stack(
             "layers.{i}.self_attn.q_norm.weight", lambda w: w)}
@@ -475,20 +497,23 @@ def params_from_hf_state_dict(
             "down_proj": {"kernel": stack(
                 "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
         }
-    elif has("layers.0.mlp.c_fc.weight"):
-        # StarCoder2 NON-gated MLP: c_fc -> up_proj, c_proj -> down_proj
+    elif has("layers.0.mlp.c_fc.weight") or has("layers.0.mlp.fc1.weight"):
+        # NON-gated MLPs: StarCoder2 names them c_fc/c_proj, phi fc1/fc2
         # (activation='gelu' builds no gate_proj)
+        up_n, dn_n = (("c_fc", "c_proj")
+                      if has("layers.0.mlp.c_fc.weight")
+                      else ("fc1", "fc2"))
         block["mlp"] = {
             "up_proj": {"kernel": stack(
-                "layers.{i}.mlp.c_fc.weight", lambda w: w.T)},
+                f"layers.{{i}}.mlp.{up_n}.weight", lambda w: w.T)},
             "down_proj": {"kernel": stack(
-                "layers.{i}.mlp.c_proj.weight", lambda w: w.T)},
+                f"layers.{{i}}.mlp.{dn_n}.weight", lambda w: w.T)},
         }
         if cfg.mlp_bias:
             block["mlp"]["up_proj"]["bias"] = stack(
-                "layers.{i}.mlp.c_fc.bias", lambda b: b)
+                f"layers.{{i}}.mlp.{up_n}.bias", lambda b: b)
             block["mlp"]["down_proj"]["bias"] = stack(
-                "layers.{i}.mlp.c_proj.bias", lambda b: b)
+                f"layers.{{i}}.mlp.{dn_n}.bias", lambda b: b)
     else:
         block["mlp"] = {
             "gate_proj": {"kernel": stack(
@@ -511,26 +536,32 @@ def params_from_hf_state_dict(
             "layers.{i}.pre_feedforward_layernorm.weight", lambda w: w)}
         block["ln2_post"] = {"scale": stack(
             "layers.{i}.post_feedforward_layernorm.weight", lambda w: w)}
-    else:
+    elif not cfg.parallel_block:      # phi's parallel block has no ln2
         block["ln2"] = {"scale": stack(ln2_src, lambda w: w)}
+    # phi names the final norm final_layernorm
+    fn_src = ("final_layernorm" if has("final_layernorm.weight")
+              else "norm")
     params: Dict[str, Any] = {
         "embed_tokens": {"embedding": get("embed_tokens.weight")},
         "layers": {"block": block},
-        "final_norm": {"scale": get("norm.weight")},
+        "final_norm": {"scale": get(f"{fn_src}.weight")},
     }
     if cfg.norm == "layernorm":
-        # biased LayerNorms (StarCoder2): same source names, .bias leaf
+        # biased LayerNorms (StarCoder2/phi): same source names, .bias
         block["ln1"]["bias"] = stack(
             ln1_src.replace(".weight", ".bias"), lambda b: b)
-        block["ln2"]["bias"] = stack(
-            ln2_src.replace(".weight", ".bias"), lambda b: b)
-        params["final_norm"]["bias"] = get("norm.bias")
+        if "ln2" in block:
+            block["ln2"]["bias"] = stack(
+                ln2_src.replace(".weight", ".bias"), lambda b: b)
+        params["final_norm"]["bias"] = get(f"{fn_src}.bias")
     if not cfg.tie_embeddings:
         # lm_head lives at the top level in HF models
         head = state_dict.get("lm_head.weight")
         if head is None:
             raise KeyError("lm_head.weight missing and tie_embeddings=False")
         params["lm_head"] = {"kernel": _t(head).T}
+        if cfg.head_bias:
+            params["lm_head"]["bias"] = _t(state_dict["lm_head.bias"])
 
     import jax
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)

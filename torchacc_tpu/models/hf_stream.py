@@ -257,8 +257,12 @@ def _detect_nongated(names) -> bool:
 def streamable_names(names) -> bool:
     """Whether the checkpoint uses the llama-family tensor layout the
     stream plan maps (separate or phi3-packed attention projections).
-    GPT-2-style checkpoints (Conv1D ``h.N.attn.c_attn``) are NOT — the
-    caller should fall back to the materialising converter."""
+    GPT-2-style checkpoints (Conv1D ``h.N.attn.c_attn``) and phi-2's
+    parallel-block layout (``self_attn.dense``, ``final_layernorm``)
+    are NOT — the caller should fall back to the materialising
+    converter (phi-2 tops out at 2.7B, comfortably materialisable)."""
+    if any(n.endswith("self_attn.dense.weight") for n in names):
+        return False
     return any(n.endswith(("self_attn.q_proj.weight",
                            "self_attn.qkv_proj.weight"))
                for n in names)

@@ -48,6 +48,10 @@ class ModelConfig:
     # 0 disables.  Applied in the plain head, the fused-CE head
     # (ops/fused.py) and the 1F1B last-stage head alike.
     logit_softcap: float = 0.0
+    # phi-2-style parallel residual: x + attn(ln1(x)) + mlp(ln1(x)) —
+    # ONE shared pre-norm, no ln2 (HF PhiDecoderLayer)
+    parallel_block: bool = False
+    head_bias: bool = False                 # bias on the lm_head (phi-2)
     qkv_bias: bool = False                  # Qwen2 style
     o_bias: bool = False                    # bias on o_proj (llama
     #                                         attention_bias covers it;
@@ -626,6 +630,20 @@ class Block(nn.Module):
         if cfg.norm_placement not in ("pre", "post"):
             raise ValueError(f"norm_placement must be 'pre' | 'post', "
                              f"got {cfg.norm_placement!r}")
+        if cfg.parallel_block:
+            # phi-2: both sublayers read ONE shared pre-norm and the
+            # residual adds them together; no ln2 exists
+            if post or cfg.sandwich_norms:
+                raise ValueError("parallel_block (phi) does not compose "
+                                 "with norm_placement='post' or "
+                                 "sandwich_norms")
+            n = Norm(cfg, name="ln1")(x)
+            attn_out = attn_cls(cfg, name="attn")(
+                n, positions, segment_ids, dropout_seed)
+            mlp_out = mlp_cls(
+                cfg, name="moe" if cfg.num_experts > 0 else "mlp")(n)
+            return (x + checkpoint_name(attn_out, "attn_out")
+                    + checkpoint_name(mlp_out, "mlp_out"))
         attn_out = attn_cls(cfg, name="attn")(
             x if post else Norm(cfg, name="ln1")(x),
             positions, segment_ids, dropout_seed)
@@ -963,9 +981,16 @@ class TransformerLM(nn.Module):
             # head matmul chunk-by-chunk inside the loss
             return x
         if cfg.tie_embeddings:
+            if cfg.head_bias:
+                # the tied path projects via emb.attend — no bias param
+                # exists to apply; converting silently would drop it
+                raise ValueError(
+                    "head_bias does not compose with tie_embeddings "
+                    "(the tied head has no bias parameter)")
             logits = emb.attend(x)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head",
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.head_bias,
+                              name="lm_head",
                               dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                               kernel_init=nn.initializers.normal(0.02))(x)
         return softcap(logits.astype(jnp.float32), cfg.logit_softcap)
@@ -1044,6 +1069,8 @@ def head_logits(cfg: ModelConfig, params, x: jax.Array) -> jax.Array:
          else params["lm_head"]["kernel"])
     logits = jnp.einsum("bsh,hv->bsv", xn.astype(cfg.dtype),
                         w.astype(cfg.dtype))
+    if cfg.head_bias:
+        logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
     return softcap(logits.astype(jnp.float32), cfg.logit_softcap)
 
 
@@ -1204,13 +1231,19 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     # (replicated) head.
     _mesh = jax.sharding.get_abstract_mesh()
     _tp_ext = int(getattr(_mesh, "shape", {}).get("tp", 1) or 1)
+    # neither chunked-CE variant carries a bias term — head_bias models
+    # (phi) take the materialised-logits paths below, mirroring the
+    # trainer's fused-CE gate
     tp_head = (cfg.tp_vocab_head and _tp_ext > 1 and custom_loss is None
-               and cfg.vocab_size % _tp_ext == 0)
+               and cfg.vocab_size % _tp_ext == 0 and not cfg.head_bias)
+    use_fused_ce = use_fused_ce and not cfg.head_bias
 
     def head_loss(hp, y, lab):
         xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
         w = (hp["embed"].T if cfg.tie_embeddings
              else hp["lm_head"]["kernel"])
+        hb = (hp["lm_head"]["bias"].astype(jnp.float32)
+              if cfg.head_bias else None)
         if tp_head:
             from torchacc_tpu.ops.fused import fused_linear_cross_entropy_tp
             return fused_linear_cross_entropy_tp(
@@ -1223,9 +1256,9 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             # pp/executor.py:283-321).  The batch view here carries the
             # micro's labels; losses needing other batch leaves should
             # use the gpipe schedule, whose loss runs outside the region.
-            logits = _pin_logits(
-                jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
-                           w.astype(jnp.float32)))
+            logits = jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
+                                w.astype(jnp.float32))
+            logits = _pin_logits(logits if hb is None else logits + hb)
             res = custom_loss(softcap(logits, cfg.logit_softcap),
                               _MicroBatchView(labels=lab))
             if isinstance(res, tuple):
@@ -1239,9 +1272,9 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
             return fused_linear_cross_entropy(
                 xn, w, lab, logit_softcap=cfg.logit_softcap,
                 scan_free=True)
-        logits = _pin_logits(
-            jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
-                       w.astype(jnp.float32)))
+        logits = jnp.einsum("bsh,hv->bsv", xn.astype(jnp.float32),
+                            w.astype(jnp.float32))
+        logits = _pin_logits(logits if hb is None else logits + hb)
         return loss_sum_count(softcap(logits, cfg.logit_softcap), lab)
 
     # tells the 1F1B executor's head_vjp to SKIP its replicated-head pin:

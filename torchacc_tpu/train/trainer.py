@@ -100,7 +100,11 @@ class Trainer:
         from torchacc_tpu.models.transformer import TransformerLM
         self._use_fused_ce = (loss is None
                               and config.compute.fused_kernels
-                              and isinstance(model, TransformerLM))
+                              and isinstance(model, TransformerLM)
+                              # the chunked head has no bias term;
+                              # head_bias models (phi-2) use the
+                              # materialised-logits loss
+                              and not model.cfg.head_bias)
         self.state: Optional[TrainState] = None
         self.state_shardings = None
         self._abstract: Optional[TrainState] = None
