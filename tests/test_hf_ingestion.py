@@ -854,3 +854,23 @@ def test_phi2_trains_and_decodes(devices):
     np.testing.assert_array_equal(np.asarray(pp_toks), np.asarray(ref_toks))
     np.testing.assert_allclose(float(tr_pp.step(b)["loss"]), losses[0],
                                rtol=1e-5)
+
+
+def test_cohere_logits_match():
+    """Cohere / Command-R: parallel residual with one shared BIASLESS
+    LayerNorm, gated silu MLP, tied embeddings, and the logit_scale
+    multiplier (0.0625 here — binding, so a dropped scale fails)."""
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, layer_norm_eps=1e-5,
+        logit_scale=0.0625, tie_word_embeddings=True,
+        attn_implementation="eager")
+    torch.manual_seed(13)
+    hf_model = transformers.CohereForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "cohere"
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.parallel_block and not cfg.norm_bias
+    assert cfg.logit_scale == 0.0625
+    ids = np.random.default_rng(13).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)

@@ -653,3 +653,29 @@ def test_streamed_starcoder2(tmp_path):
     with torch.no_grad():
         theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_streamed_cohere(tmp_path):
+    """Cohere streams: parallel-block plan (ln1 only, no ln2 entries),
+    biasless LayerNorm, tied embeddings, logit_scale binding."""
+    hf_cfg = transformers.CohereConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.0625,
+        tie_word_embeddings=True, attn_implementation="eager")
+    torch.manual_seed(14)
+    hf_model = transformers.CohereForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    assert cfg.parallel_block and not cfg.norm_bias
+    blk = params["layers"]["block"]
+    assert "ln2" not in blk and "bias" not in blk["ln1"]
+    ids = np.random.default_rng(14).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)

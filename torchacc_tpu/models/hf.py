@@ -131,6 +131,19 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         kw.update(norm="layernorm", activation="gelu",
                   qkv_bias=bias, o_bias=bias, mlp_bias=bias,
                   norm_eps=float(get("norm_epsilon", 1e-5)))
+    if mt == "cohere":
+        # Cohere / Command-R: PARALLEL residual with ONE shared BIASLESS
+        # LayerNorm, gated silu MLP (llama names), tied embeddings, and
+        # a logit_scale multiplier (applied by scaling the final-normed
+        # hidden — every head path inherits it)
+        if get("use_qk_norm", False):
+            raise NotImplementedError(
+                "cohere use_qk_norm=True (per-head LayerNorm q/k) is "
+                "not implemented")
+        kw.update(parallel_block=True, norm="layernorm", norm_bias=False,
+                  norm_eps=float(get("layer_norm_eps", 1e-5)),
+                  logit_scale=float(get("logit_scale", 1.0) or 1.0),
+                  rope_interleaved=True)
     if mt == "phi":
         # Phi-1/1.5/2: PARALLEL residual (x + attn(ln(x)) + mlp(ln(x)),
         # one shared biased LayerNorm, no ln2), partial rotary,
@@ -546,7 +559,7 @@ def params_from_hf_state_dict(
         "layers": {"block": block},
         "final_norm": {"scale": get(f"{fn_src}.weight")},
     }
-    if cfg.norm == "layernorm":
+    if cfg.norm == "layernorm" and cfg.norm_bias:
         # biased LayerNorms (StarCoder2/phi): same source names, .bias
         block["ln1"]["bias"] = stack(
             ln1_src.replace(".weight", ".bias"), lambda b: b)

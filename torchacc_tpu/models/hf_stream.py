@@ -94,7 +94,8 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
     add("embed_tokens.weight", ("embed_tokens", "embedding"), None,
         (v, h), lambda w: w)
     add("norm.weight", ("final_norm", "scale"), None, (h,), lambda w: w)
-    ln_bias = cfg.norm == "layernorm"   # biased LayerNorms (StarCoder2)
+    # biased LayerNorms (StarCoder2); cohere's layernorm is biasless
+    ln_bias = cfg.norm == "layernorm" and cfg.norm_bias
     if ln_bias:
         add("norm.bias", ("final_norm", "bias"), None, (h,), lambda b: b)
     if not cfg.tie_embeddings:
@@ -222,6 +223,13 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
             continue
         add(p + "input_layernorm.weight", b + ("ln1", "scale"), i, (h,),
             lambda w: w)
+        if cfg.parallel_block:
+            # phi/cohere: one shared norm, no ln2 (phi is excluded from
+            # streaming by layout, but cohere streams)
+            if ln_bias:
+                add(p + "input_layernorm.bias", b + ("ln1", "bias"), i,
+                    (h,), lambda bb: bb)
+            continue
         if ln_bias and not cfg.sandwich_norms:
             add(p + "input_layernorm.bias", b + ("ln1", "bias"), i, (h,),
                 lambda bb: bb)

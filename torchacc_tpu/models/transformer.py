@@ -49,9 +49,15 @@ class ModelConfig:
     # (ops/fused.py) and the 1F1B last-stage head alike.
     logit_softcap: float = 0.0
     # phi-2-style parallel residual: x + attn(ln1(x)) + mlp(ln1(x)) —
-    # ONE shared pre-norm, no ln2 (HF PhiDecoderLayer)
+    # ONE shared pre-norm, no ln2 (HF PhiDecoderLayer / CohereDecoderLayer)
     parallel_block: bool = False
     head_bias: bool = False                 # bias on the lm_head (phi-2)
+    norm_bias: bool = True                  # layernorm bias (False: cohere)
+    rope_interleaved: bool = False          # cohere pairwise rope layout
+    # Cohere logit multiplier; applied by SCALING the final-normed hidden
+    # (logits*s == (x*s)@W), so every head path — plain, fused-CE,
+    # tp-vocab-parallel, pp decode — inherits it from one place
+    logit_scale: float = 1.0
     qkv_bias: bool = False                  # Qwen2 style
     o_bias: bool = False                    # bias on o_proj (llama
     #                                         attention_bias covers it;
@@ -198,7 +204,9 @@ class ModelConfig:
         return 4 * self.hidden_size
 
     def num_params(self) -> int:
-        """Analytic parameter count (for MFU math)."""
+        """Analytic parameter count (for MFU math) — exact per family:
+        biases (qkv/o/mlp/head), sandwich and qk norms, parallel-block
+        norm counts, and biased LayerNorms are all accounted."""
         h, v = self.hidden_size, self.vocab_size
         d = self.head_size
         emb = v * h + (self.max_seq_len * h if self.pos_emb == "learned" else 0)
@@ -206,15 +214,29 @@ class ModelConfig:
             + (self.num_heads * d) * h
         if self.qkv_bias:
             attn += (self.num_heads + 2 * self.kv_heads) * d
+        if self.o_bias:
+            attn += h
+        if self.qk_norm:
+            attn += ((self.num_heads + self.kv_heads) * d
+                     if self.qk_norm_proj else 2 * d)
         if self.activation in ("swiglu", "geglu"):
             mlp = 3 * h * self.ffn_size
+            if self.mlp_bias:
+                mlp += 2 * self.ffn_size + h
         else:
             mlp = 2 * h * self.ffn_size
+            if self.mlp_bias:
+                mlp += self.ffn_size + h
         if self.num_experts > 0:
             mlp = mlp * self.num_experts + h * self.num_experts
-        norm_size = 2 * h if self.norm == "layernorm" else h
-        norms = (2 * self.num_layers + 1) * norm_size
+        norm_size = (2 * h if self.norm == "layernorm" and self.norm_bias
+                     else h)
+        per_block = (1 if self.parallel_block
+                     else (4 if self.sandwich_norms else 2))
+        norms = (per_block * self.num_layers + 1) * norm_size
         out = 0 if self.tie_embeddings else v * h
+        if self.head_bias:
+            out += v
         return emb + self.num_layers * (attn + mlp) + norms + out
 
 
@@ -225,6 +247,17 @@ def softcap(logits: jax.Array, cap: float) -> jax.Array:
     if cap <= 0.0:
         return logits
     return jnp.tanh(logits / cap) * cap
+
+
+def scale_hidden(cfg: "ModelConfig", xn: jax.Array) -> jax.Array:
+    """Apply cohere's logit_scale to the final-normed hidden
+    (logits * s == (x * s) @ W), so every head path — the module tail,
+    ``head_logits`` (pp decode), the 1F1B head, and the fused-CE path
+    fed by ``return_hidden`` — inherits the multiplier from ONE
+    definition (same no-drift rationale as :func:`softcap`)."""
+    if cfg.logit_scale == 1.0:
+        return xn
+    return xn * jnp.asarray(cfg.logit_scale, xn.dtype)
 
 
 def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
@@ -316,9 +349,16 @@ def _rope(q: jax.Array, k: jax.Array, positions: jax.Array,
     def rot(x):
         xf = x.astype(jnp.float32)
         xr, xp = xf[..., :rot_d], xf[..., rot_d:]
-        x1, x2 = jnp.split(xr, 2, axis=-1)
-        out = jnp.concatenate(
-            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        if cfg.rope_interleaved:
+            # cohere: dims pair as (even, odd) instead of llama's half
+            # split; rotate each pair and restore the interleaving
+            x1, x2 = xr[..., 0::2], xr[..., 1::2]
+            out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                            axis=-1).reshape(xr.shape)
+        else:
+            x1, x2 = jnp.split(xr, 2, axis=-1)
+            out = jnp.concatenate(
+                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
         if rot_d < d:
             out = jnp.concatenate([out, xp], axis=-1)
         return out.astype(x.dtype)
@@ -349,13 +389,15 @@ class Norm(nn.Module):
             return (y * sf).astype(cfg.dtype)
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
                            cfg.param_dtype)
-        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],),
-                          cfg.param_dtype)
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
         y = (xf - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
-        return (y * scale.astype(jnp.float32)
-                + bias.astype(jnp.float32)).astype(cfg.dtype)
+        y = y * scale.astype(jnp.float32)
+        if cfg.norm_bias:   # cohere's LayerNorm carries no bias
+            bias = self.param("bias", nn.initializers.zeros,
+                              (x.shape[-1],), cfg.param_dtype)
+            y = y + bias.astype(jnp.float32)
+        return y.astype(cfg.dtype)
 
 
 def alibi_slopes(num_heads: int) -> Tuple[float, ...]:
@@ -975,7 +1017,7 @@ class TransformerLM(nn.Module):
             (x, _, _), _ = scan_mod((x, positions, segment_ids),
                                     seeds_xs)
 
-        x = Norm(cfg, name="final_norm")(x)
+        x = scale_hidden(cfg, Norm(cfg, name="final_norm")(x))
         if return_hidden:
             # fused linear+CE path (ops/fused.py): the caller applies the
             # head matmul chunk-by-chunk inside the loss
@@ -1064,7 +1106,8 @@ def head_logits(cfg: ModelConfig, params, x: jax.Array) -> jax.Array:
     (Dense/attend both cast operands to cfg.dtype) — one definition so
     raw-params consumers (the pp decode path, models/generate.py)
     cannot drift from the module."""
-    xn = Norm(cfg).apply({"params": params["final_norm"]}, x)
+    xn = scale_hidden(cfg, Norm(cfg).apply(
+        {"params": params["final_norm"]}, x))
     w = (params["embed_tokens"]["embedding"].T if cfg.tie_embeddings
          else params["lm_head"]["kernel"])
     logits = jnp.einsum("bsh,hv->bsv", xn.astype(cfg.dtype),
@@ -1239,7 +1282,8 @@ def pp_1f1b_forward_sum_count(cfg: ModelConfig, params, input_ids,
     use_fused_ce = use_fused_ce and not cfg.head_bias
 
     def head_loss(hp, y, lab):
-        xn = Norm(cfg).apply({"params": hp["final_norm"]}, y)
+        xn = scale_hidden(cfg, Norm(cfg).apply(
+            {"params": hp["final_norm"]}, y))
         w = (hp["embed"].T if cfg.tie_embeddings
              else hp["lm_head"]["kernel"])
         hb = (hp["lm_head"]["bias"].astype(jnp.float32)
