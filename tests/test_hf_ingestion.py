@@ -874,3 +874,58 @@ def test_cohere_logits_match():
     assert cfg.logit_scale == 0.0625
     ids = np.random.default_rng(13).integers(0, 128, size=(2, 16)).astype(np.int32)
     _compare(hf_model, ids, atol=2e-4)
+
+
+def test_nemotron_logits_match():
+    """Nemotron: layernorm1p ((1+w) scale + bias over a mean-centred
+    norm), NON-gated square-relu MLP keeping the up/down names, partial
+    rotary 0.5."""
+    hf_cfg = transformers.NemotronConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, norm_eps=1e-5,
+        partial_rotary_factor=0.5, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(15)
+    hf_model = transformers.NemotronForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "nemotron"
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.norm == "layernorm1p" and cfg.activation == "relu2"
+    ids = np.random.default_rng(15).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_gpt_neox_logits_match(parallel):
+    """GPT-NeoX / Pythia: two-norm parallel residual (or sequential when
+    use_parallel_residual=False), packed per-head [q|k|v] attention,
+    exact erf gelu, rotary_pct partial rope, biases everywhere."""
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=parallel, layer_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(17)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    assert hf_model.config.model_type == "gpt_neox"
+    cfg = config_from_hf(hf_cfg, dtype=jnp.float32, param_dtype=jnp.float32)
+    assert cfg.parallel_block == parallel
+    assert cfg.activation == "gelu_exact" and cfg.partial_rotary == 0.25
+    ids = np.random.default_rng(17).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)
+
+
+def test_gpt_neox_attention_bias_false():
+    """attention_bias=False neox checkpoints (no qkv/dense bias tensors)
+    convert instead of KeyError-ing."""
+    hf_cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        attention_bias=False, tie_word_embeddings=False,
+        attn_implementation="eager")
+    torch.manual_seed(18)
+    hf_model = transformers.GPTNeoXForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(18).integers(0, 128, size=(2, 16)).astype(np.int32)
+    _compare(hf_model, ids, atol=2e-4)

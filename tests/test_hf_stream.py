@@ -679,3 +679,28 @@ def test_streamed_cohere(tmp_path):
     with torch.no_grad():
         theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)
+
+
+def test_streamed_nemotron(tmp_path):
+    """Nemotron streams: gate-free up/down plan entries + layernorm1p
+    bias entries."""
+    hf_cfg = transformers.NemotronConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        tie_word_embeddings=False, attn_implementation="eager")
+    torch.manual_seed(16)
+    hf_model = transformers.NemotronForCausalLM(hf_cfg).eval()
+    path = str(tmp_path / "ckpt")
+    _save_sharded(hf_model, path, n_shards=2)
+
+    cfg, params = load_hf_model_streamed(path, dtype=jnp.float32,
+                                         param_dtype=jnp.float32)
+    blk = params["layers"]["block"]
+    assert "gate_proj" not in blk["mlp"] and "bias" in blk["ln1"]
+    ids = np.random.default_rng(16).integers(0, 128, size=(2, 16))
+    ours = TransformerLM(cfg).apply({"params": params},
+                                    jnp.asarray(ids, jnp.int32))
+    with torch.no_grad():
+        theirs = hf_model(torch.from_numpy(ids)).logits.float().numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4)

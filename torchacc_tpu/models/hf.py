@@ -131,6 +131,45 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         kw.update(norm="layernorm", activation="gelu",
                   qkv_bias=bias, o_bias=bias, mlp_bias=bias,
                   norm_eps=float(get("norm_epsilon", 1e-5)))
+    if mt == "gpt_neox":
+        # GPT-NeoX / Pythia: TWO-norm parallel residual
+        # (x + attn(ln1(x)) + mlp(ln2(x)) when use_parallel_residual,
+        # the pythia default), packed per-head [q|k|v] attention, exact
+        # erf gelu, biases everywhere, partial rotary via rotary_pct
+        act = get("hidden_act", "gelu")
+        if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh",
+                       "gelu_fast"):
+            raise NotImplementedError(
+                f"gpt_neox hidden_act {act!r} is not implemented")
+        nx_bias = bool(get("attention_bias", True))
+        kw.update(norm="layernorm",
+                  activation="gelu_exact" if act == "gelu" else "gelu",
+                  parallel_block=bool(get("use_parallel_residual", True)),
+                  parallel_block_shared_norm=False,
+                  # attention_bias gates qkv/dense; the MLP linears are
+                  # unconditionally biased in HF GPTNeoXMLP
+                  qkv_bias=nx_bias, o_bias=nx_bias, mlp_bias=True,
+                  norm_eps=float(get("layer_norm_eps", 1e-5)),
+                  rope_theta=float(get("rotary_emb_base",
+                                       get("rope_theta", 10000.0) or
+                                       10000.0) or 10000.0))
+        prf = float(get("rotary_pct", 1.0) or 1.0)
+        if prf != 1.0:
+            kw["partial_rotary"] = prf
+    if mt == "nemotron":
+        # Nemotron: layernorm1p ((1+w) scale + bias over a mean-centred
+        # norm), NON-gated square-relu MLP (up/down names), partial
+        # rotary; llama attention names
+        act = get("hidden_act", "relu2")
+        if act != "relu2":
+            raise NotImplementedError(
+                f"nemotron hidden_act {act!r} is not implemented "
+                f"(relu2 is)")
+        kw.update(norm="layernorm1p", activation="relu2",
+                  norm_eps=float(get("norm_eps", 1e-5)))
+        prf = float(get("partial_rotary_factor", 0.5) or 1.0)
+        if prf != 1.0:
+            kw["partial_rotary"] = prf
     if mt == "cohere":
         # Cohere / Command-R: PARALLEL residual with ONE shared BIASLESS
         # LayerNorm, gated silu MLP (llama names), tied embeddings, and
@@ -375,6 +414,85 @@ def _params_from_gpt2(state_dict, cfg: ModelConfig, dtype):
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
 
 
+def _params_from_neox(state_dict, cfg: ModelConfig, dtype):
+    """GPT-NeoX state dict -> TransformerLM params: ``gpt_neox.``
+    prefix, packed per-head ``attention.query_key_value`` ([q|k|v] rows
+    PER HEAD — not the phi3 whole-tensor split), ``attention.dense``,
+    ``mlp.dense_h_to_4h/dense_4h_to_h``, biased LayerNorms, top-level
+    ``embed_out`` head."""
+    L, h = cfg.num_layers, cfg.hidden_size
+    nh, d = cfg.num_heads, cfg.head_size
+
+    def get(name):
+        for prefix in ("gpt_neox.", ""):
+            if prefix + name in state_dict:
+                return _t(state_dict[prefix + name])
+        raise KeyError(f"missing weight {name!r} in state_dict")
+
+    def stack(fmt, transform):
+        return np.stack([transform(get(fmt.format(i=i))) for i in range(L)])
+
+    qw, kw_, vw, qb, kb, vb = ([] for _ in range(6))
+    for i in range(L):
+        w = get(f"layers.{i}.attention.query_key_value.weight")
+        w3 = w.reshape(nh, 3 * d, h)          # rows per head: [q|k|v]
+        # -> [h, nh, d] kernels / [nh, d] biases
+        qw.append(w3[:, :d, :].transpose(2, 0, 1))
+        kw_.append(w3[:, d:2 * d, :].transpose(2, 0, 1))
+        vw.append(w3[:, 2 * d:, :].transpose(2, 0, 1))
+        if cfg.qkv_bias:   # attention_bias=False checkpoints ship none
+            b3 = get(f"layers.{i}.attention.query_key_value.bias"
+                     ).reshape(nh, 3 * d)
+            qb.append(b3[:, :d])
+            kb.append(b3[:, d:2 * d])
+            vb.append(b3[:, 2 * d:])
+    attn = {
+        "q_proj": {"kernel": np.stack(qw)},
+        "k_proj": {"kernel": np.stack(kw_)},
+        "v_proj": {"kernel": np.stack(vw)},
+        "o_proj": {"kernel": stack("layers.{i}.attention.dense.weight",
+                                   lambda w: w.T.reshape(nh, d, h))},
+    }
+    if cfg.qkv_bias:
+        attn["q_proj"]["bias"] = np.stack(qb)
+        attn["k_proj"]["bias"] = np.stack(kb)
+        attn["v_proj"]["bias"] = np.stack(vb)
+    if cfg.o_bias:
+        attn["o_proj"]["bias"] = stack(
+            "layers.{i}.attention.dense.bias", lambda b: b)
+    block = {
+        "attn": attn,
+        "mlp": {
+            "up_proj": {"kernel": stack(
+                "layers.{i}.mlp.dense_h_to_4h.weight", lambda w: w.T),
+                "bias": stack("layers.{i}.mlp.dense_h_to_4h.bias",
+                              lambda b: b)},
+            "down_proj": {"kernel": stack(
+                "layers.{i}.mlp.dense_4h_to_h.weight", lambda w: w.T),
+                "bias": stack("layers.{i}.mlp.dense_4h_to_h.bias",
+                              lambda b: b)},
+        },
+        "ln1": {"scale": stack("layers.{i}.input_layernorm.weight",
+                               lambda w: w),
+                "bias": stack("layers.{i}.input_layernorm.bias",
+                              lambda b: b)},
+        "ln2": {"scale": stack(
+            "layers.{i}.post_attention_layernorm.weight", lambda w: w),
+            "bias": stack("layers.{i}.post_attention_layernorm.bias",
+                          lambda b: b)},
+    }
+    params: Dict[str, Any] = {
+        "embed_tokens": {"embedding": get("embed_in.weight")},
+        "layers": {"block": block},
+        "final_norm": {"scale": get("final_layer_norm.weight"),
+                       "bias": get("final_layer_norm.bias")},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _t(state_dict["embed_out.weight"]).T}
+    import jax
+    return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
+
+
 def params_from_hf_state_dict(
     state_dict: Mapping[str, Any],
     cfg: ModelConfig,
@@ -394,6 +512,9 @@ def params_from_hf_state_dict(
     # unsupported and will fail on their attention tensors loudly)
     if any(k.endswith("attn.c_attn.weight") for k in state_dict):
         return _params_from_gpt2(state_dict, cfg, dtype)
+    if any(k.endswith("attention.query_key_value.weight")
+           for k in state_dict):
+        return _params_from_neox(state_dict, cfg, dtype)
     L = cfg.num_layers
     h = cfg.hidden_size
     nh, nk, d = cfg.num_heads, cfg.kv_heads, cfg.head_size
@@ -528,16 +649,17 @@ def params_from_hf_state_dict(
             block["mlp"]["down_proj"]["bias"] = stack(
                 f"layers.{{i}}.mlp.{dn_n}.bias", lambda b: b)
     else:
+        # gated (llama) MLPs carry gate/up/down; non-gated models that
+        # keep the up/down names (nemotron relu2) just drop the gate
+        gated = cfg.activation in ("swiglu", "geglu")
+        names = (("gate_proj", "up_proj", "down_proj") if gated
+                 else ("up_proj", "down_proj"))
         block["mlp"] = {
-            "gate_proj": {"kernel": stack(
-                "layers.{i}.mlp.gate_proj.weight", lambda w: w.T)},
-            "up_proj": {"kernel": stack(
-                "layers.{i}.mlp.up_proj.weight", lambda w: w.T)},
-            "down_proj": {"kernel": stack(
-                "layers.{i}.mlp.down_proj.weight", lambda w: w.T)},
-        }
+            nm: {"kernel": stack(
+                f"layers.{{i}}.mlp.{nm}.weight", lambda w: w.T)}
+            for nm in names}
         if cfg.mlp_bias:
-            for nm in ("gate_proj", "up_proj", "down_proj"):
+            for nm in names:
                 block["mlp"][nm]["bias"] = stack(
                     f"layers.{{i}}.mlp.{nm}.bias", lambda b: b)
     if cfg.sandwich_norms:
@@ -559,7 +681,7 @@ def params_from_hf_state_dict(
         "layers": {"block": block},
         "final_norm": {"scale": get(f"{fn_src}.weight")},
     }
-    if cfg.norm == "layernorm" and cfg.norm_bias:
+    if cfg.norm in ("layernorm", "layernorm1p") and cfg.norm_bias:
         # biased LayerNorms (StarCoder2/phi): same source names, .bias
         block["ln1"]["bias"] = stack(
             ln1_src.replace(".weight", ".bias"), lambda b: b)

@@ -94,8 +94,9 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
     add("embed_tokens.weight", ("embed_tokens", "embedding"), None,
         (v, h), lambda w: w)
     add("norm.weight", ("final_norm", "scale"), None, (h,), lambda w: w)
-    # biased LayerNorms (StarCoder2); cohere's layernorm is biasless
-    ln_bias = cfg.norm == "layernorm" and cfg.norm_bias
+    # biased LayerNorms (StarCoder2, nemotron's layernorm1p); cohere's
+    # layernorm is biasless
+    ln_bias = cfg.norm in ("layernorm", "layernorm1p") and cfg.norm_bias
     if ln_bias:
         add("norm.bias", ("final_norm", "bias"), None, (h,), lambda b: b)
     if not cfg.tie_embeddings:
@@ -200,15 +201,19 @@ def ingestion_plan(cfg: ModelConfig, *, packed_qkv: bool = False,
                     (h,), lambda b: b)
         else:
             m = ("layers", "block", "mlp")
-            add(p + "mlp.gate_proj.weight", m + ("gate_proj", "kernel"), i,
-                (inter, h), lambda w: np.ascontiguousarray(w.T))
+            # non-gated models keeping the up/down names (nemotron
+            # relu2) have no gate tensors
+            if cfg.activation in ("swiglu", "geglu"):
+                add(p + "mlp.gate_proj.weight", m + ("gate_proj", "kernel"),
+                    i, (inter, h), lambda w: np.ascontiguousarray(w.T))
+                if cfg.mlp_bias:
+                    add(p + "mlp.gate_proj.bias", m + ("gate_proj", "bias"),
+                        i, (inter,), lambda b: b)
             add(p + "mlp.up_proj.weight", m + ("up_proj", "kernel"), i,
                 (inter, h), lambda w: np.ascontiguousarray(w.T))
             add(p + "mlp.down_proj.weight", m + ("down_proj", "kernel"), i,
                 (h, inter), lambda w: np.ascontiguousarray(w.T))
             if cfg.mlp_bias:
-                add(p + "mlp.gate_proj.bias", m + ("gate_proj", "bias"),
-                    i, (inter,), lambda b: b)
                 add(p + "mlp.up_proj.bias", m + ("up_proj", "bias"), i,
                     (inter,), lambda b: b)
                 add(p + "mlp.down_proj.bias", m + ("down_proj", "bias"),

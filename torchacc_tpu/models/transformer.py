@@ -39,9 +39,13 @@ class ModelConfig:
     pos_emb: str = "rope"                   # 'rope' | 'learned' | 'alibi'
     # 'rmsnorm1p' is the Gemma variant: effective scale is (1 + w) with
     # w zero-initialised (HF GemmaRMSNorm)
-    norm: str = "rmsnorm"                   # 'rmsnorm' | 'layernorm' | 'rmsnorm1p'
-    # 'geglu' is Gemma's gated tanh-GELU (gelu_pytorch_tanh on the gate)
-    activation: str = "swiglu"              # 'swiglu' | 'gelu' | 'geglu'
+    # 'rmsnorm' | 'layernorm' | 'rmsnorm1p' | 'layernorm1p' (nemotron:
+    # zero-centred (1+w) scale AND bias over a mean-subtracted norm)
+    norm: str = "rmsnorm"
+    # 'geglu' is Gemma's gated tanh-GELU (gelu_pytorch_tanh on the gate);
+    # 'gelu' (tanh approx), 'gelu_exact' (gpt-neox erf) and 'relu2'
+    # (nemotron square-relu) are NON-gated 2-matrix MLPs
+    activation: str = "swiglu"  # swiglu | gelu | geglu | relu2 | gelu_exact
     # Gemma multiplies token embeddings by sqrt(hidden_size)
     embed_scale: bool = False
     # Gemma2 final-logit soft-capping: logits = c * tanh(logits / c);
@@ -49,8 +53,11 @@ class ModelConfig:
     # (ops/fused.py) and the 1F1B last-stage head alike.
     logit_softcap: float = 0.0
     # phi-2-style parallel residual: x + attn(ln1(x)) + mlp(ln1(x)) —
-    # ONE shared pre-norm, no ln2 (HF PhiDecoderLayer / CohereDecoderLayer)
+    # ONE shared pre-norm, no ln2 (HF PhiDecoderLayer / CohereDecoderLayer).
+    # parallel_block_shared_norm=False is GPT-NeoX's variant: the mlp
+    # branch reads its OWN pre-norm (x + attn(ln1(x)) + mlp(ln2(x)))
     parallel_block: bool = False
+    parallel_block_shared_norm: bool = True
     head_bias: bool = False                 # bias on the lm_head (phi-2)
     norm_bias: bool = True                  # layernorm bias (False: cohere)
     rope_interleaved: bool = False          # cohere pairwise rope layout
@@ -229,9 +236,11 @@ class ModelConfig:
                 mlp += self.ffn_size + h
         if self.num_experts > 0:
             mlp = mlp * self.num_experts + h * self.num_experts
-        norm_size = (2 * h if self.norm == "layernorm" and self.norm_bias
-                     else h)
+        norm_size = (2 * h
+                     if self.norm in ("layernorm", "layernorm1p")
+                     and self.norm_bias else h)
         per_block = (1 if self.parallel_block
+                     and self.parallel_block_shared_norm
                      else (4 if self.sandwich_norms else 2))
         norms = (per_block * self.num_layers + 1) * norm_size
         out = 0 if self.tie_embeddings else v * h
@@ -387,12 +396,15 @@ class Norm(nn.Module):
             if one_p:
                 sf = 1.0 + sf
             return (y * sf).astype(cfg.dtype)
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
-                           cfg.param_dtype)
+        one_p = cfg.norm == "layernorm1p"   # nemotron: stored w, scale 1+w
+        scale = self.param(
+            "scale", nn.initializers.zeros if one_p else nn.initializers.ones,
+            (x.shape[-1],), cfg.param_dtype)
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
         y = (xf - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
-        y = y * scale.astype(jnp.float32)
+        sf = scale.astype(jnp.float32)
+        y = y * (1.0 + sf if one_p else sf)
         if cfg.norm_bias:   # cohere's LayerNorm carries no bias
             bias = self.param("bias", nn.initializers.zeros,
                               (x.shape[-1],), cfg.param_dtype)
@@ -628,8 +640,14 @@ class Mlp(nn.Module):
             act = nn.silu if cfg.activation == "swiglu" else nn.gelu
             h = act(gate) * up
         else:
-            h = nn.gelu(checkpoint_name(dense("up_proj", cfg.ffn_size)(x),
-                                        "mlp_gate_up"))
+            up = checkpoint_name(dense("up_proj", cfg.ffn_size)(x),
+                                 "mlp_gate_up")
+            if cfg.activation == "relu2":   # nemotron: square(relu(x))
+                h = jnp.square(nn.relu(up))
+            elif cfg.activation == "gelu_exact":   # gpt-neox erf gelu
+                h = nn.gelu(up, approximate=False)
+            else:
+                h = nn.gelu(up)
         # megatron TP: ffn hidden sharded on 'tp' (column-parallel out)
         h = activation_constraint(h, ("batch", "seq", "mlp"),
                                   cfg.logical_axis_rules or DEFAULT_RULES)
@@ -682,8 +700,10 @@ class Block(nn.Module):
             n = Norm(cfg, name="ln1")(x)
             attn_out = attn_cls(cfg, name="attn")(
                 n, positions, segment_ids, dropout_seed)
+            n_mlp = (n if cfg.parallel_block_shared_norm
+                     else Norm(cfg, name="ln2")(x))   # gpt-neox
             mlp_out = mlp_cls(
-                cfg, name="moe" if cfg.num_experts > 0 else "mlp")(n)
+                cfg, name="moe" if cfg.num_experts > 0 else "mlp")(n_mlp)
             return (x + checkpoint_name(attn_out, "attn_out")
                     + checkpoint_name(mlp_out, "mlp_out"))
         attn_out = attn_cls(cfg, name="attn")(
