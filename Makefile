@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -22,6 +22,16 @@ test-inproc:
 
 bench:
 	python bench.py
+
+# fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
+# shifts where the NaN losses / preemptions / I/O faults land, so three
+# different fault schedules exercise the same guarantees
+chaos:
+	for s in 0 1 2; do \
+		echo "== chaos seed $$s =="; \
+		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
+			-m resilience -q || exit 1; \
+	done
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -117,6 +117,11 @@ def test_every_config_field_has_a_consumer():
         # intra_size -> SPConfig.ulysses_degree/ring_degree -> the 'spu' and
         # 'sp' extents in DistConfig.axis_sizes, which the mesh builder reads
         "intra_size": "axis_sizes",
+        # backoff shape -> ResilienceConfig.retry_policy(), read by the
+        # trainer's checkpoint manager and the async loader
+        "retry_base_delay_s": "retry_policy",
+        "retry_max_delay_s": "retry_policy",
+        "retry_deadline_s": "retry_policy",
     }
     unread = []
     for path, name in fields_of(cfg_mod.Config, ""):

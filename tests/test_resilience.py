@@ -1,0 +1,476 @@
+"""Resilience subsystem tests: anomaly guards, preemption auto-resume,
+checkpoint manifests/integrity, retried I/O, loader degradation — all
+driven by the deterministic fault-injection harness (resilience/chaos.py).
+
+``CHAOS_SEED`` (``make chaos`` runs 0..2) shifts the injected fault
+positions so three different schedules exercise the same guarantees.
+
+The bitwise-equivalence contract under test (docs/resilience.md):
+
+- a guard-skipped anomalous step leaves params/opt-state exactly as if
+  that batch had never been seen (only the step counter advances);
+- preemption -> emergency save -> ``fit(resume='auto')`` reproduces the
+  uninterrupted run's final params bit for bit.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchacc_tpu as ta
+from torchacc_tpu.checkpoint import CheckpointManager
+from torchacc_tpu.checkpoint.io import MANIFEST
+from torchacc_tpu.errors import (
+    AnomalyError,
+    CheckpointCorruptionError,
+    CheckpointError,
+    CheckpointNotFoundError,
+    DataLoaderError,
+    TrainerStateError,
+)
+from torchacc_tpu.models import get_preset
+from torchacc_tpu.resilience import (
+    ChaosLoader,
+    ChaosPlan,
+    RetryPolicy,
+    chaos_loss,
+    clear_preemption,
+    failpoint,
+    retry_call,
+)
+from torchacc_tpu.train import accelerate
+from torchacc_tpu.utils.metrics import counters
+
+pytestmark = pytest.mark.resilience
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    counters.reset()
+    clear_preemption()
+    yield
+    clear_preemption()
+
+
+def _model():
+    return get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      intermediate_size=64, dtype=jnp.float32)
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [{"input_ids": rng.integers(0, 64, size=(8, 16)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def _trainer(**res_kwargs):
+    import optax
+    res_kwargs.setdefault("retry_base_delay_s", 0.001)
+    res_kwargs.setdefault("retry_max_delay_s", 0.002)
+    cfg = ta.Config(resilience=ta.ResilienceConfig(**res_kwargs))
+    tr, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3),
+                       loss=chaos_loss())
+    return tr
+
+
+def _params(tr):
+    return jax.device_get(tr.state.params)
+
+
+def _assert_trees_equal(a, b):
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(x, y), a, b)
+
+
+# -- retry / failpoint units -------------------------------------------------
+
+def test_retry_backoff_and_deadline():
+    calls, sleeps = {"n": 0}, []
+    pol = RetryPolicy(max_retries=3, base_delay_s=0.5, max_delay_s=2.0,
+                      jitter=0.0)
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, policy=pol, sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [0.5, 1.0]  # exponential, jitter disabled
+
+    # retries exhausted: the LAST exception surfaces
+    with pytest.raises(ValueError):
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("always")),
+                   policy=RetryPolicy(max_retries=1, base_delay_s=0.0,
+                                      max_delay_s=0.0),
+                   sleep=lambda s: None)
+
+    # deadline: no retry is attempted once the budget would be exceeded
+    calls["n"] = 0
+    clock = {"t": 0.0}
+
+    def failing():
+        calls["n"] += 1
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        retry_call(failing,
+                   policy=RetryPolicy(max_retries=10, base_delay_s=5.0,
+                                      max_delay_s=5.0, deadline_s=1.0,
+                                      jitter=0.0),
+                   sleep=lambda s: None, clock=lambda: clock["t"])
+    assert calls["n"] == 1
+
+
+def test_chaos_failpoint_deterministic():
+    plan = ChaosPlan(seed=CHAOS_SEED).fail("p", times=2, exc=OSError)
+    with plan:
+        outcomes = []
+        for _ in range(4):
+            try:
+                failpoint("p")
+                outcomes.append(True)
+            except OSError:
+                outcomes.append(False)
+    assert outcomes == [False, False, True, True]
+    assert plan.stats()["p"] == {"hits": 4, "raised": 2}
+    failpoint("p")  # inactive: no-op
+
+    with pytest.raises(RuntimeError):  # no nested plans
+        with ChaosPlan() as a, ChaosPlan() as b:  # noqa: F841
+            pass
+
+
+def test_config_resilience_validation():
+    with pytest.raises(ta.ConfigError):
+        ta.Config.from_dict({"resilience": {"spike_ewma_alpha": 2.0}})
+    with pytest.raises(ta.ConfigError):
+        ta.Config.from_dict({"resilience": {"max_consecutive_anomalies": 0}})
+    with pytest.raises(ta.ConfigError):  # degenerate EW variance window
+        ta.Config.from_dict({"resilience": {"spike_guard": True,
+                                            "spike_warmup_steps": 1}})
+    cfg = ta.Config.from_dict(
+        {"resilience": {"nan_guard": True, "ckpt_retries": 5}})
+    assert cfg.resilience.nan_guard and cfg.resilience.ckpt_retries == 5
+    assert cfg.to_dict()["resilience"]["ckpt_retries"] == 5
+
+
+def test_counters_monotonic_and_suffix():
+    assert counters.suffix() == ""
+    counters.inc("ckpt_retries")
+    counters.inc("ckpt_retries")
+    counters.inc("resumes")
+    assert counters.get("ckpt_retries") == 2
+    assert counters.suffix() == " [ckpt_retries=2 resumes=1]"
+
+
+# -- checkpoint manifests / integrity ---------------------------------------
+
+def _small_state(mult=1.0):
+    return {"a": jnp.arange(4.0) * mult, "b": {"c": jnp.ones((2, 2)) * mult}}
+
+
+def _small_abstract():
+    return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        _small_state())
+
+
+def test_manifest_written_last_and_partial_steps_skipped(tmp_path):
+    d = str(tmp_path / "ckpt")
+    pol = RetryPolicy(max_retries=1, base_delay_s=0.001, max_delay_s=0.002)
+    mgr = CheckpointManager(d, retry_policy=pol)
+    assert mgr.save(1, _small_state(1.0))
+    assert mgr.save(2, _small_state(2.0))
+    # starting save 2 committed save 1's marker — a SIGKILL here loses
+    # at most the in-flight step, not the whole run's markers
+    assert os.path.exists(os.path.join(d, "1", MANIFEST))
+    mgr.wait_until_finished()
+    assert os.path.exists(os.path.join(d, "1", MANIFEST))
+    assert os.path.exists(os.path.join(d, "2", MANIFEST))
+    assert mgr.latest_step() == 2
+
+    # simulate a partial write: step 3 exists but was never marked
+    os.remove(os.path.join(d, "2", MANIFEST))
+    fresh = CheckpointManager(d, retry_policy=pol)
+    assert fresh.valid_steps() == [1]
+    assert fresh.latest_step() == 1
+    restored = fresh.restore(_small_abstract())
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np.arange(4.0))
+    mgr.close()
+    fresh.close()
+
+
+def test_restore_latest_valid_falls_back_on_corruption(tmp_path):
+    d = str(tmp_path / "ckpt")
+    pol = RetryPolicy(max_retries=0, base_delay_s=0.0, max_delay_s=0.0)
+    mgr = CheckpointManager(d, retry_policy=pol)
+    mgr.save(1, _small_state(1.0))
+    mgr.save(2, _small_state(2.0))
+    mgr.wait_until_finished()
+    # corrupt step 2's payload but keep its manifest: the restore fails
+    # mid-read and the manager must fall back to step 1
+    import shutil
+    shutil.rmtree(os.path.join(d, "2", "default"))
+    state, step = mgr.restore_latest_valid(_small_abstract())
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(state["a"]), np.arange(4.0))
+
+    # digest mismatch (structure drift) is detected before any read
+    assert not mgr.validate_step(1, {"other": jnp.zeros(3)})
+    assert mgr.validate_step(1, _small_abstract())
+    mgr.close()
+
+
+def test_checkpoint_io_errors_retried_then_typed(tmp_path):
+    d = str(tmp_path / "ckpt")
+    pol = RetryPolicy(max_retries=2, base_delay_s=0.001, max_delay_s=0.002)
+    mgr = CheckpointManager(d, retry_policy=pol)
+    with ChaosPlan(seed=CHAOS_SEED).fail("checkpoint.save", times=2):
+        assert mgr.save(1, _small_state())  # below the limit: not fatal
+    assert counters.get("ckpt_retries") == 2
+    with ChaosPlan(seed=CHAOS_SEED).fail("checkpoint.save", times=5):
+        with pytest.raises(CheckpointError):
+            mgr.save(2, _small_state(), force=True)
+    with ChaosPlan(seed=CHAOS_SEED).fail("checkpoint.restore", times=2):
+        restored = mgr.restore(_small_abstract())
+    np.testing.assert_array_equal(np.asarray(restored["a"]), np.arange(4.0))
+    mgr.close()
+
+
+def test_typed_errors(tmp_path):
+    t = _trainer()
+    with pytest.raises(TrainerStateError):
+        t.save(str(tmp_path / "nope"))
+    mgr = CheckpointManager(str(tmp_path / "empty"))
+    with pytest.raises(CheckpointNotFoundError):
+        mgr.restore(_small_abstract())
+    # compat: CheckpointNotFoundError is still a FileNotFoundError
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(_small_abstract())
+    mgr.close()
+    from torchacc_tpu.checkpoint import restore_checkpoint
+    with pytest.raises(CheckpointNotFoundError):
+        restore_checkpoint(str(tmp_path / "missing"))
+
+
+# -- anomaly guards ----------------------------------------------------------
+
+def test_nan_guard_skip_is_equivalent_to_dropping_the_batch():
+    m = 4 + CHAOS_SEED % 3
+    bs = _batches(8)
+    t1 = _trainer(nan_guard=True)
+    t1.fit(ChaosLoader(bs, nan_loss_steps={m}), max_steps=8, log_every=0)
+    assert counters.get("anomalies_skipped") == 1
+    assert int(t1.state.step) == 8  # time moves on; the update didn't
+
+    t2 = _trainer(nan_guard=True)
+    t2.fit(ChaosLoader(bs[:m] + bs[m + 1:]), max_steps=7, log_every=0)
+    _assert_trees_equal(_params(t1), _params(t2))
+
+
+def test_spike_guard_skips_gradient_blowup():
+    m = 5 + CHAOS_SEED % 2
+    bs = _batches(8)
+    kw = dict(spike_guard=True, spike_zscore=4.0, spike_ewma_alpha=0.2,
+              spike_warmup_steps=3)
+    t1 = _trainer(**kw)
+    t1.fit(ChaosLoader(bs, loss_scale_steps={m: 1e4}), max_steps=8,
+           log_every=0)
+    assert counters.get("anomalies_skipped") == 1
+
+    # rejected steps don't pollute the EW statistics: the run matches a
+    # run that never saw the offending batch
+    t2 = _trainer(**kw)
+    t2.fit(ChaosLoader(bs[:m] + bs[m + 1:]), max_steps=7, log_every=0)
+    _assert_trees_equal(_params(t1), _params(t2))
+
+
+def test_abort_after_consecutive_anomalies_with_diagnosis():
+    bs = _batches(8)
+    t = _trainer(nan_guard=True, max_consecutive_anomalies=3)
+    with pytest.raises(AnomalyError) as ei:
+        t.fit(ChaosLoader(bs, nan_loss_steps={2, 3, 4, 5, 6, 7}),
+              max_steps=8, log_every=0)
+    assert ei.value.consecutive == 3
+    assert ei.value.kind == "non-finite loss/grad"
+    assert counters.get("anomalies_skipped") == 3
+
+
+# -- preemption + auto-resume (the acceptance chaos run) ---------------------
+
+def test_preemption_autoresume_bitwise_identical(tmp_path):
+    """Injected preemption at step k and injected NaN at step m:
+    emergency save -> fit(resume='auto') -> final params bitwise equal
+    to the uninterrupted run's."""
+    k = 2 + CHAOS_SEED % 3
+    m = 5 + CHAOS_SEED % 2
+    bs = _batches(8)
+    d = str(tmp_path / "run")
+
+    # uninterrupted reference (same harness, no preemption)
+    ref = _trainer(nan_guard=True)
+    ref.fit(ChaosLoader(bs, nan_loss_steps={m}), max_steps=8, log_every=0)
+
+    # preempted run: stops after step k with an emergency checkpoint
+    t1 = _trainer(nan_guard=True)
+    t1.fit(ChaosLoader(bs, nan_loss_steps={m}, preempt_after_step=k),
+           max_steps=8, log_every=0, checkpoint_dir=d,
+           checkpoint_every=1000, resume='auto')
+    assert int(t1.state.step) == k + 1
+    assert counters.get("emergency_saves") == 1
+    # fit clears the flag it handled, so an in-process supervisor can
+    # immediately call fit(resume='auto') again
+    from torchacc_tpu.resilience import preemption_requested
+    assert not preemption_requested()
+    counters.reset()  # isolate the resumed run's counters
+
+    # resumed run: restores step k+1, skips the consumed batches, rides
+    # through the NaN at m, finishes all 8 steps
+    t2 = _trainer(nan_guard=True)
+    t2.fit(ChaosLoader(bs, nan_loss_steps={m}), max_steps=8, log_every=0,
+           checkpoint_dir=d, checkpoint_every=1000, resume='auto')
+    assert counters.get("resumes") == 1
+    assert int(t2.state.step) == 8
+    if m > k:
+        assert counters.get("anomalies_skipped") == 1
+    _assert_trees_equal(_params(ref), _params(t2))
+
+
+def test_autoresume_falls_back_to_previous_step_on_corruption(tmp_path):
+    bs = _batches(6)
+    d = str(tmp_path / "run")
+    ref = _trainer()
+    ref.fit(ChaosLoader(bs), max_steps=6, log_every=0)
+
+    t1 = _trainer()
+    t1.fit(ChaosLoader(bs), max_steps=6, log_every=0, checkpoint_dir=d,
+           checkpoint_every=2)
+    probe = CheckpointManager(d)
+    steps = probe.valid_steps()
+    probe.close()
+    assert len(steps) >= 2, "expected periodic checkpoints"
+    # corrupt the newest step's payload (manifest intact)
+    import shutil
+    shutil.rmtree(os.path.join(d, str(steps[-1]), "default"))
+
+    t2 = _trainer()
+    t2.fit(ChaosLoader(bs), max_steps=6, log_every=0, checkpoint_dir=d,
+           checkpoint_every=1000, resume='auto')
+    assert counters.get("resumes") == 1
+    assert int(t2.state.step) == 6
+    # the unreadable step was quarantined (evidence kept), not deleted
+    assert os.path.exists(os.path.join(d, f"{steps[-1]}.corrupt"))
+    _assert_trees_equal(_params(ref), _params(t2))
+
+
+def test_autoresume_with_empty_dir_starts_fresh(tmp_path):
+    bs = _batches(3)
+    t = _trainer()
+    hist = t.fit(ChaosLoader(bs), max_steps=3, log_every=1,
+                 checkpoint_dir=str(tmp_path / "new"), resume='auto')
+    assert counters.get("resumes") == 0
+    assert int(t.state.step) == 3
+    assert hist and hist[0]["step"] == 0
+
+
+# -- async loader retries + degradation --------------------------------------
+
+def _loader_cfg(**res_kwargs):
+    res_kwargs.setdefault("retry_base_delay_s", 0.001)
+    res_kwargs.setdefault("retry_max_delay_s", 0.002)
+    return ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=8)),
+                     resilience=ta.ResilienceConfig(**res_kwargs))
+
+
+def test_async_loader_retries_transient_fetch_faults(devices):
+    cfg = _loader_cfg(loader_retries=3)
+    src = ChaosLoader(_batches(4), fetch_faults={1: 2})
+    out = list(ta.data.AsyncLoader(src, cfg))
+    assert len(out) == 4
+    assert counters.get("loader_retries") >= 2
+    assert counters.get("loader_fallbacks") == 0
+
+
+def test_async_loader_degrades_to_synchronous(devices):
+    # producer exhausts its retries (2 attempts vs 3 faults) and hands
+    # the iterator to the consumer, which clears the remaining fault and
+    # finishes the epoch in order
+    cfg = _loader_cfg(loader_retries=1)
+    src = ChaosLoader(_batches(4, seed=3), fetch_faults={1: 3})
+    out = list(ta.data.AsyncLoader(src, cfg))
+    assert len(out) == 4
+    assert counters.get("loader_fallbacks") == 1
+    ref = [b["input_ids"] for b in _batches(4, seed=3)]
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(np.asarray(got["input_ids"]), want)
+
+
+def test_async_loader_transfer_fault_degrades_without_dropping(devices):
+    # the producer fetched the batch but its device transfer keeps
+    # failing; the degrade handoff must carry that batch to the
+    # consumer, not drop it
+    cfg = _loader_cfg(loader_retries=1)
+    src = ChaosLoader(_batches(4, seed=5))
+    with ChaosPlan(seed=CHAOS_SEED).fail("loader.transfer", times=3):
+        out = list(ta.data.AsyncLoader(src, cfg))
+    assert counters.get("loader_fallbacks") == 1
+    ref = [b["input_ids"] for b in _batches(4, seed=5)]
+    assert len(out) == len(ref)
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(np.asarray(got["input_ids"]), want)
+
+
+def test_async_loader_skip_batches_bypasses_transfer(devices):
+    cfg = _loader_cfg()
+    src = ChaosLoader(_batches(5, seed=6))
+    plan = ChaosPlan(seed=CHAOS_SEED).fail("loader.transfer", times=0)
+    with plan:
+        out = list(ta.data.AsyncLoader(src, cfg).skip_batches(3))
+    assert len(out) == 2
+    # skipped batches never hit the pad/device-transfer path
+    assert plan.stats()["loader.transfer"]["hits"] == 2
+    want = _batches(5, seed=6)[3]["input_ids"]
+    np.testing.assert_array_equal(np.asarray(out[0]["input_ids"]), want)
+
+
+def test_async_loader_fatal_without_fallback(devices):
+    cfg = _loader_cfg(loader_retries=1, loader_sync_fallback=False)
+    src = ChaosLoader(_batches(4), fetch_faults={1: 99})
+    with pytest.raises(DataLoaderError):
+        list(ta.data.AsyncLoader(src, cfg))
+
+
+def test_async_loader_dead_generator_zero_retries_not_truncated(devices):
+    # with loader_retries=0 the failure degrades to sync mode; the
+    # handed-over error must still poison the consumer's first re-fetch
+    # so the closed generator reads as a failure, not end-of-stream
+    cfg = _loader_cfg(loader_retries=0, loader_sync_fallback=True)
+
+    def gen():
+        yield _batches(3, seed=9)[0]
+        raise OSError("stream died")
+
+    with pytest.raises(DataLoaderError):
+        list(ta.data.AsyncLoader(gen(), cfg))
+
+
+def test_async_loader_dead_generator_fails_loudly(devices):
+    # a plain generator that raises is CLOSED — retrying next() yields
+    # StopIteration, which must surface the original error, not a
+    # silently truncated epoch
+    cfg = _loader_cfg(loader_retries=2)
+
+    def gen():
+        yield from _batches(2, seed=8)
+        raise OSError("stream died")
+
+    with pytest.raises(DataLoaderError) as ei:
+        list(ta.data.AsyncLoader(gen(), cfg))
+    assert isinstance(ei.value.__cause__.__cause__, OSError)

@@ -16,7 +16,11 @@ optimizers run inside the compiled program (no syncfree variants needed).
 
 __version__ = "0.1.0"
 
-from torchacc_tpu import data, models, ops, parallel
+from torchacc_tpu.utils import compat as _compat
+
+_compat.install()
+
+from torchacc_tpu import data, errors, models, ops, parallel, resilience
 from torchacc_tpu.config import (
     ComputeConfig,
     Config,
@@ -28,6 +32,7 @@ from torchacc_tpu.config import (
     FSDPConfig,
     MemoryConfig,
     PPConfig,
+    ResilienceConfig,
     SPConfig,
     TPConfig,
 )
@@ -46,10 +51,13 @@ __all__ = [
     "PPConfig",
     "SPConfig",
     "EPConfig",
+    "ResilienceConfig",
     "accelerate",
+    "errors",
     "logger",
     "ops",
     "parallel",
+    "resilience",
 ]
 
 

@@ -13,16 +13,62 @@ has no equivalent because nothing is ever flattened.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
-from typing import Any, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
+from jax.tree_util import tree_flatten_with_path
 
+from torchacc_tpu.errors import (
+    CheckpointCorruptionError,
+    CheckpointError,
+    CheckpointNotFoundError,
+)
+from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.resilience.retry import RetryPolicy, retry_call
 from torchacc_tpu.train.state import TrainState
 from torchacc_tpu.utils.logger import logger
+
+#: Marker file written into a step directory only after the write is
+#: durable; steps without it are partial writes and are never resumed.
+MANIFEST = "_MANIFEST"
+_MANIFEST_FORMAT = 1
+
+
+def tree_digest(tree: Any) -> Dict[str, Any]:
+    """Structure summary of a state pytree: leaf count + sha256 over the
+    sorted ``path:shape:dtype`` lines.  Works on real arrays and on
+    ShapeDtypeStruct trees alike (None leaves are flattened out of both),
+    so a digest recorded at save time can be checked against a trainer's
+    abstract state before restoring."""
+    leaves, _ = tree_flatten_with_path(tree)
+    lines = sorted(
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        + f":{tuple(getattr(x, 'shape', ()))}:{getattr(x, 'dtype', '?')}"
+        for path, x in leaves)
+    h = hashlib.sha256("\n".join(lines).encode()).hexdigest()
+    return {"leaves": len(lines), "digest": h}
+
+
+def _snapshot(state: Any) -> Any:
+    """Donation-safe copy of a state pytree for async writes.
+
+    The training loop donates state buffers into the next jitted step;
+    an async checkpoint write that still references the live arrays then
+    races the donation — on CPU runtimes the buffers are *reused*, so
+    the write silently serialises a FUTURE step's values under this
+    step's label.  A device-local copy (sharding-preserving) decouples
+    the write from the step loop at the cost of one state-sized copy per
+    actual save."""
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, state)
 
 
 def save_checkpoint(path: str, state: Any, *, force: bool = False,
@@ -38,6 +84,8 @@ def save_checkpoint(path: str, state: Any, *, force: bool = False,
     permissions) and releases the writer's resources.
     """
     path = os.path.abspath(os.fspath(path))
+    if not blocking:
+        state = _snapshot(state)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state, force=force)
     handle = AsyncSave(ckptr, path)
@@ -79,6 +127,8 @@ def restore_checkpoint(
     (replicated) arrays, useful for inspection/consolidation.
     """
     path = os.path.abspath(os.fspath(path))
+    if not os.path.exists(path):
+        raise CheckpointNotFoundError(f"no checkpoint at {path}")
     ckptr = ocp.StandardCheckpointer()
     if abstract_state is None:
         return ckptr.restore(path)
@@ -243,42 +293,260 @@ def _restack_legacy_layers(tree: Any) -> tuple[Any, bool]:
 
 
 class CheckpointManager:
-    """Step-tracked checkpoint directory with retention.
+    """Step-tracked checkpoint directory with retention, commit markers,
+    integrity validation, and retried I/O.
 
     Reference analogue: the training scripts' periodic ``ta.save`` +
-    offline consolidation; here rotation/retention is built in.
+    offline consolidation; here rotation/retention is built in, plus the
+    resilience contract (docs/resilience.md):
+
+    - a ``_MANIFEST`` marker (step, time, tree-structure digest) is
+      written into each step directory only *after* the orbax write is
+      durable, so a partially-written step killed mid-save is never
+      picked up by ``latest_step()``/``restore()``;
+    - save/restore I/O is retried with jittered exponential backoff
+      (``retry_policy``; counter ``ckpt_retries``), so a flaky storage
+      blip below the retry limit is a log line, not a dead run;
+    - ``restore_latest_valid`` walks marked steps newest-first,
+      validating the manifest digest against the target state's
+      structure and falling back a step on corruption.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
-                 save_interval_steps: int = 1):
-        self._mgr = ocp.CheckpointManager(
-            os.path.abspath(directory),
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                save_interval_steps=save_interval_steps,
-            ),
+                 save_interval_steps: int = 1,
+                 retry_policy: Optional[RetryPolicy] = None):
+        self._dir = os.path.abspath(directory)
+        self._retry = (retry_policy if retry_policy is not None
+                       else RetryPolicy(max_retries=3))
+        # steps saved through this manager whose manifests are still
+        # pending (orbax save is async; the marker must be written last)
+        self._pending: Dict[int, Dict[str, Any]] = {}
+        self._options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
         )
+        self._mgr = ocp.CheckpointManager(self._dir, options=self._options)
 
-    def save(self, step: int, state: Any) -> bool:
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+    # -- save ---------------------------------------------------------------
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        # skip-check first so the donation-safe snapshot (copy) is only
+        # paid on steps that actually write
+        if not force:
+            try:
+                if not self._mgr.should_save(step):
+                    # skip step — but if the previous save's background
+                    # write has since finished, mark it NOW instead of
+                    # leaving a durable checkpoint unmarked for a whole
+                    # interval (a crash in that window would otherwise
+                    # force resume one interval further back)
+                    if self._pending and not self._mgr.is_saving_in_progress():
+                        self._commit_manifests()
+                    return False
+            except Exception:  # noqa: BLE001 - older orbax: let save decide
+                pass
+        # commit markers for earlier (now finished) saves before starting
+        # a new one: after a hard crash (SIGKILL/OOM) at most the single
+        # in-flight step is unmarked, not the whole run's worth
+        self._commit_manifests()
+        state = _snapshot(state)
+
+        def _once():
+            failpoint("checkpoint.save", step=step)
+            return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                  force=force)
+        try:
+            saved = retry_call(_once, policy=self._retry,
+                               counter="ckpt_retries",
+                               description=f"checkpoint save (step {step})")
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint save of step {step} to {self._dir} failed "
+                f"after {self._retry.max_retries + 1} attempt(s)") from e
+        if saved:
+            self._pending[step] = tree_digest(state)
         return saved
 
-    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
-        if step is None:
-            step = self._mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError("no checkpoint found")
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+    def _commit_manifests(self) -> None:
+        """Wait for in-flight orbax writes, then mark the completed steps.
+        The marker is last: a crash anywhere before this leaves an
+        unmarked (= invisible) step, never a bogus one."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, {}
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as e:
+            raise CheckpointError(
+                f"background checkpoint write under {self._dir} failed "
+                f"(steps {sorted(pending)} stay unmarked)") from e
+        for step, digest in sorted(pending.items()):
+            step_dir = os.path.join(self._dir, str(step))
+            if not os.path.isdir(step_dir):
+                continue  # already rotated out by max_to_keep
+            manifest = {"format": _MANIFEST_FORMAT, "step": step,
+                        "time": time.time(), "tree": digest}
+            tmp = os.path.join(step_dir, MANIFEST + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(step_dir, MANIFEST))
 
-    def latest_step(self) -> Optional[int]:
-        return self._mgr.latest_step()
+    # -- step enumeration ---------------------------------------------------
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._dir, str(step), MANIFEST)
+
+    def _read_manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def valid_steps(self) -> List[int]:
+        """Steps carrying a commit marker, ascending."""
+        self._commit_manifests()
+        return [s for s in self._mgr.all_steps()
+                if os.path.exists(self._manifest_path(s))]
 
     def all_steps(self):
         return self._mgr.all_steps()
 
+    def latest_step(self) -> Optional[int]:
+        marked = self.valid_steps()
+        if marked:
+            return marked[-1]
+        # Pre-manifest-era directory (no step is marked): honour it with
+        # a warning rather than refusing to resume.  A genuinely partial
+        # step always coexists with older *marked* steps, so this
+        # fallback never selects one.
+        legacy = self._mgr.all_steps()
+        if legacy:
+            logger.warning(
+                f"checkpoint dir {self._dir} has no {MANIFEST} markers "
+                "(written by an older version?); treating the newest step "
+                "as valid")
+            return max(legacy)
+        return None
+
+    # -- restore ------------------------------------------------------------
+    def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
+        self._commit_manifests()
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise CheckpointNotFoundError(
+                f"no checkpoint found under {self._dir}")
+
+        def _once():
+            failpoint("checkpoint.restore", step=step)
+            # Restore straight from the step's item directory: the
+            # manager infers its item layout by scanning step dirs, so a
+            # *sibling* step with a gutted payload can poison restores of
+            # perfectly healthy steps ("multiple checkpointable objects").
+            # The direct path is immune; fall back to the manager for
+            # layouts without a 'default' item dir.
+            item_dir = os.path.join(self._dir, str(step), "default")
+            if os.path.isdir(item_dir):
+                return ocp.StandardCheckpointer().restore(
+                    item_dir, abstract_state)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
+        try:
+            return retry_call(_once, policy=self._retry,
+                              counter="ckpt_retries",
+                              description=f"checkpoint restore (step {step})")
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint restore of step {step} from {self._dir} "
+                f"failed after {self._retry.max_retries + 1} attempt(s)"
+            ) from e
+
+    def validate_step(self, step: int,
+                      abstract_state: Optional[Any] = None) -> bool:
+        """Cheap integrity check: the manifest exists, parses, and (when
+        a target state is given) its tree-structure digest matches."""
+        manifest = self._read_manifest(step)
+        if manifest is None:
+            return False
+        if abstract_state is not None:
+            want = tree_digest(abstract_state)
+            got = manifest.get("tree", {})
+            if (got.get("leaves") != want["leaves"]
+                    or got.get("digest") != want["digest"]):
+                logger.warning(
+                    f"checkpoint step {step}: tree-structure digest "
+                    f"mismatch (checkpoint {got.get('leaves')} leaves, "
+                    f"target {want['leaves']}) — treating as invalid")
+                return False
+        return True
+
+    def restore_latest_valid(self, abstract_state: Any):
+        """Restore the newest step that passes validation, falling back
+        one step at a time on corruption.  Returns ``(state, step)``.
+
+        This is the ``Trainer.fit(resume='auto')`` engine: a step whose
+        manifest is missing/mismatched is skipped outright; a step whose
+        array payload turns out unreadable mid-restore is logged and the
+        previous step is tried.
+        """
+        candidates = sorted(self.valid_steps(), reverse=True)
+        if not candidates and self._mgr.all_steps():
+            legacy = self.latest_step()  # logs the legacy-dir warning
+            candidates = [legacy] if legacy is not None else []
+        errors: List[str] = []
+        for step in candidates:
+            if not self.validate_step(step, abstract_state) \
+                    and os.path.exists(self._manifest_path(step)):
+                errors.append(f"step {step}: structure mismatch")
+                continue
+            try:
+                return self.restore(abstract_state, step=step), step
+            except CheckpointError as e:
+                cause = e.__cause__ or e
+                logger.warning(
+                    f"checkpoint step {step} is unreadable ({cause!r}); "
+                    "falling back to the previous step")
+                errors.append(f"step {step}: {cause!r}")
+                self._quarantine(step)
+        if errors:
+            raise CheckpointCorruptionError(
+                f"no restorable checkpoint under {self._dir}: "
+                + "; ".join(errors))
+        raise CheckpointNotFoundError(
+            f"no checkpoint found under {self._dir}")
+
+    def _quarantine(self, step: int) -> None:
+        """Rename an unreadable step dir to ``<step>.corrupt`` (evidence
+        preserved, never deleted) and rebuild the orbax manager: a gutted
+        step dir poisons its item-layout inference, which would otherwise
+        fail every subsequent save/restore in the directory."""
+        src = os.path.join(self._dir, str(step))
+        dst = src + ".corrupt"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{src}.corrupt{n}"
+        try:
+            os.rename(src, dst)
+        except OSError as e:
+            logger.warning(
+                f"could not quarantine corrupt checkpoint step {step}: {e}")
+            return
+        logger.warning(
+            f"quarantined corrupt checkpoint step {step} -> {dst}")
+        try:
+            self._mgr.close()
+        except Exception:  # noqa: BLE001 - already degraded
+            pass
+        self._mgr = ocp.CheckpointManager(self._dir, options=self._options)
+
+    # -- lifecycle ----------------------------------------------------------
     def wait_until_finished(self):
-        self._mgr.wait_until_finished()
+        self._commit_manifests()
 
     def close(self):
-        self._mgr.close()
+        try:
+            self._commit_manifests()
+        finally:
+            self._mgr.close()

@@ -326,6 +326,81 @@ class EPConfig:
 
 
 @dataclass
+class ResilienceConfig:
+    """Fault tolerance: anomaly guards, retries, preemption handling.
+
+    The reference leans on HF Trainer resume + manual restarts; a
+    TPU-native framework owns this (resilience/ package, docs/
+    resilience.md).  Guards default OFF: the non-finite/spike verdict is
+    selected in-graph (no sync to *skip*), but the abort-after-N
+    guarantee requires one scalar device fetch per step, which breaks
+    async step dispatch — opt in for long unattended runs.
+    """
+
+    # skip optimizer updates on non-finite loss/grad (in-jit select, like
+    # the fp16 GradScaler skip; under float16 the scaler already owns the
+    # overflow skip and only the spike guard adds checks)
+    nan_guard: bool = False
+    # skip updates whose grad-norm z-score vs an EW mean/var exceeds
+    # spike_zscore (after spike_warmup_steps accepted steps)
+    spike_guard: bool = False
+    spike_zscore: float = 6.0
+    spike_ewma_alpha: float = 0.02
+    spike_warmup_steps: int = 20
+    # abort (AnomalyError, with diagnosis) after this many consecutive
+    # anomalous steps — a diverging run, not a glitch
+    max_consecutive_anomalies: int = 8
+    # checkpoint save/restore I/O retries (jittered exponential backoff)
+    ckpt_retries: int = 3
+    retry_base_delay_s: float = 0.5
+    retry_max_delay_s: float = 8.0
+    retry_deadline_s: Optional[float] = None   # total wall-clock budget
+    # async-loader batch-fetch retries; after they are exhausted the
+    # loader degrades to synchronous (consumer-thread) iteration instead
+    # of dying, when loader_sync_fallback is set
+    loader_retries: int = 2
+    loader_sync_fallback: bool = True
+    # write a blocking emergency checkpoint when a preemption signal
+    # (SIGTERM / request_preemption) arrives during Trainer.fit with a
+    # checkpoint_dir configured
+    emergency_checkpoint: bool = True
+
+    def validate(self) -> None:
+        _check(self.spike_zscore > 0,
+               "resilience.spike_zscore must be positive")
+        _check(0.0 < self.spike_ewma_alpha <= 1.0,
+               "resilience.spike_ewma_alpha must be in (0, 1]")
+        _check(self.spike_warmup_steps >= 0,
+               "resilience.spike_warmup_steps must be >= 0")
+        # with < 2 accepted samples the EW variance is degenerate and
+        # every healthy step z-scores as a spike
+        _check(not self.spike_guard or self.spike_warmup_steps >= 2,
+               "resilience.spike_warmup_steps must be >= 2 when "
+               "spike_guard is enabled (the EW variance needs at least "
+               "two accepted steps to be meaningful)")
+        _check(self.max_consecutive_anomalies >= 1,
+               "resilience.max_consecutive_anomalies must be >= 1")
+        _check(self.ckpt_retries >= 0, "resilience.ckpt_retries must be >= 0")
+        _check(self.loader_retries >= 0,
+               "resilience.loader_retries must be >= 0")
+        _check(self.retry_base_delay_s >= 0,
+               "resilience.retry_base_delay_s must be >= 0")
+        _check(self.retry_max_delay_s >= self.retry_base_delay_s,
+               "resilience.retry_max_delay_s must be >= retry_base_delay_s")
+        if self.retry_deadline_s is not None:
+            _check(self.retry_deadline_s > 0,
+                   "resilience.retry_deadline_s must be positive")
+
+    def retry_policy(self, max_retries: int) -> Any:
+        """The shared RetryPolicy view of the delay/deadline knobs."""
+        from torchacc_tpu.resilience.retry import RetryPolicy
+        return RetryPolicy(max_retries=max_retries,
+                           base_delay_s=self.retry_base_delay_s,
+                           max_delay_s=self.retry_max_delay_s,
+                           deadline_s=self.retry_deadline_s)
+
+
+@dataclass
 class DistConfig:
     """Parallelism composition + topology ordering.
 
@@ -392,6 +467,7 @@ class Config:
     memory: MemoryConfig = field(default_factory=MemoryConfig)
     data: DataConfig = field(default_factory=DataConfig)
     dist: DistConfig = field(default_factory=DistConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     # Gradient accumulation micro-steps per optimizer step (non-PP path;
     # under PP the pipeline's num_micro_batches plays this role).
     grad_accum: int = 1
@@ -404,6 +480,7 @@ class Config:
         self.memory.validate()
         self.data.validate()
         self.dist.validate()
+        self.resilience.validate()
         _check(self.grad_accum >= 1, "grad_accum must be >= 1")
 
     # -- mesh ---------------------------------------------------------------
@@ -466,6 +543,7 @@ _TYPE_MAP = {
     "memory": MemoryConfig,
     "data": DataConfig,
     "dist": DistConfig,
+    "resilience": ResilienceConfig,
     "dp": DPConfig,
     "tp": TPConfig,
     "fsdp": FSDPConfig,

@@ -1,4 +1,4 @@
-"""Async host->device input feed with bucketing.
+"""Async host->device input feed with bucketing and fault tolerance.
 
 Reference: ``AsyncLoader`` (core/async_loader.py:159-207) wraps any
 DataLoader in background worker threads that bucket, pad, and upload
@@ -6,23 +6,54 @@ batches ahead of compute.  TPU-native version: a producer thread buckets
 and pads on host, then ``jax.device_put`` with the batch NamedSharding
 starts the (async) transfer; a bounded queue of in-flight device batches
 gives double buffering so step N+1's upload overlaps step N's compute.
+
+Fault tolerance (resilience subsystem, ``Config.resilience``): batch
+fetches and device transfers are retried with jittered exponential
+backoff (``loader_retries``, counter ``loader_retries``); when retries
+are exhausted in the producer thread and ``loader_sync_fallback`` is
+set, the loader degrades to synchronous consumer-thread iteration
+instead of killing the run — some sources misbehave precisely *because*
+they are driven from a side thread, so the fallback both simplifies the
+failure and often clears it.  Fatal failures raise a typed
+:class:`~torchacc_tpu.errors.DataLoaderError`.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import queue
 import threading
 from typing import Any, Dict, Iterable, Iterator, Optional
 
 import jax
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from torchacc_tpu.config import Config
 from torchacc_tpu.data.bucketing import pad_batch
+from torchacc_tpu.errors import DataLoaderError
 from torchacc_tpu.parallel.sharding import batch_spec
+from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.resilience.retry import retry_call
 from torchacc_tpu.utils.logger import logger
 
 _SENTINEL = object()
+_EXHAUSTED = object()
+
+
+class _Degrade:
+    """Producer -> consumer handoff: async loading gave up; the consumer
+    continues synchronously from ``it`` (order is preserved because the
+    marker rides the same FIFO queue behind already-produced batches).
+    ``pending`` is a batch already fetched from ``it`` whose device
+    transfer failed — it must be retried by the consumer, not dropped.
+    ``err`` is the producer's final exception: the consumer's first
+    re-fetch seeds its truncation detector with it, so a generator
+    source that died does not read as a clean end-of-stream."""
+
+    def __init__(self, it: Iterator, pending=None, err=None):
+        self.it = it
+        self.pending = pending
+        self.err = err
 
 
 class AsyncLoader:
@@ -48,8 +79,87 @@ class AsyncLoader:
         self._buckets = config.data.bucket_sizes()
         self._pad_values = config.data.pad_value_dict
         self._prefetch = max(1, config.data.prefetch)
+        res = config.resilience
+        # a DataLoaderError raised inside a retried fetch means "this is
+        # final" (e.g. a generator source died) — never re-attempted
+        self._retry = dataclasses.replace(
+            res.retry_policy(res.loader_retries),
+            no_retry=(DataLoaderError,))
+        self._sync_fallback = res.loader_sync_fallback
+        self._rank_shardings: Dict[int, NamedSharding] = {}
+
+    # -- fault-wrapped primitives -------------------------------------------
+    def _fetch(self, it: Iterator, prior_err=None):
+        """One batch from the source (or _EXHAUSTED), retried on error.
+
+        Retrying ``next()`` is only sound for restartable iterators; a
+        plain *generator* that raised is closed, and re-calling it
+        yields StopIteration — which would silently truncate the epoch
+        (and misalign resume-skip replay).  End-of-stream right after a
+        failed attempt (this call's, or ``prior_err`` carried across a
+        degrade handoff) is therefore treated as the original failure,
+        loudly."""
+        state: Dict[str, Any] = {"err": prior_err}
+
+        def once():
+            failpoint("loader.fetch")
+            try:
+                item = next(it)
+            except StopIteration:
+                if state["err"] is not None:
+                    raise DataLoaderError(
+                        "batch source ended immediately after a failed "
+                        "fetch — generator-backed sources close on error "
+                        "and cannot be retried; surfacing the original "
+                        "failure instead of a truncated epoch"
+                    ) from state["err"]
+                return _EXHAUSTED
+            except Exception as e:
+                state["err"] = e
+                raise
+            return item
+        return retry_call(once, policy=self._retry, counter="loader_retries",
+                          description="loader batch fetch")
+
+    def _leaf_sharding(self, leaf) -> NamedSharding:
+        """Batch sharding truncated to the leaf's rank (scalars — e.g.
+        injected fault markers — replicate), mirroring the trainer's
+        per-leaf batch shardings.  Cached per rank: mesh and spec are
+        fixed for the loader's lifetime."""
+        ndim = getattr(leaf, "ndim", 0)
+        full = self._sharding.spec
+        if ndim >= len(full):
+            return self._sharding
+        sh = self._rank_shardings.get(ndim)
+        if sh is None:
+            sh = NamedSharding(self._sharding.mesh,
+                               PartitionSpec(*full[:ndim]))
+            self._rank_shardings[ndim] = sh
+        return sh
+
+    def _transfer(self, batch) -> Dict[str, jax.Array]:
+        """Pad + start the async device transfer, retried on error."""
+        def once():
+            failpoint("loader.transfer")
+            host = pad_batch(batch, self._buckets, self._pad_values)
+            # device_put is async: the DMA overlaps compute, and the
+            # bounded queue caps in-flight batches (double buffer).
+            return {k: jax.device_put(v, self._leaf_sharding(v))
+                    for k, v in host.items()}
+        return retry_call(once, policy=self._retry, counter="loader_retries",
+                          description="loader device transfer")
+
+    def skip_batches(self, n: int) -> Iterator[Dict[str, jax.Array]]:
+        """Iterate after fast-forwarding ``n`` source batches WITHOUT
+        padding or device-transferring them.  ``Trainer.fit`` uses this
+        on auto-resume so realigning the data stream costs host
+        iteration only, not ``n`` wasted device uploads."""
+        return self._iterate(skip=n)
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        return self._iterate(skip=0)
+
+    def _iterate(self, skip: int) -> Iterator[Dict[str, jax.Array]]:
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         err: list = []
         stop = threading.Event()
@@ -66,19 +176,50 @@ class AsyncLoader:
                     continue
             return False
 
+        it = iter(self._loader)
+
         def produce():
+            pending = None
+            skipping = False
             try:
-                for batch in self._loader:
+                skipping = True
+                for _ in range(skip):
+                    if stop.is_set() or self._fetch(it) is _EXHAUSTED:
+                        return
+                skipping = False
+                while True:
                     if stop.is_set():
                         return
-                    host = pad_batch(batch, self._buckets, self._pad_values)
-                    # device_put is async: the DMA overlaps compute, and the
-                    # bounded queue caps in-flight batches (double buffer).
-                    dev = {k: jax.device_put(v, self._sharding)
-                           for k, v in host.items()}
+                    pending = self._fetch(it)
+                    if pending is _EXHAUSTED:
+                        break
+                    dev = self._transfer(pending)
+                    pending = None
                     if not _put(dev):
                         return
-            except Exception as e:  # surface in the consumer thread
+            except Exception as e:
+                # no degrade for (a) failures while replaying the resume
+                # prefix — that would silently misalign the data stream
+                # against the restored step count — or (b) typed fatal
+                # errors (a dead generator source cannot be resumed from
+                # the consumer thread either)
+                if self._sync_fallback and not skipping \
+                        and not isinstance(e, DataLoaderError):
+                    # hand the iterator (and any batch whose transfer
+                    # failed) back: the consumer retries this position
+                    # synchronously (some sources fail only when driven
+                    # from a side thread)
+                    logger.warning(
+                        f"async loading failed after retries ({e!r}); "
+                        "degrading to synchronous loading")
+                    from torchacc_tpu.utils.metrics import counters
+                    counters.inc("loader_fallbacks")
+                    # err seeds the consumer's truncation detector only
+                    # for FETCH failures; after a transfer failure the
+                    # iterator itself is healthy
+                    _put(_Degrade(it, pending,
+                                  None if pending is not None else e))
+                    return
                 err.append(e)
                 logger.error(f"AsyncLoader producer failed: {e}")
             finally:
@@ -91,11 +232,48 @@ class AsyncLoader:
                 item = q.get()
                 if item is _SENTINEL:
                     if err:
-                        raise err[0]
+                        raise DataLoaderError(
+                            "input pipeline failed (batch fetch/transfer "
+                            "retries exhausted)") from err[0]
+                    return
+                if isinstance(item, _Degrade):
+                    yield from self._iterate_sync(item.it, item.pending,
+                                                  item.err)
                     return
                 yield item
         finally:
             stop.set()
+            # drain the queue so a producer blocked in _put can observe
+            # stop, then wait (bounded) for it to leave the runtime — a
+            # daemon thread abandoned inside a device transfer trips
+            # std::terminate at interpreter teardown
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5.0)
+
+    def _iterate_sync(self, it: Iterator, pending=None,
+                      prior_err=None) -> Iterator[Dict[str, jax.Array]]:
+        """Degraded mode: fetch + transfer inline on the consumer thread
+        (no prefetch overlap); errors here are fatal and typed.
+        ``pending`` is a batch the producer fetched but failed to
+        transfer — it goes first so nothing is dropped."""
+        while True:
+            try:
+                batch = pending if pending is not None \
+                    else self._fetch(it, prior_err)
+                pending = prior_err = None
+                if batch is _EXHAUSTED:
+                    return
+                yield self._transfer(batch)
+            except StopIteration:  # pragma: no cover - defensive
+                return
+            except Exception as e:
+                raise DataLoaderError(
+                    "input pipeline failed in synchronous-fallback mode"
+                ) from e
 
     def __len__(self) -> int:
         return len(self._loader)  # type: ignore[arg-type]

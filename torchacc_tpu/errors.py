@@ -1,0 +1,69 @@
+"""Typed exception hierarchy for the framework.
+
+The reference raises bare ``RuntimeError``/``ValueError`` from its save
+and dist paths (e.g. torchacc/utils/checkpoint.py); a fault-tolerance
+layer needs error types a supervisor can branch on — "checkpoint step is
+corrupt, fall back" is a different action from "the trainer was asked to
+save before init".  Everything derives from :class:`TorchAccTPUError` so
+``except TorchAccTPUError`` catches any framework-originated failure
+without swallowing genuine bugs (TypeError, AttributeError, ...).
+
+``ConfigError`` (config.py) predates this module and stays where it is;
+it is re-exported here so one import site covers the whole hierarchy.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from torchacc_tpu.config import ConfigError  # noqa: F401  (re-export)
+
+
+class TorchAccTPUError(Exception):
+    """Base class for framework-raised errors."""
+
+
+class CheckpointError(TorchAccTPUError):
+    """Checkpoint save/restore failed (I/O, corruption, retry exhausted)."""
+
+
+class CheckpointNotFoundError(CheckpointError, FileNotFoundError):
+    """No (valid) checkpoint exists where one was requested.
+
+    Also a ``FileNotFoundError`` so pre-existing ``except
+    FileNotFoundError`` callers of ``CheckpointManager.restore`` keep
+    working.
+    """
+
+
+class CheckpointCorruptionError(CheckpointError):
+    """A checkpoint step exists but failed integrity validation
+    (missing/unparseable manifest, tree-structure digest mismatch, or an
+    unreadable array payload)."""
+
+
+class TrainerStateError(TorchAccTPUError):
+    """The Trainer was driven in an invalid order (e.g. ``save()`` before
+    ``init()``/``step()``)."""
+
+
+class DataLoaderError(TorchAccTPUError):
+    """The input pipeline failed fatally (batch fetch retries exhausted
+    with synchronous fallback disabled or also failing)."""
+
+
+class AnomalyError(TorchAccTPUError):
+    """Too many consecutive anomalous steps — the run is diverging, not
+    glitching.  Carries a diagnosis so the operator sees *what* tripped
+    (non-finite loss vs gradient-norm spike) without re-running."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 kind: Optional[str] = None, consecutive: int = 0,
+                 loss: Optional[float] = None,
+                 grad_norm: Optional[float] = None):
+        super().__init__(message)
+        self.step = step
+        self.kind = kind
+        self.consecutive = consecutive
+        self.loss = loss
+        self.grad_norm = grad_norm

@@ -1,0 +1,41 @@
+"""Fault-tolerance subsystem: preemption-safe auto-resume, step-level
+anomaly guards, retry/backoff for flaky I/O, and a deterministic
+fault-injection harness.
+
+See docs/resilience.md for the operator-facing contract (what is and is
+not guaranteed).  Wiring: ``Config.resilience`` (config.py) configures
+the guards and retry policies; ``Trainer.fit(resume='auto')``
+(train/trainer.py) is the auto-resume entry point; checkpoint and data
+I/O pick up the retry policies automatically.
+"""
+
+from torchacc_tpu.resilience.chaos import (
+    ChaosLoader,
+    ChaosPlan,
+    chaos_loss,
+    failpoint,
+)
+from torchacc_tpu.resilience.guard import GuardMonitor, guard_apply, guard_init
+from torchacc_tpu.resilience.preemption import (
+    clear_preemption,
+    install_preemption_handler,
+    preemption_requested,
+    request_preemption,
+)
+from torchacc_tpu.resilience.retry import RetryPolicy, retry_call
+
+__all__ = [
+    "ChaosLoader",
+    "ChaosPlan",
+    "chaos_loss",
+    "failpoint",
+    "GuardMonitor",
+    "guard_apply",
+    "guard_init",
+    "install_preemption_handler",
+    "preemption_requested",
+    "request_preemption",
+    "clear_preemption",
+    "RetryPolicy",
+    "retry_call",
+]

@@ -1,0 +1,200 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Two complementary mechanisms:
+
+**Failpoints** — named hooks compiled into production I/O paths
+(``checkpoint.save``, ``checkpoint.restore``, ``loader.fetch``,
+``loader.transfer``).  They cost one global ``is None`` check when no
+plan is active; with a :class:`ChaosPlan` active they raise configured
+exceptions deterministically (fixed hit counts, or a seeded rate — the
+same seed always yields the same fault sequence).  This is the
+Go-failpoint / TiKV ``fail::fail_point!`` pattern: the injection seam
+lives in the real code path, so tests exercise the exact retry/backoff
+branches production will take.
+
+**Data-level faults** — :class:`ChaosLoader` wraps a batch stream and
+injects (a) NaN losses, via a ``chaos_loss_mul`` scalar the
+:func:`chaos_loss` function multiplies into the loss sum (NaN poisons
+loss AND gradients, exactly like a real numeric blow-up), (b) simulated
+preemptions (``resilience.preemption.request_preemption`` at a chosen
+step), and (c) transient fetch errors.  Injection rides the batch dict,
+so the jitted program is identical between clean and chaos runs — the
+bitwise-equivalence tests in tests/test_resilience.py depend on that.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Set
+
+from torchacc_tpu.utils.logger import logger
+
+_active: Optional["ChaosPlan"] = None
+_lock = threading.Lock()
+
+
+def failpoint(name: str, **ctx: Any) -> None:
+    """Hook compiled into production I/O paths; no-op unless a plan is
+    active.  May raise the plan's configured exception."""
+    plan = _active
+    if plan is not None:
+        plan.hit(name, ctx)
+
+
+@dataclass
+class _Rule:
+    times: int = 0                 # raise on the first `times` hits ...
+    rate: float = 0.0              # ... plus with this seeded probability
+    exc: Callable[[str], BaseException] = OSError
+    raised: int = 0
+    hits: int = 0
+
+
+@dataclass
+class ChaosPlan:
+    """A seeded set of failpoint rules, activated as a context manager::
+
+        plan = ChaosPlan(seed=0)
+        plan.fail("checkpoint.save", times=2, exc=OSError)
+        with plan:
+            ...   # first two checkpoint saves raise OSError
+
+    The same seed reproduces the same rate-based fault sequence.
+    """
+
+    seed: int = 0
+    _rules: Dict[str, _Rule] = field(default_factory=dict)
+    _rng: random.Random = field(default=None, repr=False)  # type: ignore
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    def fail(self, point: str, *, times: int = 1, rate: float = 0.0,
+             exc: Callable[[str], BaseException] = OSError) -> "ChaosPlan":
+        self._rules[point] = _Rule(times=times, rate=rate, exc=exc)
+        return self
+
+    def hit(self, point: str, ctx: Dict[str, Any]) -> None:
+        rule = self._rules.get(point)
+        if rule is None:
+            return
+        rule.hits += 1
+        inject = (rule.raised < rule.times
+                  or (rule.rate > 0.0 and self._rng.random() < rule.rate))
+        if inject:
+            rule.raised += 1
+            logger.warning(
+                f"chaos: injecting fault #{rule.raised} at {point} "
+                f"({ctx or {}})")
+            raise rule.exc(f"chaos-injected fault at {point} "
+                           f"(#{rule.raised}, ctx={ctx})")
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        return {p: {"hits": r.hits, "raised": r.raised}
+                for p, r in self._rules.items()}
+
+    def __enter__(self) -> "ChaosPlan":
+        global _active
+        with _lock:
+            if _active is not None:
+                raise RuntimeError("a ChaosPlan is already active")
+            _active = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _active
+        with _lock:
+            _active = None
+
+
+def chaos_loss():
+    """Default-equivalent loss that honours ``chaos_loss_mul``.
+
+    Identical math to the Trainer's default loss (sum/count cross-entropy
+    with -100 skip) with the loss sum multiplied by the per-batch
+    ``chaos_loss_mul`` scalar ChaosLoader injects (1.0 normally, NaN on
+    fault steps — multiplying by 1.0 is bitwise-exact, so clean runs
+    through the harness match runs without it).
+    """
+    def loss(logits, batch):
+        from torchacc_tpu.models.transformer import loss_sum_count
+        from torchacc_tpu.train.trainer import shift_labels
+        s, c = loss_sum_count(
+            logits, batch.get("labels", shift_labels(
+                batch["input_ids"], batch.get("segment_ids"))))
+        mul = batch.get("chaos_loss_mul")
+        if mul is not None:
+            s = s * mul
+        return s, c
+    return loss
+
+
+class ChaosLoader:
+    """Deterministic data-level fault injector around a batch iterable.
+
+    Every yielded batch gains a ``chaos_loss_mul`` float32 scalar (1.0,
+    or NaN when the 0-based batch index is in ``nan_loss_steps``) —
+    pair with :func:`chaos_loss`.  ``preempt_after_step=k`` requests
+    preemption while yielding batch ``k`` (the training loop finishes
+    step ``k``, then sees the flag at the step boundary — the timing of
+    a real SIGTERM).  ``fetch_faults={index: n}`` makes ``__next__``
+    raise ``fetch_exc`` ``n`` times before successfully yielding batch
+    ``index`` — a transiently flaky source for exercising loader
+    retries.  Wrap the *outermost* iterable (inside any AsyncLoader) so
+    step indices line up with trainer steps.
+    """
+
+    def __init__(self, loader: Iterable[Dict[str, Any]], *,
+                 nan_loss_steps: Iterable[int] = (),
+                 loss_scale_steps: Optional[Dict[int, float]] = None,
+                 preempt_after_step: Optional[int] = None,
+                 fetch_faults: Optional[Dict[int, int]] = None,
+                 fetch_exc: Callable[[str], BaseException] = OSError):
+        self._loader = loader
+        self._nan: Set[int] = set(nan_loss_steps)
+        # finite multipliers (e.g. 1e4) simulate gradient blow-ups for
+        # the spike guard without going non-finite
+        self._scale = dict(loss_scale_steps or {})
+        self._preempt = preempt_after_step
+        self._fetch_faults = dict(fetch_faults or {})
+        self._fetch_exc = fetch_exc
+
+    def __iter__(self) -> Iterator[Dict[str, Any]]:
+        return _ChaosIterator(self)
+
+    def __len__(self) -> int:
+        return len(self._loader)  # type: ignore[arg-type]
+
+
+class _ChaosIterator:
+    def __init__(self, cl: ChaosLoader):
+        self._cl = cl
+        self._it = iter(cl._loader)
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict[str, Any]:
+        import numpy as np
+        cl = self._cl
+        i = self._i
+        pending = cl._fetch_faults.get(i, 0)
+        if pending > 0:
+            cl._fetch_faults[i] = pending - 1
+            raise cl._fetch_exc(
+                f"chaos-injected fetch fault at batch {i} "
+                f"({pending - 1} remaining)")
+        batch = dict(next(self._it))
+        if i in cl._nan:
+            mul = np.float32("nan")
+        else:
+            mul = np.float32(cl._scale.get(i, 1.0))
+        batch["chaos_loss_mul"] = np.asarray(mul, np.float32)
+        if cl._preempt is not None and i == cl._preempt:
+            from torchacc_tpu.resilience.preemption import request_preemption
+            request_preemption(f"chaos: simulated eviction at step {i}")
+        self._i = i + 1
+        return batch

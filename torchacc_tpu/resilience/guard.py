@@ -1,0 +1,144 @@
+"""Step-level anomaly guards: non-finite loss and gradient-norm spikes.
+
+A single NaN loss (bad shard, numeric edge) or a pathological gradient
+spike should cost one skipped batch, not a dead run or a corrupted
+optimizer state.  The guard runs *inside* the jitted train step, in the
+same shape as the fp16 GradScaler skip (train/amp.py): compute the
+candidate update, then ``select_tree`` between candidate and previous
+state on a scalar verdict — no host sync is needed to *skip*.
+
+Spike detection keeps an exponentially-weighted mean/variance of the
+gradient norm (West's EW update) in a tiny replicated guard-state pytree
+threaded through the step; a step whose norm z-score exceeds the
+configured threshold after warmup is rejected and does NOT update the
+statistics (one spike must not inflate the variance and mask the next).
+
+Aborting after N *consecutive* anomalies is host-side by necessity
+(Python must raise): :class:`GuardMonitor` reads the per-step anomaly
+verdict — one scalar device fetch per step, the price of the abort
+guarantee — and raises :class:`~torchacc_tpu.errors.AnomalyError` with a
+diagnosis.  Guard state is intentionally NOT checkpointed: statistics
+re-warm after resume (documented non-guarantee, docs/resilience.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchacc_tpu.config import ResilienceConfig
+from torchacc_tpu.errors import AnomalyError
+from torchacc_tpu.utils.logger import logger
+
+# anomaly kind codes (metrics["anomaly_kind"])
+KIND_NONE = 0
+KIND_NONFINITE = 1
+KIND_SPIKE = 2
+_KIND_NAMES = {KIND_NONFINITE: "non-finite loss/grad",
+               KIND_SPIKE: "grad-norm spike"}
+
+
+def guard_init() -> Dict[str, jax.Array]:
+    """Fresh EW statistics (replicated scalars)."""
+    return {
+        "mean": jnp.zeros((), jnp.float32),
+        "var": jnp.zeros((), jnp.float32),
+        "count": jnp.zeros((), jnp.int32),
+    }
+
+
+def guard_apply(
+    gstate: Dict[str, jax.Array],
+    loss: jax.Array,
+    grad_norm: jax.Array,
+    cfg: ResilienceConfig,
+    *,
+    check_finite: bool = True,
+) -> Tuple[jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Judge one step; traced inside the train step.
+
+    Returns ``(ok, kind, new_gstate)`` — ``ok`` is a bool scalar (True =
+    apply the update), ``kind`` an int32 anomaly code.  ``check_finite``
+    is disabled by the trainer when the fp16 GradScaler already owns
+    overflow skipping (a scaler backoff is not an anomaly).
+    """
+    gn = grad_norm.astype(jnp.float32)
+    finite = jnp.isfinite(loss) & jnp.isfinite(gn)
+    false = jnp.zeros((), bool)
+    nonfinite_anom = (~finite) if (cfg.nan_guard and check_finite) else false
+    if cfg.spike_guard:
+        warm = gstate["count"] >= cfg.spike_warmup_steps
+        std = jnp.sqrt(jnp.maximum(gstate["var"], 0.0))
+        z = (gn - gstate["mean"]) / (std + 1e-8)
+        spike = warm & finite & (z > cfg.spike_zscore)
+    else:
+        spike = false
+    ok = ~(nonfinite_anom | spike)
+    kind = jnp.where(nonfinite_anom, KIND_NONFINITE,
+                     jnp.where(spike, KIND_SPIKE, KIND_NONE)).astype(jnp.int32)
+
+    # EW mean/var update on accepted finite steps only.  The FIRST
+    # accepted norm seeds the mean outright: an EW climb from a zero
+    # init would leave the early mean far below the true norm and the
+    # variance dominated by that bias, making healthy steps z-score as
+    # spikes right after warmup.
+    upd = ok & finite
+    first = gstate["count"] == 0
+    a = jnp.float32(cfg.spike_ewma_alpha)
+    delta = gn - gstate["mean"]
+    mean_next = jnp.where(first, gn, gstate["mean"] + a * delta)
+    var_next = jnp.where(
+        first, 0.0, (1.0 - a) * (gstate["var"] + a * delta * delta))
+    new_gstate = {
+        "mean": jnp.where(upd, mean_next, gstate["mean"]),
+        "var": jnp.where(upd, var_next, gstate["var"]),
+        "count": gstate["count"] + upd.astype(jnp.int32),
+    }
+    return ok, kind, new_gstate
+
+
+class GuardMonitor:
+    """Host-side consecutive-anomaly tracker (abort-after-N).
+
+    ``observe`` fetches the step's anomaly scalar (the one host sync the
+    guard costs), increments the ``anomalies_skipped`` counter, and
+    raises :class:`AnomalyError` once ``max_consecutive_anomalies``
+    anomalous steps occur in a row.
+    """
+
+    def __init__(self, cfg: ResilienceConfig):
+        self._max = cfg.max_consecutive_anomalies
+        self._consec = 0
+
+    @property
+    def consecutive(self) -> int:
+        return self._consec
+
+    def observe(self, step: int, metrics: Dict[str, jax.Array]) -> bool:
+        """Returns True when the step was anomalous (and skipped)."""
+        kind = int(metrics.get("anomaly_kind", 0))
+        if kind == KIND_NONE:
+            self._consec = 0
+            return False
+        self._consec += 1
+        from torchacc_tpu.utils.metrics import counters
+        counters.inc("anomalies_skipped")
+        loss = float(metrics["loss"])
+        gn = float(metrics["grad_norm"])
+        logger.warning(
+            f"step {step}: anomaly ({_KIND_NAMES[kind]}; loss={loss:.4g} "
+            f"grad_norm={gn:.4g}) — update skipped "
+            f"({self._consec}/{self._max} consecutive)")
+        if self._consec >= self._max:
+            raise AnomalyError(
+                f"aborting: {self._consec} consecutive anomalous steps "
+                f"(last: {_KIND_NAMES[kind]} at step {step}, "
+                f"loss={loss:.4g}, grad_norm={gn:.4g}).  The run is "
+                "diverging, not glitching — lower the learning rate, "
+                "check the data shard, or resume from an earlier "
+                "checkpoint.",
+                step=step, kind=_KIND_NAMES[kind], consecutive=self._consec,
+                loss=loss, grad_norm=gn)
+        return True

@@ -1,0 +1,78 @@
+"""Preemption awareness: catch the eviction signal, checkpoint, exit clean.
+
+TPU pods are preemptible by design: maintenance events and spot
+reclamation deliver SIGTERM with a short grace window.  The pattern
+(Orbax emergency checkpointing, MaxText's
+``jax.distributed...reached_preemption_sync_point``) is: a signal
+handler flips a flag, the step loop polls it at step boundaries, and on
+preemption performs one *blocking* save before returning — a resumed job
+then loses at most the in-flight step.
+
+State is process-global (a signal is process-global) and thread-safe; a
+previously-installed handler is chained, not clobbered.
+``request_preemption`` triggers the same path programmatically — the
+fault-injection harness (resilience/chaos.py) uses it to simulate
+eviction deterministically in tests.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable, Optional
+
+from torchacc_tpu.utils.logger import logger
+
+_event = threading.Event()
+_installed: set = set()
+_lock = threading.Lock()
+
+
+def install_preemption_handler(
+        signals: Iterable[int] = (signal.SIGTERM,)) -> bool:
+    """Install the flag-setting handler (idempotent, chains any previous
+    handler).  Returns False when not callable from this thread (signal
+    handlers can only be installed from the main thread)."""
+    with _lock:
+        todo = [s for s in signals if s not in _installed]
+        if not todo:
+            return True
+        for sig in todo:
+            try:
+                prev = signal.getsignal(sig)
+
+                def handler(signum, frame, _prev=prev):
+                    _event.set()
+                    logger.warning(
+                        f"received signal {signum}: preemption requested — "
+                        "an emergency checkpoint will be written at the "
+                        "next step boundary")
+                    if callable(_prev) and _prev not in (
+                            signal.SIG_IGN, signal.SIG_DFL):
+                        _prev(signum, frame)
+
+                signal.signal(sig, handler)
+                _installed.add(sig)
+            except ValueError:
+                # not the main thread — poll-only mode still works via
+                # request_preemption()
+                logger.debug(
+                    "preemption handler not installed (not in main thread)")
+                return False
+    return True
+
+
+def preemption_requested() -> bool:
+    return _event.is_set()
+
+
+def request_preemption(reason: str = "") -> None:
+    """Programmatic preemption (chaos harness, external schedulers)."""
+    if reason:
+        logger.warning(f"preemption requested: {reason}")
+    _event.set()
+
+
+def clear_preemption() -> None:
+    """Reset the flag (tests; or a supervisor that handled the event)."""
+    _event.clear()

@@ -18,6 +18,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from torchacc_tpu.config import Config
+from torchacc_tpu.errors import TrainerStateError
 from torchacc_tpu.models.axes import param_axes as resolve_param_axes
 from torchacc_tpu.models.transformer import loss_sum_count
 from torchacc_tpu.parallel.sharding import (
@@ -105,6 +106,17 @@ class Trainer:
                               # head_bias models (phi-2) use the
                               # materialised-logits loss
                               and not model.cfg.head_bias)
+        # step-level anomaly guards (resilience/guard.py): EW grad-norm
+        # statistics threaded through the jitted step, host-side
+        # consecutive-anomaly monitor
+        res = config.resilience
+        # fp16's GradScaler already owns non-finite skipping, so a
+        # nan_guard alone would be a permanent no-op there — don't pay
+        # the guard's per-step host sync for it
+        self._guard_on = res.spike_guard or (
+            res.nan_guard and config.compute.dtype != "float16")
+        self._guard_state = None
+        self._guard_monitor = None
         self.state: Optional[TrainState] = None
         self.state_shardings = None
         self._abstract: Optional[TrainState] = None
@@ -321,8 +333,11 @@ class Trainer:
         offload_live = offload_is_live(self.config.memory)
 
         shadow_on = self._shadow_on
+        res_cfg = self.config.resilience
+        guard_on = self._guard_on
 
-        def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        def train_step(state: TrainState, batch: Dict[str, jax.Array],
+                       gstate=None):
             # bf16 compute-params: the forward differentiates the bf16
             # shadow out of opt_state (no full-tree f32->bf16 cast in
             # the step); the optimizer applies the bf16 grads to the f32
@@ -397,6 +412,23 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 loss_val = loss_s / scale
 
+            from torchacc_tpu.train.amp import global_norm_f32
+
+            # f32-accumulated: bf16 grad trees (shadow mode) would
+            # otherwise norm-reduce in bf16
+            grad_norm = global_norm_f32(grads)
+            ok = kind = new_gstate = None
+            if guard_on:
+                # anomaly verdict (resilience/guard.py): non-finite loss
+                # and/or EW grad-norm spike, selected in-graph below the
+                # same way the fp16 scaler skips overflow steps.  Under
+                # the scaler, overflow handling stays the scaler's job —
+                # a scale backoff is not an anomaly.
+                from torchacc_tpu.resilience.guard import guard_apply
+                ok, kind, new_gstate = guard_apply(
+                    gstate, loss_val, grad_norm, res_cfg,
+                    check_finite=not use_scaler)
+
             new_scaler = state.scaler
             if use_scaler:
                 from torchacc_tpu.train.amp import (
@@ -411,24 +443,33 @@ class Trainer:
                     safe_grads, state.opt_state, state.params)
                 params_candidate = optax.apply_updates(state.params, updates)
                 # skip the step entirely on overflow — no host sync
-                new_params = select_tree(finite, params_candidate,
+                keep = finite if ok is None else finite & ok
+                new_params = select_tree(keep, params_candidate,
                                          state.params)
-                new_opt = select_tree(finite, opt_candidate, state.opt_state)
+                new_opt = select_tree(keep, opt_candidate, state.opt_state)
                 new_scaler = scaler_update(state.scaler, finite)
             else:
-                updates, new_opt = optimizer.update(
+                updates, opt_candidate = optimizer.update(
                     grads, state.opt_state, state.params)
-                new_params = optax.apply_updates(state.params, updates)
+                params_candidate = optax.apply_updates(state.params, updates)
+                if ok is None:
+                    new_params, new_opt = params_candidate, opt_candidate
+                else:
+                    from torchacc_tpu.train.amp import select_tree
+                    new_params = select_tree(ok, params_candidate,
+                                             state.params)
+                    new_opt = select_tree(ok, opt_candidate,
+                                          state.opt_state)
 
-            from torchacc_tpu.train.amp import global_norm_f32
             metrics = {
                 "loss": loss_val,
-                # f32-accumulated: bf16 grad trees (shadow mode) would
-                # otherwise norm-reduce in bf16
-                "grad_norm": global_norm_f32(grads),
+                "grad_norm": grad_norm,
             }
             if use_scaler:
                 metrics["loss_scale"] = new_scaler["scale"]
+            if guard_on:
+                metrics["anomaly"] = (~ok).astype(jnp.float32)
+                metrics["anomaly_kind"] = kind
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, scaler=new_scaler)
             if offload_live:
@@ -440,6 +481,12 @@ class Trainer:
                 metrics = jax.tree.map(
                     lambda m: jax.lax.with_sharding_constraint(
                         m, self._metrics_sharding), metrics)
+                if guard_on:
+                    new_gstate = jax.tree.map(
+                        lambda g: jax.lax.with_sharding_constraint(
+                            g, self._metrics_sharding), new_gstate)
+            if guard_on:
+                return new_state, new_gstate, metrics
             return new_state, metrics
 
         # Host-offload remat makes the lowered module contain memory-kind
@@ -451,6 +498,20 @@ class Trainer:
         # sharding').  Pinning the outputs with in-graph
         # with_sharding_constraint instead keeps the layouts AND skips
         # the output-annotate path, so multi-device SPMD offload works.
+        if guard_on:
+            # guard statistics ride as a donated third operand (replicated
+            # scalars); deliberately NOT part of TrainState so checkpoint
+            # layouts are unchanged — stats re-warm after resume
+            return jax.jit(
+                train_step,
+                in_shardings=(self.state_shardings,
+                              self._batch_shardings(sample_batch),
+                              self._metrics_sharding),
+                out_shardings=(None if offload_live else
+                               (self.state_shardings, self._metrics_sharding,
+                                self._metrics_sharding)),
+                donate_argnums=(0, 2),
+            )
         return jax.jit(
             train_step,
             in_shardings=(self.state_shardings,
@@ -471,8 +532,22 @@ class Trainer:
         if self._train_step is None or structure != self._train_step_structure:
             self._train_step = self._build_train_step(batch)
             self._train_step_structure = structure
+        if self._guard_on and self._guard_state is None:
+            from torchacc_tpu.resilience.guard import GuardMonitor, guard_init
+            self._guard_state = jax.device_put(guard_init(),
+                                               self._metrics_sharding)
+            self._guard_monitor = GuardMonitor(self.config.resilience)
         with jax.sharding.set_mesh(self.mesh):
-            self.state, metrics = self._train_step(self.state, batch)
+            if self._guard_on:
+                self.state, self._guard_state, metrics = self._train_step(
+                    self.state, batch, self._guard_state)
+            else:
+                self.state, metrics = self._train_step(self.state, batch)
+        if self._guard_on:
+            # the abort-after-N guarantee costs one scalar fetch per step
+            # (see ResilienceConfig); raises AnomalyError with a
+            # diagnosis once max_consecutive_anomalies is reached
+            self._guard_monitor.observe(int(self.state.step) - 1, metrics)
         return metrics
 
     # -- checkpointing ------------------------------------------------------
@@ -495,15 +570,31 @@ class Trainer:
         ``blocking=False`` snapshots and writes in the background;
         call ``.wait()`` on the returned handle before relying on it."""
         if self.state is None:
-            raise RuntimeError("nothing to save — call init() (or step) first")
+            raise TrainerStateError(
+                "nothing to save — call init() (or step) first")
         from torchacc_tpu.checkpoint import save_checkpoint
         return save_checkpoint(path, self.state, blocking=blocking)
+
+    def _adopt_restored(self, state: TrainState) -> TrainState:
+        """Re-materialise restored arrays through a jitted identity.
+
+        Orbax-deserialized buffers donated into a persistent-cache
+        executable double-free on some jaxlib CPU builds ("corrupted
+        double-linked list" abort on the first post-restore step); the
+        copy is bitwise-exact, lands buffers the runtime owns, and costs
+        one state-sized copy only at restore time."""
+        with jax.sharding.set_mesh(self.mesh):
+            state = jax.jit(
+                lambda s: s, out_shardings=self.state_shardings)(state)
+        jax.block_until_ready(state)
+        return state
 
     def restore(self, path: str) -> TrainState:
         """Restore (and reshard if the mesh/layout changed).  Does NOT
         run init first — restored shards are the only allocation."""
         from torchacc_tpu.checkpoint import restore_checkpoint
-        self.state = restore_checkpoint(path, self.abstract_state())
+        self.state = self._adopt_restored(
+            restore_checkpoint(path, self.abstract_state()))
         return self.state
 
     # -- high-level loop ----------------------------------------------------
@@ -519,6 +610,7 @@ class Trainer:
         log_every: int = 50,
         metrics_dir: Optional[str] = None,
         metrics_step_offset: int = 0,
+        resume: Optional[str] = None,
     ):
         """Run the training loop (reference analogue: the HF-Trainer
         integration the reference enables via accelerate_hf_trainer.py —
@@ -531,24 +623,97 @@ class Trainer:
         epoch (HFTrainerAdapter) pass their global step so the scalar
         charts stay monotonic.
 
+        ``resume='auto'`` (requires ``checkpoint_dir``) restores the
+        newest *valid* checkpoint step — commit-marked, manifest digest
+        matching this trainer's state structure, payload readable,
+        falling back a step on corruption — then skips that many batches
+        from ``loader`` so the data stream stays aligned, and continues
+        counting steps from there.  With no checkpoint yet it starts
+        fresh.  While a ``checkpoint_dir`` is set (and
+        ``resilience.emergency_checkpoint`` is on, the default), a
+        preemption signal (SIGTERM, or chaos-injected) triggers one
+        blocking emergency save at the step boundary and a clean return
+        — a rescheduled job resumes losing at most the in-flight step.
+        See docs/resilience.md for guarantees and non-guarantees.
+
         Returns a list of {step, loss, ...} log records."""
         import time as _time
 
-        from torchacc_tpu.utils.metrics import open_metrics
+        from torchacc_tpu.utils.metrics import counters, open_metrics
+        res_cfg = self.config.resilience
         mgr = None
         if checkpoint_dir is not None:
             from torchacc_tpu.checkpoint import CheckpointManager
-            mgr = CheckpointManager(checkpoint_dir,
-                                    save_interval_steps=checkpoint_every)
+            mgr = CheckpointManager(
+                checkpoint_dir, save_interval_steps=checkpoint_every,
+                retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries))
+        start_step = 0
+        if resume is not None:
+            if resume != "auto":
+                raise ValueError(f"resume must be None or 'auto', "
+                                 f"got {resume!r}")
+            if mgr is None:
+                raise TrainerStateError(
+                    "fit(resume='auto') requires checkpoint_dir")
+            from torchacc_tpu.errors import (
+                CheckpointCorruptionError,
+                CheckpointNotFoundError,
+            )
+            try:
+                state, start_step = mgr.restore_latest_valid(
+                    self.abstract_state())
+            except CheckpointNotFoundError:
+                logger.info("resume='auto': no checkpoint yet — "
+                            "starting fresh")
+            except CheckpointCorruptionError as e:
+                # every existing step is unreadable (e.g. the run died
+                # mid-write of its very first checkpoint): the restart
+                # command must still start the run, not crash it
+                logger.warning(
+                    f"resume='auto': no restorable checkpoint ({e}); "
+                    "starting fresh")
+            else:
+                self.state = self._adopt_restored(state)
+                counters.inc("resumes")
+                logger.info(
+                    f"resume='auto': restored step {start_step} from "
+                    f"{checkpoint_dir}; skipping {start_step} consumed "
+                    "batches")
+        preempt_on = mgr is not None and res_cfg.emergency_checkpoint
+        if preempt_on:
+            from torchacc_tpu.resilience.preemption import (
+                clear_preemption,
+                install_preemption_handler,
+                preemption_requested,
+            )
+            install_preemption_handler()
+            if preemption_requested():
+                # a stale flag (signal delivered while no preemption-
+                # aware fit was running) must not stop this run at its
+                # first step boundary; starting fit IS the intent to
+                # train
+                logger.warning(
+                    "clearing a stale preemption request at fit start")
+                clear_preemption()
         mw = open_metrics(metrics_dir)
         history = []
         t0 = _time.perf_counter()
-        t_prev, s_prev = t0, 0
+        t_prev, s_prev = t0, start_step
         import itertools
-        bounded = (itertools.islice(loader, max_steps)
-                   if max_steps is not None else loader)
+        skip_fn = getattr(loader, "skip_batches", None)
+        if start_step and skip_fn is not None:
+            # skip the consumed prefix at the source (AsyncLoader: no
+            # pad/device-transfer for skipped batches)
+            data_it = skip_fn(start_step)
+            bounded = (data_it if max_steps is None else
+                       itertools.islice(data_it,
+                                        max(max_steps - start_step, 0)))
+        else:
+            data_it = iter(loader)
+            bounded = (itertools.islice(data_it, start_step, max_steps)
+                       if (max_steps is not None or start_step) else data_it)
         try:
-            for step_idx, batch in enumerate(bounded):
+            for step_idx, batch in enumerate(bounded, start=start_step):
                 metrics = self.step(batch)
                 do_log = log_every and step_idx % log_every == 0
                 do_eval = (eval_loader is not None and eval_every
@@ -573,17 +738,49 @@ class Trainer:
                     # restamp AFTER eval so its wall time is not charged
                     # to the next interval's steps/tokens-per-sec
                     t_prev, s_prev = _time.perf_counter(), step_idx
+                    # degradation counters ride the record so operators
+                    # see retries/skips/resumes in metrics.jsonl too
+                    for k, v in counters.snapshot().items():
+                        rec[k] = v
                     history.append(rec)
                     if mw is not None:
                         mw.log(metrics_step_offset + step_idx,
                                {f"train/{k}": v for k, v in rec.items()
                                 if k != "step"})
-                    logger.info(f"step {step_idx}: loss {rec['loss']:.4f}")
+                    logger.info(f"step {step_idx}: loss {rec['loss']:.4f}"
+                                f"{counters.suffix()}")
+                saved = False
                 if mgr is not None:
                     # label = completed-step count == state.step after
                     # this step
-                    mgr.save(step_idx + 1, self.state)
+                    saved = mgr.save(step_idx + 1, self.state)
+                if preempt_on and preemption_requested():
+                    # blocking emergency save (Orbax emergency-checkpoint
+                    # pattern): make the just-completed step durable, then
+                    # return cleanly — the grace window is for saving,
+                    # not for more steps
+                    if not saved:
+                        mgr.save(step_idx + 1, self.state, force=True)
+                    mgr.wait_until_finished()
+                    counters.inc("preemptions")
+                    counters.inc("emergency_saves")
+                    # the request is now handled — clear it so an
+                    # in-process supervisor can call fit(resume='auto')
+                    # again without instantly re-preempting
+                    clear_preemption()
+                    logger.warning(
+                        f"preemption requested: emergency checkpoint at "
+                        f"step {step_idx + 1} is durable; stopping fit "
+                        "(resume with fit(resume='auto'))")
+                    break
         finally:
+            # early exits (preemption, max_steps, errors) must shut the
+            # async loader's producer thread down NOW — a daemon thread
+            # abandoned inside the runtime trips std::terminate at
+            # interpreter teardown
+            close = getattr(data_it, "close", None)
+            if close is not None:
+                close()
             if mgr is not None:
                 mgr.wait_until_finished()
                 mgr.close()

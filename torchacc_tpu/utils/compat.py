@@ -1,0 +1,84 @@
+"""JAX version compatibility shims.
+
+The framework targets current JAX, but containers pin older releases;
+hard-failing on a missing alias would brick every trainer path.  Shims
+are installed once at ``import torchacc_tpu`` and are no-ops on modern
+JAX.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    """Install all applicable shims (idempotent)."""
+    _install_set_mesh()
+    _install_get_abstract_mesh()
+    _install_shard_map()
+    _install_pallas_compiler_params()
+
+
+def _install_set_mesh() -> None:
+    # jax.sharding.set_mesh (the context-manager form every call site
+    # here uses) landed after 0.4.x; on older JAX a concrete Mesh is
+    # itself a context manager with the same scoping semantics, so
+    # delegate to it.
+    if hasattr(jax.sharding, "set_mesh"):
+        return
+
+    def set_mesh(mesh):
+        return mesh
+
+    jax.sharding.set_mesh = set_mesh
+
+
+def _install_get_abstract_mesh() -> None:
+    # jax.sharding.get_abstract_mesh reads the mesh context set_mesh
+    # established; the 0.4.x equivalent is the thread-local physical
+    # mesh a `with mesh:` block sets.  Call sites guard for None/empty.
+    if hasattr(jax.sharding, "get_abstract_mesh"):
+        return
+
+    def get_abstract_mesh():
+        from jax._src import mesh as mesh_lib
+        m = mesh_lib.thread_resources.env.physical_mesh
+        return None if m.empty else m
+
+    jax.sharding.get_abstract_mesh = get_abstract_mesh
+
+
+def _install_shard_map() -> None:
+    # jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+    # check_vma=..., axis_names=...) is the stabilised form of
+    # jax.experimental.shard_map.shard_map, whose kwargs differ:
+    # check_rep is the old name of check_vma, and `auto` is the
+    # complement of axis_names (axes left automatic rather than axes
+    # made manual).
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                  axis_names=None):
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=bool(check_vma),
+                          auto=auto)
+
+    jax.shard_map = shard_map
+
+
+def _install_pallas_compiler_params() -> None:
+    # pltpu.CompilerParams was named TPUCompilerParams on older releases
+    # (same dimension_semantics field).
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except Exception:  # noqa: BLE001 - no pallas on this build
+        return
+    if hasattr(pltpu, "CompilerParams") or \
+            not hasattr(pltpu, "TPUCompilerParams"):
+        return
+    pltpu.CompilerParams = pltpu.TPUCompilerParams

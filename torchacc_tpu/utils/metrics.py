@@ -24,12 +24,60 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Dict, Optional, Union
 
 from torchacc_tpu.utils.logger import logger
 
 Number = Union[int, float]
+
+
+class Counters:
+    """Process-wide monotonic counters for degradation events.
+
+    The resilience subsystem increments these (``anomalies_skipped``,
+    ``ckpt_retries``, ``resumes``, ``loader_retries``,
+    ``loader_fallbacks``, ``preemptions``, ``emergency_saves``) and the
+    Trainer surfaces the non-zero ones in every step log line — an
+    operator sees a run degrading without grepping worker logs.
+    Thread-safe: retries fire from the async-loader producer thread.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> int:
+        with self._lock:
+            self._c[name] = self._c.get(name, 0) + n
+            return self._c[name]
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._c.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        """Non-zero counters, sorted by name."""
+        with self._lock:
+            return {k: v for k, v in sorted(self._c.items()) if v}
+
+    def reset(self) -> None:
+        """Zero everything (tests)."""
+        with self._lock:
+            self._c.clear()
+
+    def suffix(self) -> str:
+        """Log-line suffix like ``" [ckpt_retries=2 resumes=1]"``; empty
+        when every counter is zero."""
+        snap = self.snapshot()
+        if not snap:
+            return ""
+        return " [" + " ".join(f"{k}={v}" for k, v in snap.items()) + "]"
+
+
+#: The process-wide instance every subsystem shares.
+counters = Counters()
 
 
 class MetricsWriter:
