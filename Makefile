@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -24,14 +24,23 @@ bench:
 	python bench.py
 
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
-# shifts where the NaN losses / preemptions / I/O faults land, so three
-# different fault schedules exercise the same guarantees
+# shifts where the NaN losses / preemptions / I/O faults / injected
+# hangs land, so three different fault schedules exercise the same
+# guarantees.  test_watchdog.py rides along: deterministic fake-clock
+# coverage of the hang-detection path the chaos runs trip for real.
 chaos:
 	for s in 0 1 2; do \
 		echo "== chaos seed $$s =="; \
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
-			-m resilience -q || exit 1; \
+			tests/test_watchdog.py -q || exit 1; \
 	done
+
+# multi-host robustness proof: 2-process jax.distributed fixtures
+# (cross-host resume consensus with divergent quarantine, preemption
+# sync, coordination primitives) — subprocess-based, so run separately
+# from the in-process suites
+chaos-multihost:
+	JAX_PLATFORMS=cpu $(PYTEST) tests/ -m multihost -q
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -92,6 +92,108 @@ def test_two_process_dp_step(tmp_path):
     _run_two_procs("dp")
 
 
+_CONSENSUS_WORKER = """
+import os, sys
+port, pid, base = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from torchacc_tpu.parallel.distributed import initialize_distributed
+initialize_distributed(coordinator_address=f"localhost:{port}",
+                       num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from torchacc_tpu.checkpoint import CheckpointManager
+from torchacc_tpu.resilience import ChaosPlan, preemption
+from torchacc_tpu.resilience import coordination as coord
+from torchacc_tpu.resilience.retry import RetryPolicy
+
+# -- agreement primitives under genuinely divergent host inputs
+assert coord.min_over_hosts(10 + pid) == 10
+assert coord.max_over_hosts(10 + pid) == 11
+assert coord.any_host(pid == 1) is True
+assert coord.all_agree(pid == 1) is False
+assert coord.all_agree(True) is True
+assert int(coord.broadcast_from_primary(100 + pid)) == 100
+
+# -- preemption sync point: a signal on host 0 reaches BOTH hosts
+if pid == 0:
+    preemption.request_preemption("chaos: host-0 eviction")
+assert preemption.sync_preemption(timeout_s=120) is True
+assert preemption.preemption_requested()   # the joined host latched it
+preemption.clear_preemption()
+
+# -- save two steps of replicated GLOBAL state into one shared dir
+mesh = Mesh(np.asarray(jax.devices()), ("x",))
+rep = NamedSharding(mesh, PartitionSpec())
+mk = jax.jit(lambda m: {"a": jnp.arange(4.0) * m,
+                        "b": {"c": jnp.ones((2, 2)) * m}},
+             out_shardings=rep)
+mgr = CheckpointManager(
+    base, retry_policy=RetryPolicy(max_retries=0, base_delay_s=0.0,
+                                   max_delay_s=0.0),
+    coord_timeout_s=120.0)
+mgr.save(1, mk(1.0))
+mgr.save(2, mk(2.0))
+mgr.wait_until_finished()
+coord.barrier("saved")          # primary's commit markers are visible
+abstract = jax.tree.map(
+    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=rep),
+    mk(0.0))
+
+# -- divergent quarantine: ONLY this host fails to read the newest step
+# (injected at the collective-free readability probe — the seam where a
+# divergent local view is survivable; see io._restore_consensus)
+plan = None
+if pid == 1:
+    plan = ChaosPlan(seed=0).fail("checkpoint.probe", times=1)
+    plan.__enter__()
+try:
+    state, step = mgr.restore_latest_valid(abstract)
+finally:
+    if plan is not None:
+        plan.__exit__(None, None, None)
+assert step == 1, step
+# the quarantine decision replicated: the shared step-2 dir is renamed
+assert os.path.exists(os.path.join(base, "2.corrupt")), os.listdir(base)
+assert not os.path.exists(os.path.join(base, "2")), os.listdir(base)
+np.testing.assert_array_equal(np.asarray(state["a"]), np.arange(4.0))
+
+# -- bitwise agreement across hosts on every restored leaf AND the step
+from jax.experimental import multihost_utils
+flat = np.concatenate(
+    [np.asarray(x).ravel() for x in jax.tree.leaves(state)])
+g = np.asarray(multihost_utils.process_allgather(flat))
+assert g.shape[0] == 2, g.shape
+np.testing.assert_array_equal(g[0], g[1])
+gs = np.asarray(multihost_utils.process_allgather(
+    np.asarray(step, np.int64)))
+assert int(gs.min()) == int(gs.max()) == 1, gs
+mgr.close()
+print(f"proc {pid} ok consensus step={step}", flush=True)
+"""
+
+
+@pytest.mark.slow
+@pytest.mark.multihost
+def test_two_process_resume_consensus(tmp_path):
+    """The acceptance fixture for multi-host resilience: two
+    jax.distributed CPU processes share a checkpoint directory, save
+    steps 1 and 2, then host 1 alone fails to read step 2 (chaos
+    failpoint — the divergent-view scenario).  Both hosts must agree on
+    the SAME fallback step (min over hosts, broadcast from process 0),
+    quarantine the bad step everywhere, and end up with bitwise-equal
+    restored params — no split-brain resume."""
+    outs = _run_two_procs(str(tmp_path / "shared_ckpt"),
+                          worker_src=_CONSENSUS_WORKER)
+    for out in outs:
+        assert "consensus step=1" in out, out[-2000:]
+
+
 @pytest.mark.slow
 def test_two_process_pp_1f1b_step(tmp_path):
     """The 1F1B ppermute ring crosses the PROCESS boundary: pp is the

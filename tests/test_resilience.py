@@ -28,7 +28,9 @@ from torchacc_tpu.errors import (
     CheckpointCorruptionError,
     CheckpointError,
     CheckpointNotFoundError,
+    CoordinationError,
     DataLoaderError,
+    HangError,
     TrainerStateError,
 )
 from torchacc_tpu.models import get_preset
@@ -155,6 +157,11 @@ def test_config_resilience_validation():
     with pytest.raises(ta.ConfigError):  # degenerate EW variance window
         ta.Config.from_dict({"resilience": {"spike_guard": True,
                                             "spike_warmup_steps": 1}})
+    with pytest.raises(ta.ConfigError):
+        ta.Config.from_dict({"resilience": {"step_deadline_s": 0.0}})
+    with pytest.raises(ta.ConfigError):
+        ta.Config.from_dict(
+            {"resilience": {"preempt_sync_interval_steps": 0}})
     cfg = ta.Config.from_dict(
         {"resilience": {"nan_guard": True, "ckpt_retries": 5}})
     assert cfg.resilience.nan_guard and cfg.resilience.ckpt_retries == 5
@@ -461,6 +468,32 @@ def test_async_loader_dead_generator_zero_retries_not_truncated(devices):
         list(ta.data.AsyncLoader(gen(), cfg))
 
 
+def test_async_loader_stall_deadline_trips_watchdog(devices):
+    # a producer wedged mid-fetch (not failing — hanging) trips the
+    # stall path: stack dump + watchdog_stalls + HangError under abort
+    cfg = _loader_cfg(loader_deadline_s=0.15, abort_on_hang=True)
+    src = ChaosLoader(_batches(2))
+    with ChaosPlan(seed=CHAOS_SEED).hang("loader.fetch", seconds=1.5):
+        with pytest.raises(HangError) as ei:
+            list(ta.data.AsyncLoader(src, cfg))
+    assert ei.value.label == "loader.fetch"
+    assert counters.get("watchdog_stalls") == 1
+
+
+def test_async_loader_stall_observe_only_recovers(devices):
+    # abort off: the stall is dumped + counted once, and when the source
+    # recovers the epoch still completes in full
+    cfg = _loader_cfg(loader_deadline_s=0.1, abort_on_hang=False)
+    src = ChaosLoader(_batches(3, seed=11))
+    with ChaosPlan(seed=CHAOS_SEED).hang("loader.fetch", seconds=0.4):
+        out = list(ta.data.AsyncLoader(src, cfg))
+    assert len(out) == 3
+    assert counters.get("watchdog_stalls") == 1
+    ref = [b["input_ids"] for b in _batches(3, seed=11)]
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(np.asarray(got["input_ids"]), want)
+
+
 def test_async_loader_dead_generator_fails_loudly(devices):
     # a plain generator that raises is CLOSED — retrying next() yields
     # StopIteration, which must surface the original error, not a
@@ -474,3 +507,172 @@ def test_async_loader_dead_generator_fails_loudly(devices):
     with pytest.raises(DataLoaderError) as ei:
         list(ta.data.AsyncLoader(gen(), cfg))
     assert isinstance(ei.value.__cause__.__cause__, OSError)
+
+
+# -- hang/straggler watchdog (the acceptance chaos proof) ---------------------
+
+def test_watchdog_trips_on_injected_midstep_hang(tmp_path):
+    """An injected mid-step hang trips the watchdog within
+    step_deadline_s, writes an all-thread stack dump, and (with
+    abort_on_hang) raises HangError at the step boundary — the restart
+    contract a supervisor needs for resume='auto'."""
+    bs = _batches(3)
+    md = str(tmp_path / "metrics")
+    t = _trainer(step_deadline_s=0.25, abort_on_hang=True)
+    with ChaosPlan(seed=CHAOS_SEED).hang("trainer.step", seconds=1.0):
+        with pytest.raises(HangError) as ei:
+            t.fit(ChaosLoader(bs), max_steps=3, log_every=0,
+                  metrics_dir=md)
+    assert ei.value.label == "train_step"
+    assert ei.value.deadline_s == 0.25
+    assert counters.get("watchdog_stalls") >= 1
+    dumps = [p for p in os.listdir(md) if p.startswith("watchdog_")]
+    assert dumps, os.listdir(md)
+    assert "train_step" in open(os.path.join(md, dumps[0])).read()
+
+
+def test_watchdog_observe_only_run_completes(tmp_path):
+    # same hang, abort off: diagnostics only, the run finishes and the
+    # stall shows up as a counter in the step records
+    bs = _batches(3)
+    t = _trainer(step_deadline_s=0.2, abort_on_hang=False)
+    with ChaosPlan(seed=CHAOS_SEED).hang("trainer.step", seconds=0.6):
+        hist = t.fit(ChaosLoader(bs), max_steps=3, log_every=1,
+                     metrics_dir=str(tmp_path / "m"))
+    assert int(t.state.step) == 3
+    assert counters.get("watchdog_stalls") >= 1
+    assert hist and hist[-1]["watchdog_stalls"] >= 1
+    assert "heartbeat_age_s" in hist[-1]
+
+
+def test_watchdog_no_stall_on_healthy_run(tmp_path):
+    bs = _batches(3)
+    t = _trainer(step_deadline_s=60.0, abort_on_hang=True)
+    t.fit(ChaosLoader(bs), max_steps=3, log_every=0)
+    assert int(t.state.step) == 3
+    assert counters.get("watchdog_stalls") == 0
+
+
+# -- cross-host coordination: single-process exact-no-op contract -------------
+
+def test_coordination_single_process_is_exact_noop(monkeypatch):
+    """Acceptance criterion: with jax.process_count() == 1 no collective
+    runs and no timeout is armed — the primitives return local values
+    directly."""
+    from torchacc_tpu.resilience import coordination as coord
+    assert coord.process_count() == 1
+
+    def boom(*a, **k):  # any collective/thread use is a failure
+        raise AssertionError("collective in a single-process run")
+    monkeypatch.setattr(coord, "_bounded", boom)
+    monkeypatch.setattr(coord, "_allgather", boom)
+
+    assert coord.min_over_hosts(7) == 7
+    assert coord.max_over_hosts(-3) == -3
+    assert coord.any_host(True) is True
+    assert coord.any_host(False) is False
+    assert coord.all_agree(True) is True
+    assert coord.all_agree(False) is False
+    obj = {"step": 4}
+    assert coord.broadcast_from_primary(obj) is obj
+    coord.barrier("noop")
+
+    from torchacc_tpu.resilience import (
+        clear_preemption,
+        request_preemption,
+        sync_preemption,
+    )
+    assert sync_preemption() is False
+    request_preemption("test")
+    assert sync_preemption() is True
+    clear_preemption()
+
+
+def test_coordination_timeout_raises_typed_error():
+    from torchacc_tpu.resilience.coordination import _bounded
+    import time as _t
+    with pytest.raises(CoordinationError) as ei:
+        _bounded(lambda: _t.sleep(5.0), timeout_s=0.05, name="stuck-agree")
+    assert ei.value.primitive == "stuck-agree"
+    assert ei.value.timeout_s == 0.05
+    # a failing collective is wrapped with the primitive name, cause kept
+    def fail():
+        raise OSError("wire fell out")
+    with pytest.raises(CoordinationError) as ei:
+        _bounded(fail, timeout_s=1.0, name="bad-agree")
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+# -- distributed init retry (satellite) ---------------------------------------
+
+def test_initialize_distributed_retries_coordinator_flaps(monkeypatch):
+    import torchacc_tpu.parallel.distributed as D
+    calls = {"n": 0}
+
+    def flaky(**kw):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("failed to connect to coordinator")
+    monkeypatch.setattr(D.jax.distributed, "initialize", flaky)
+    D.initialize_distributed(coordinator_address="10.0.0.9:1234",
+                             num_processes=2, process_id=1,
+                             retry_base_delay_s=0.001,
+                             retry_max_delay_s=0.002)
+    assert calls["n"] == 3
+    assert counters.get("dist_init_retries") == 2
+
+
+def test_initialize_distributed_exhausted_names_coordinator(monkeypatch):
+    import torchacc_tpu.parallel.distributed as D
+
+    def dead(**kw):
+        raise RuntimeError("connection refused")
+    monkeypatch.setattr(D.jax.distributed, "initialize", dead)
+    with pytest.raises(CoordinationError) as ei:
+        D.initialize_distributed(coordinator_address="10.0.0.9:1234",
+                                 num_processes=2, process_id=1,
+                                 init_retries=1,
+                                 retry_base_delay_s=0.001,
+                                 retry_max_delay_s=0.002)
+    assert "10.0.0.9:1234" in str(ei.value)
+
+
+def test_initialize_distributed_tolerates_already_initialized(monkeypatch):
+    import torchacc_tpu.parallel.distributed as D
+
+    def dup(**kw):
+        raise RuntimeError(
+            "jax.distributed.initialize should only be called once")
+    monkeypatch.setattr(D.jax.distributed, "initialize", dup)
+    D.initialize_distributed(coordinator_address="10.0.0.9:1234",
+                             num_processes=2, process_id=0)  # no raise
+
+
+# -- metrics writer multi-host gating (satellite) -----------------------------
+
+def test_metrics_writer_primary_only_by_default(tmp_path, monkeypatch):
+    from torchacc_tpu.utils import metrics as M
+    monkeypatch.setattr(M, "_process_index", lambda: 1)
+    w = M.MetricsWriter(str(tmp_path / "a"))
+    w.log(0, {"train/loss": 1.0})
+    w.log_text("t", "x")
+    w.flush()
+    w.close()  # all no-ops, no files, no crash
+    assert not os.path.exists(os.path.join(tmp_path, "a", "metrics.jsonl"))
+
+    # opt-in: non-primary writes its OWN file, never metrics.jsonl
+    w = M.MetricsWriter(str(tmp_path / "b"), all_processes=True)
+    w.log(0, {"train/loss": 1.0})
+    w.close()
+    assert os.path.exists(os.path.join(tmp_path, "b", "metrics.1.jsonl"))
+    assert not os.path.exists(os.path.join(tmp_path, "b", "metrics.jsonl"))
+
+    # the primary writes metrics.jsonl exactly as before
+    monkeypatch.setattr(M, "_process_index", lambda: 0)
+    w = M.MetricsWriter(str(tmp_path / "c"), tensorboard=False)
+    w.log(3, {"train/loss": 2.0})
+    w.close()
+    import json
+    rec = json.loads(open(
+        os.path.join(tmp_path, "c", "metrics.jsonl")).readline())
+    assert rec["step"] == 3 and rec["train/loss"] == 2.0

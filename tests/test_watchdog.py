@@ -1,0 +1,192 @@
+"""Watchdog unit tests: deterministic expiry under a fake clock.
+
+No monitor thread runs here (``poll_interval_s=None``); tests advance a
+fake monotonic clock and call ``check_now()`` directly, so deadline
+semantics are exact — no sleeps, no flaky timing.  The end-to-end path
+(real monitor thread + chaos-injected hang through ``Trainer.fit``)
+lives in tests/test_resilience.py.
+"""
+
+import os
+import threading
+
+import pytest
+
+from torchacc_tpu.errors import HangError
+from torchacc_tpu.resilience.watchdog import Watchdog, dump_stacks, trip_stall
+from torchacc_tpu.utils.metrics import counters
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _wd(tmp_path, **kw):
+    kw.setdefault("dump_dir", str(tmp_path))
+    kw.setdefault("poll_interval_s", None)  # no monitor thread
+    clk = FakeClock()
+    return Watchdog(clock=clk, **kw), clk
+
+
+def test_deadline_fires_dumps_and_counts(tmp_path):
+    wd, clk = _wd(tmp_path)
+    wd.arm("train_step", 5.0)
+    clk.advance(4.9)
+    assert not wd.check_now()          # within deadline: nothing
+    assert counters.get("watchdog_stalls") == 0
+    clk.advance(0.2)
+    assert wd.check_now()              # expired: trip
+    assert counters.get("watchdog_stalls") == 1
+    assert wd.stalls == 1
+    # the stack dump was written and names this (the stalled) thread
+    assert wd.last_dump_path and os.path.exists(wd.last_dump_path)
+    text = open(wd.last_dump_path).read()
+    assert "train_step" in text
+    assert "test_watchdog" in text     # our own frame is in the dump
+    # one trip per armed section, not one per poll
+    clk.advance(100.0)
+    assert not wd.check_now()
+    assert counters.get("watchdog_stalls") == 1
+    wd.disarm()                        # abort off: no raise
+    wd.close()
+
+
+def test_no_false_positive_on_slow_but_alive(tmp_path):
+    wd, clk = _wd(tmp_path)
+    wd.arm("train_step", 10.0)
+    for _ in range(5):                 # 40s of wall time, beating at 8s
+        clk.advance(8.0)
+        assert not wd.check_now()
+        wd.beat()                      # progress: deadline resets
+    assert counters.get("watchdog_stalls") == 0
+    assert wd.heartbeat_age_s() == 0.0
+    clk.advance(3.0)
+    assert wd.heartbeat_age_s() == pytest.approx(3.0)
+    wd.disarm()
+    wd.close()
+
+
+def test_abort_on_hang_raises_at_next_boundary(tmp_path):
+    wd, clk = _wd(tmp_path, abort_on_hang=True)
+    wd.arm("train_step", 2.0)
+    clk.advance(2.5)
+    assert wd.check_now()
+    with pytest.raises(HangError) as ei:
+        wd.disarm()                    # the step boundary
+    assert ei.value.label == "train_step"
+    assert ei.value.deadline_s == 2.0
+    assert ei.value.waited_s >= 2.5
+    assert ei.value.dump_path and os.path.exists(ei.value.dump_path)
+    # the pending error is consumed: the next section starts clean
+    wd.arm("train_step", 2.0)
+    wd.disarm()
+    wd.close()
+
+
+def test_pending_hang_raised_by_next_arm(tmp_path):
+    # a hang during data fetch surfaces even if the caller re-arms for
+    # the step instead of disarming
+    wd, clk = _wd(tmp_path, abort_on_hang=True)
+    wd.arm("data_fetch", 1.0)
+    clk.advance(1.5)
+    assert wd.check_now()
+    with pytest.raises(HangError) as ei:
+        wd.arm("train_step", 5.0)
+    assert ei.value.label == "data_fetch"
+    wd.close()
+
+
+def test_disarm_before_trip_means_no_late_abort(tmp_path):
+    # the section finished between deadline expiry and the monitor's
+    # next poll: dump/count still happen on the poll, but no HangError
+    # may ambush the (healthy) code that is now running
+    wd, clk = _wd(tmp_path, abort_on_hang=True)
+    wd.arm("train_step", 1.0)
+    clk.advance(1.5)
+    wd.disarm()                        # finished late, but finished
+    assert not wd.check_now()          # disarmed: no trip at all
+    wd.arm("train_step", 1.0)          # must not raise
+    wd.disarm()
+    wd.close()
+
+
+def test_rearm_resets_deadline(tmp_path):
+    wd, clk = _wd(tmp_path)
+    wd.arm("data_fetch", 5.0)
+    clk.advance(4.0)
+    wd.arm("train_step", 5.0)          # new section, new deadline
+    clk.advance(4.0)                   # 8s since first arm, 4s since re-arm
+    assert not wd.check_now()
+    assert counters.get("watchdog_stalls") == 0
+    wd.disarm()
+    wd.close()
+
+
+def test_watch_context_manager(tmp_path):
+    wd, clk = _wd(tmp_path, abort_on_hang=True)
+    with wd.watch("ok_section", 10.0):
+        clk.advance(1.0)
+    assert counters.get("watchdog_stalls") == 0
+
+    with pytest.raises(HangError):
+        with wd.watch("slow_section", 1.0):
+            clk.advance(2.0)
+            wd.check_now()             # monitor would have fired here
+    # a non-hang exception from the body is not masked by the pending
+    with pytest.raises(ValueError):
+        with wd.watch("failing_section", 1.0):
+            clk.advance(2.0)
+            wd.check_now()
+            raise ValueError("body error")
+    wd.close()
+
+
+def test_monitor_thread_trips_real_clock(tmp_path):
+    # integration of the daemon monitor: a genuinely slow section with a
+    # tiny deadline trips without any manual check_now()
+    wd = Watchdog(dump_dir=str(tmp_path), poll_interval_s=0.01).start()
+    done = threading.Event()
+    wd.arm("hang", 0.05)
+    done.wait(0.3)                     # "hang" for 0.3s
+    assert counters.get("watchdog_stalls") == 1
+    wd.disarm()
+    wd.close()
+
+
+def test_close_is_safe_in_finally(tmp_path):
+    wd, clk = _wd(tmp_path, abort_on_hang=True)
+    wd.arm("s", 1.0)
+    clk.advance(2.0)
+    wd.check_now()
+    wd.close()                         # pending dropped with a log, no raise
+
+
+def test_trip_stall_helper(tmp_path):
+    path = trip_stall("loader.fetch", 3.0, 1.0, dump_dir=str(tmp_path),
+                      abort=False)
+    assert path and os.path.exists(path)
+    assert counters.get("watchdog_stalls") == 1
+    with pytest.raises(HangError) as ei:
+        trip_stall("loader.fetch", 3.0, 1.0, dump_dir=str(tmp_path),
+                   abort=True)
+    assert ei.value.label == "loader.fetch"
+    assert counters.get("watchdog_stalls") == 2
+
+
+def test_dump_stacks_stderr_fallback():
+    # unwritable dir degrades to stderr and returns None, never raises
+    assert dump_stacks("x", "/proc/definitely/not/writable") is None

@@ -31,6 +31,7 @@ from torchacc_tpu.errors import (
     CheckpointError,
     CheckpointNotFoundError,
 )
+from torchacc_tpu.resilience import coordination as coord
 from torchacc_tpu.resilience.chaos import failpoint
 from torchacc_tpu.resilience.retry import RetryPolicy, retry_call
 from torchacc_tpu.train.state import TrainState
@@ -309,15 +310,26 @@ class CheckpointManager:
       blip below the retry limit is a log line, not a dead run;
     - ``restore_latest_valid`` walks marked steps newest-first,
       validating the manifest digest against the target state's
-      structure and falling back a step on corruption.
+      structure and falling back a step on corruption;
+    - multi-host (``jax.process_count() > 1``): commit markers are
+      written by the primary process only (shared-filesystem safe), and
+      ``restore_latest_valid`` reaches cross-host consensus on ONE step
+      — min over the hosts' newest locally-valid step, broadcast from
+      process 0 — with quarantine decisions replicated to every host so
+      a corrupted step can never split-brain the pod into resuming
+      different steps.  All coordination degrades to exact no-ops in
+      single-process runs.
     """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 coord_timeout_s: Optional[float] = None):
         self._dir = os.path.abspath(directory)
         self._retry = (retry_policy if retry_policy is not None
                        else RetryPolicy(max_retries=3))
+        self._coord_timeout = coord_timeout_s
+        self._should_save_logged = False
         # steps saved through this manager whose manifests are still
         # pending (orbax save is async; the marker must be written last)
         self._pending: Dict[int, Dict[str, Any]] = {}
@@ -342,8 +354,14 @@ class CheckpointManager:
                     if self._pending and not self._mgr.is_saving_in_progress():
                         self._commit_manifests()
                     return False
-            except Exception:  # noqa: BLE001 - older orbax: let save decide
-                pass
+            except Exception as e:  # noqa: BLE001 - older orbax: let save decide
+                if not self._should_save_logged:
+                    self._should_save_logged = True
+                    logger.debug(
+                        f"should_save probe unavailable on this orbax "
+                        f"({e!r}); deferring the skip decision to save() "
+                        "— this costs one state snapshot per step "
+                        "(logged once)")
         # commit markers for earlier (now finished) saves before starting
         # a new one: after a hard crash (SIGKILL/OOM) at most the single
         # in-flight step is unmarked, not the whole run's worth
@@ -369,7 +387,12 @@ class CheckpointManager:
     def _commit_manifests(self) -> None:
         """Wait for in-flight orbax writes, then mark the completed steps.
         The marker is last: a crash anywhere before this leaves an
-        unmarked (= invisible) step, never a bogus one."""
+        unmarked (= invisible) step, never a bogus one.  Multi-host, the
+        marker is written by the primary process only: every host shares
+        one checkpoint directory, and N processes racing the same
+        ``os.replace`` would corrupt the commit protocol (resume
+        consensus tolerates the marker being briefly visible on some
+        hosts before others)."""
         if not self._pending:
             return
         pending, self._pending = self._pending, {}
@@ -379,6 +402,8 @@ class CheckpointManager:
             raise CheckpointError(
                 f"background checkpoint write under {self._dir} failed "
                 f"(steps {sorted(pending)} stay unmarked)") from e
+        if coord.process_count() > 1 and coord.process_index() != 0:
+            return
         for step, digest in sorted(pending.items()):
             step_dir = os.path.join(self._dir, str(step))
             if not os.path.isdir(step_dir):
@@ -439,19 +464,7 @@ class CheckpointManager:
                 f"no checkpoint found under {self._dir}")
 
         def _once():
-            failpoint("checkpoint.restore", step=step)
-            # Restore straight from the step's item directory: the
-            # manager infers its item layout by scanning step dirs, so a
-            # *sibling* step with a gutted payload can poison restores of
-            # perfectly healthy steps ("multiple checkpointable objects").
-            # The direct path is immune; fall back to the manager for
-            # layouts without a 'default' item dir.
-            item_dir = os.path.join(self._dir, str(step), "default")
-            if os.path.isdir(item_dir):
-                return ocp.StandardCheckpointer().restore(
-                    item_dir, abstract_state)
-            return self._mgr.restore(
-                step, args=ocp.args.StandardRestore(abstract_state))
+            return self._restore_step_once(abstract_state, step)
         try:
             return retry_call(_once, policy=self._retry,
                               counter="ckpt_retries",
@@ -461,6 +474,25 @@ class CheckpointManager:
                 f"checkpoint restore of step {step} from {self._dir} "
                 f"failed after {self._retry.max_retries + 1} attempt(s)"
             ) from e
+
+    def _restore_step_once(self, abstract_state: Any, step: int) -> Any:
+        """One restore attempt, straight from the step's item directory:
+        the manager infers its item layout by scanning step dirs, so a
+        *sibling* step with a gutted payload can poison restores of
+        perfectly healthy steps ("multiple checkpointable objects").
+        The direct path is immune; falls back to the manager for layouts
+        without a 'default' item dir.  No retry here — single-host
+        callers wrap it in ``retry_call``; the multi-host consensus path
+        must NOT (the orbax restore is a cross-process collective, and
+        re-entering it alone after the peers completed theirs would
+        deadlock the pod)."""
+        failpoint("checkpoint.restore", step=step)
+        item_dir = os.path.join(self._dir, str(step), "default")
+        if os.path.isdir(item_dir):
+            return ocp.StandardCheckpointer().restore(
+                item_dir, abstract_state)
+        return self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
 
     def validate_step(self, step: int,
                       abstract_state: Optional[Any] = None) -> bool:
@@ -489,7 +521,14 @@ class CheckpointManager:
         manifest is missing/mismatched is skipped outright; a step whose
         array payload turns out unreadable mid-restore is logged and the
         previous step is tried.
+
+        Multi-host, the choice is a cross-host consensus (see
+        :meth:`_restore_consensus`): every host resumes the IDENTICAL
+        step or none does — per-host divergence here corrupts the run at
+        the first collective, silently.
         """
+        if coord.process_count() > 1:
+            return self._restore_consensus(abstract_state)
         candidates = sorted(self.valid_steps(), reverse=True)
         if not candidates and self._mgr.all_steps():
             legacy = self.latest_step()  # logs the legacy-dir warning
@@ -516,6 +555,149 @@ class CheckpointManager:
         raise CheckpointNotFoundError(
             f"no checkpoint found under {self._dir}")
 
+    def _newest_valid_step(self, abstract_state: Any,
+                           ceiling: Optional[int]) -> int:
+        """This host's newest fully-validated step strictly below
+        ``ceiling`` (-1 when none): the host-local input to the resume
+        consensus.  Only when NO commit marker exists at all does it
+        fall back to unmarked steps (pre-manifest-era dirs, or a
+        secondary host that has not yet observed the primary's marker on
+        a shared filesystem) — mirroring :meth:`latest_step`.  Marked
+        steps whose digests all mismatch must NOT resurrect unmarked
+        (possibly partial) siblings: that is structure drift, and the
+        pod should stop with the same corruption error the single-host
+        path raises."""
+        marked = [s for s in self.valid_steps()
+                  if ceiling is None or s < ceiling]
+        validated = [s for s in marked
+                     if self.validate_step(s, abstract_state)]
+        if validated:
+            return max(validated)
+        if marked:
+            return -1
+        legacy = [s for s in self._mgr.all_steps()
+                  if ceiling is None or s < ceiling]
+        return max(legacy) if legacy else -1
+
+    def _probe_step(self, step: int) -> Optional[str]:
+        """Cheap host-local readability check of a step's payload —
+        deliberately collective-free, so it can run (and FAIL) on one
+        host while its neighbours pass.  Returns an error string, or
+        None when the step looks restorable.  Chaos seam:
+        ``failpoint('checkpoint.probe')`` injects divergent views."""
+        try:
+            failpoint("checkpoint.probe", step=step)
+            step_dir = os.path.join(self._dir, str(step))
+            if not os.path.isdir(step_dir):
+                return "step directory missing"
+            item_dir = os.path.join(step_dir, "default")
+            payload = item_dir if os.path.isdir(item_dir) else step_dir
+            names = set(os.listdir(payload)) \
+                - {MANIFEST, "_CHECKPOINT_METADATA"}
+            if not names:
+                return "payload missing"
+            # known orbax layout markers (_METADATA / manifest.ocdbt /
+            # array dirs).  The set is deliberately broad and the check
+            # advisory for unrecognised layouts: a future orbax with
+            # different file names must NOT make every healthy step
+            # probe as corrupt (which would quarantine the whole
+            # retained history pod-wide)
+            markers = {"_METADATA", "manifest.ocdbt", "_sharding", "d"}
+            if payload == item_dir and not (
+                    markers & names
+                    or any(n.startswith("ocdbt.") for n in names)):
+                logger.warning(
+                    f"checkpoint step {step}: unrecognised payload "
+                    f"layout ({sorted(names)[:6]}) — treating as "
+                    "restorable")
+        except Exception as e:  # noqa: BLE001 - any probe failure counts
+            return f"{e!r}"
+        return None
+
+    def _restore_consensus(self, abstract_state: Any):
+        """Multi-host ``restore_latest_valid``: agree on ONE step, then
+        restore it everywhere, falling back in lockstep on corruption.
+
+        Per round: (1) each host proposes its newest locally-valid step;
+        (2) the consensus step is the MIN over hosts (the conservative
+        choice — every host can restore it), broadcast from process 0 so
+        the agreed value is bitwise identical everywhere; (3) each host
+        runs the collective-free local readability probe and the pod
+        takes the all-agree vote; (4) on any probe failure, EVERY host
+        quarantines the step (replicated decision — no split-brain where
+        one host renames a step its neighbours still resume from) and
+        the round repeats below it.  Only a unanimously-probed step
+        enters the actual restore, TOGETHER on every host — the orbax
+        restore carries its own cross-process barriers, so entering it
+        divergently (some hosts restoring, some not) would deadlock the
+        pod.  The collective count per round is fixed (min + broadcast +
+        all-agree) regardless of local outcomes, keeping hosts in
+        lockstep; a restore failure past a clean unanimous probe is
+        fatal by design (mid-collective divergence cannot be coordinated
+        around — the supervisor restarts and the next probe round
+        quarantines the step).
+        """
+        t = self._coord_timeout
+        errors: List[str] = []
+        ceiling: Optional[int] = None
+        while True:
+            newest = self._newest_valid_step(abstract_state, ceiling)
+            # ONE collective: the allgathered vector is bitwise
+            # identical on every host, so its min IS process 0's value —
+            # the same every-host-agrees guarantee an explicit primary
+            # broadcast would buy, without a second timeout window
+            agreed = coord.min_over_hosts(newest, timeout_s=t,
+                                          name="resume-step")
+            if agreed < 0:
+                # no step every host can offer — the whole pod starts
+                # fresh (or fails) together; the vote distinguishes
+                # "nothing anywhere" from "corruption burned every step"
+                had_anything = coord.any_host(
+                    bool(errors or self._mgr.all_steps()),
+                    timeout_s=t, name="resume-empty")
+                if had_anything:
+                    raise CheckpointCorruptionError(
+                        f"no checkpoint step restorable on every host "
+                        f"under {self._dir}"
+                        + (f": {'; '.join(errors)}" if errors else ""))
+                raise CheckpointNotFoundError(
+                    f"no checkpoint found under {self._dir} on any host")
+            probe_err = self._probe_step(agreed)
+            if coord.all_agree(probe_err is None, timeout_s=t,
+                               name="resume-ok"):
+                logger.info(
+                    f"resume consensus: all {coord.process_count()} "
+                    f"processes restoring step {agreed}")
+                # deliberately NOT retried: this is a cross-process
+                # collective — a lone host re-entering it on a transient
+                # error, after its peers already completed theirs, would
+                # wedge the pod in mismatched barriers.  Failure here is
+                # fatal by design (docs/resilience.md non-guarantees),
+                # but quarantine the step on the way out so the
+                # restarted pod proposes a DIFFERENT step — a corrupt-
+                # but-probe-passing step must not crash-loop the
+                # supervisor forever.
+                try:
+                    return (self._restore_step_once(abstract_state,
+                                                    agreed), agreed)
+                except Exception:
+                    self._quarantine(agreed)
+                    raise
+            if probe_err is not None:
+                logger.warning(
+                    f"checkpoint step {agreed} is unreadable here "
+                    f"({probe_err}); quarantining on all hosts and "
+                    "falling back")
+                errors.append(f"step {agreed}: {probe_err}")
+            else:
+                logger.warning(
+                    f"checkpoint step {agreed} probes healthy here but "
+                    "is unreadable on another host; quarantining the "
+                    "replicated way and falling back")
+                errors.append(f"step {agreed}: unreadable on another host")
+            self._quarantine(agreed)
+            ceiling = agreed
+
     def _quarantine(self, step: int) -> None:
         """Rename an unreadable step dir to ``<step>.corrupt`` (evidence
         preserved, never deleted) and rebuild the orbax manager: a gutted
@@ -529,12 +711,21 @@ class CheckpointManager:
             dst = f"{src}.corrupt{n}"
         try:
             os.rename(src, dst)
-        except OSError as e:
             logger.warning(
-                f"could not quarantine corrupt checkpoint step {step}: {e}")
-            return
-        logger.warning(
-            f"quarantined corrupt checkpoint step {step} -> {dst}")
+                f"quarantined corrupt checkpoint step {step} -> {dst}")
+        except OSError as e:
+            if not os.path.exists(src):
+                # shared filesystem: another host's replicated quarantine
+                # already renamed it — the decision held; still rebuild
+                # the manager below so the gutted layout cache is dropped
+                logger.debug(
+                    f"checkpoint step {step} already quarantined "
+                    "(another host won the rename)")
+            else:
+                logger.warning(
+                    f"could not quarantine corrupt checkpoint step "
+                    f"{step}: {e}")
+                return
         try:
             self._mgr.close()
         except Exception:  # noqa: BLE001 - already degraded

@@ -364,6 +364,32 @@ class ResilienceConfig:
     # (SIGTERM / request_preemption) arrives during Trainer.fit with a
     # checkpoint_dir configured
     emergency_checkpoint: bool = True
+    # hang/straggler watchdog (resilience/watchdog.py): when set,
+    # Trainer.fit arms a per-step deadline around the train step; on
+    # expiry the watchdog dumps all-thread stacks, increments the
+    # watchdog_stalls counter, and (with abort_on_hang) raises HangError
+    # at the next step boundary so a supervisor restarts into
+    # fit(resume='auto').  None disables the watchdog entirely.
+    step_deadline_s: Optional[float] = None
+    # stall deadline for the async loader's consumer wait (a hung
+    # producer/source trips the same stack-dump + counter path); None
+    # falls back to step_deadline_s semantics in fit and disables the
+    # loader-internal deadline
+    loader_deadline_s: Optional[float] = None
+    # raise HangError once a tripped deadline resolves (False = observe
+    # only: stack dump + counter, training continues if the stall clears)
+    abort_on_hang: bool = False
+    # timeout for cross-host coordination primitives (preemption sync,
+    # resume consensus — resilience/coordination.py).  Only consulted
+    # when jax.process_count() > 1; single-process runs never arm it.
+    coord_timeout_s: float = 120.0
+    # multi-host only: run the cross-host preemption sync every N step
+    # boundaries instead of every one (the sync is a small blocking
+    # allgather — on sub-second steps, raise this to keep the hot path
+    # collective-free at the cost of reacting to a peer's SIGTERM up to
+    # N-1 steps later).  Single-process runs check the local flag every
+    # step regardless.
+    preempt_sync_interval_steps: int = 1
 
     def validate(self) -> None:
         _check(self.spike_zscore > 0,
@@ -390,6 +416,16 @@ class ResilienceConfig:
         if self.retry_deadline_s is not None:
             _check(self.retry_deadline_s > 0,
                    "resilience.retry_deadline_s must be positive")
+        if self.step_deadline_s is not None:
+            _check(self.step_deadline_s > 0,
+                   "resilience.step_deadline_s must be positive")
+        if self.loader_deadline_s is not None:
+            _check(self.loader_deadline_s > 0,
+                   "resilience.loader_deadline_s must be positive")
+        _check(self.coord_timeout_s > 0,
+               "resilience.coord_timeout_s must be positive")
+        _check(self.preempt_sync_interval_steps >= 1,
+               "resilience.preempt_sync_interval_steps must be >= 1")
 
     def retry_policy(self, max_retries: int) -> Any:
         """The shared RetryPolicy view of the delay/deadline knobs."""

@@ -69,6 +69,7 @@ class AsyncLoader:
         config: Config,
         mesh: Optional[Mesh] = None,
         sharding: Optional[NamedSharding] = None,
+        stall_dump_dir: Optional[str] = None,
     ):
         self._loader = loader
         self._config = config
@@ -86,6 +87,17 @@ class AsyncLoader:
             res.retry_policy(res.loader_retries),
             no_retry=(DataLoaderError,))
         self._sync_fallback = res.loader_sync_fallback
+        # stall deadline on the consumer's wait for the next device
+        # batch: a producer wedged in a source/fetch (not merely failing
+        # — failing is the retry path's job) trips the watchdog path
+        # (stack dump + watchdog_stalls counter, HangError under
+        # abort_on_hang) instead of hanging the step loop forever
+        self._stall_deadline = res.loader_deadline_s
+        self._abort_on_hang = res.abort_on_hang
+        # where stall stack dumps land (pass the run's metrics/
+        # checkpoint dir so the evidence sits next to the trainer
+        # watchdog's dumps; None = stderr)
+        self._stall_dump_dir = stall_dump_dir
         self._rank_shardings: Dict[int, NamedSharding] = {}
 
     # -- fault-wrapped primitives -------------------------------------------
@@ -229,7 +241,7 @@ class AsyncLoader:
         t.start()
         try:
             while True:
-                item = q.get()
+                item = self._get_with_stall_deadline(q)
                 if item is _SENTINEL:
                     if err:
                         raise DataLoaderError(
@@ -253,6 +265,32 @@ class AsyncLoader:
             except queue.Empty:
                 pass
             t.join(timeout=5.0)
+
+    def _get_with_stall_deadline(self, q: "queue.Queue"):
+        """Next queue item; with ``resilience.loader_deadline_s`` set,
+        a wait past the deadline trips the watchdog stall path ONCE per
+        wait (stack dump + ``watchdog_stalls``; ``HangError`` when
+        ``resilience.abort_on_hang``) — otherwise it logs and keeps
+        waiting, so an eventually-recovering source only costs the
+        diagnostics."""
+        deadline = self._stall_deadline
+        if not deadline:
+            return q.get()
+        import time
+        start = time.monotonic()
+        quantum = min(max(deadline / 4.0, 0.01), 0.5)
+        tripped = False
+        while True:
+            try:
+                return q.get(timeout=quantum)
+            except queue.Empty:
+                waited = time.monotonic() - start
+                if waited >= deadline and not tripped:
+                    from torchacc_tpu.resilience.watchdog import trip_stall
+                    trip_stall("loader.fetch", waited, deadline,
+                               dump_dir=self._stall_dump_dir,
+                               abort=self._abort_on_hang)
+                    tripped = True
 
     def _iterate_sync(self, it: Iterator, pending=None,
                       prior_err=None) -> Iterator[Dict[str, jax.Array]]:

@@ -52,6 +52,42 @@ class DataLoaderError(TorchAccTPUError):
     with synchronous fallback disabled or also failing)."""
 
 
+class CoordinationError(TorchAccTPUError):
+    """A cross-host coordination primitive failed or timed out.
+
+    Carries the primitive name and the timeout so an operator can tell a
+    dead coordinator ("broadcast timed out") from a logic error without
+    re-running.  Raised only in multi-process runs — every primitive is
+    an exact no-op when ``jax.process_count() == 1``."""
+
+    def __init__(self, message: str, *, primitive: Optional[str] = None,
+                 timeout_s: Optional[float] = None):
+        super().__init__(message)
+        self.primitive = primitive
+        self.timeout_s = timeout_s
+
+
+class HangError(TorchAccTPUError):
+    """A watched section (train step, data fetch) exceeded its deadline.
+
+    The watchdog (resilience/watchdog.py) dumps all-thread stacks and
+    increments ``watchdog_stalls`` when the deadline expires; with
+    ``resilience.abort_on_hang`` it raises this error so a supervisor
+    can restart the job into ``fit(resume='auto')``.  Carries the
+    section label, the configured deadline, the observed wait, and the
+    stack-dump path (when one was written to disk)."""
+
+    def __init__(self, message: str, *, label: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 waited_s: Optional[float] = None,
+                 dump_path: Optional[str] = None):
+        super().__init__(message)
+        self.label = label
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+        self.dump_path = dump_path
+
+
 class AnomalyError(TorchAccTPUError):
     """Too many consecutive anomalous steps — the run is diverging, not
     glitching.  Carries a diagnosis so the operator sees *what* tripped

@@ -4,9 +4,14 @@ Reference: ``ta.dist.init_process_group`` + NCCL warmup
 (dist/__init__.py:45-98) driven by torchrun env vars.  JAX multi-host is
 one call — ``jax.distributed.initialize`` — after which ``jax.devices()``
 spans every host of the pod/slice and the SAME single-program code runs
-on each host (no rank-conditional logic anywhere in this framework).
-Collective warmup cliques are unnecessary: XLA programs embed their
-collectives.
+on each host.  The *compute* path stays rank-free (XLA programs embed
+their collectives; warmup cliques are unnecessary); the only
+rank-conditional logic in the framework is on the *host* side, where it
+is required for correctness: ``is_primary()`` gates the metrics/
+TensorBoard writers and checkpoint commit markers so multi-host runs on
+a shared filesystem don't clobber each other's files, and the resilience
+layer's coordination primitives (resilience/coordination.py) broadcast
+decisions from the primary.
 """
 
 from __future__ import annotations
@@ -23,6 +28,10 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    *,
+    init_retries: int = 3,
+    retry_base_delay_s: float = 1.0,
+    retry_max_delay_s: float = 15.0,
 ) -> None:
     """Initialise multi-host JAX.
 
@@ -31,17 +40,75 @@ def initialize_distributed(
     RANK/WORLD_SIZE/MASTER_ADDR (utils/distributed.py env plumbing).
     Explicit args override; env vars COORDINATOR_ADDRESS / NUM_PROCESSES
     / PROCESS_ID are honoured as a fallback.
+
+    The ``jax.distributed.initialize`` call is retried with the shared
+    jittered-backoff :class:`RetryPolicy` (``init_retries`` attempts):
+    at pod bring-up the coordinator host routinely comes up seconds
+    after the workers, and a single connection flap must not kill a
+    256-chip job before it starts.  Exhausted retries raise a
+    :class:`~torchacc_tpu.errors.CoordinationError` naming the
+    coordinator address — the diagnostic that distinguishes "wrong
+    address/firewall" from a framework bug.
     """
+    from torchacc_tpu.errors import CoordinationError
+    from torchacc_tpu.resilience.retry import RetryPolicy, retry_call
+
+    # CPU multi-process (2-process tests, dev boxes): XLA:CPU needs a
+    # cross-host collectives backend selected BEFORE the runtime comes
+    # up, or every multi-process computation dies with "Multiprocess
+    # computations aren't implemented on the CPU backend".  gloo ships
+    # with jaxlib; reading the *config* (not jax.default_backend(),
+    # which would materialise backends too early) keeps this safe.
+    try:
+        platforms = str(getattr(jax.config, "jax_platforms", None)
+                        or os.environ.get("JAX_PLATFORMS", ""))
+        if "cpu" in platforms.split(","):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - older/newer jax: no such knob
+        pass
     coordinator_address = coordinator_address or os.environ.get(
         "COORDINATOR_ADDRESS")
     if num_processes is None and "NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["NUM_PROCESSES"])
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id)
+    where = coordinator_address or "<auto-detected coordinator>"
+
+    def _once():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id)
+        except RuntimeError as e:
+            # a previous (partial) attempt may have latched the runtime;
+            # "already initialized" is success, not a coordinator fault
+            # (jax phrases it "should only be called once").  Match the
+            # specific phrasings — NOT a bare "already", which would
+            # swallow genuine failures like "address already in use".
+            msg = str(e).lower()
+            if "already initialized" in msg or "only be called once" in msg:
+                logger.warning(
+                    "jax.distributed already initialized; reusing the "
+                    "existing runtime")
+                return
+            raise
+
+    policy = RetryPolicy(max_retries=max(init_retries, 0),
+                         base_delay_s=retry_base_delay_s,
+                         max_delay_s=retry_max_delay_s)
+    try:
+        retry_call(_once, policy=policy, counter="dist_init_retries",
+                   description=f"jax.distributed.initialize "
+                               f"(coordinator {where})")
+    except Exception as e:
+        raise CoordinationError(
+            f"could not initialise jax.distributed against coordinator "
+            f"{where} (process {process_id}/{num_processes}) after "
+            f"{policy.max_retries + 1} attempt(s): {e!r}.  Check that the "
+            "coordinator host is up, the address/port is reachable from "
+            "this host, and every process was launched with the same "
+            "num_processes.", primitive="initialize") from e
     logger.info(
         f"distributed initialised: process {jax.process_index()}/"
         f"{jax.process_count()}, {len(jax.devices())} global devices")

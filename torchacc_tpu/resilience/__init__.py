@@ -1,12 +1,15 @@
 """Fault-tolerance subsystem: preemption-safe auto-resume, step-level
-anomaly guards, retry/backoff for flaky I/O, and a deterministic
-fault-injection harness.
+anomaly guards, retry/backoff for flaky I/O, cross-host coordination,
+a hang/straggler watchdog, and a deterministic fault-injection harness.
 
 See docs/resilience.md for the operator-facing contract (what is and is
 not guaranteed).  Wiring: ``Config.resilience`` (config.py) configures
-the guards and retry policies; ``Trainer.fit(resume='auto')``
+the guards, deadlines, and retry policies; ``Trainer.fit(resume='auto')``
 (train/trainer.py) is the auto-resume entry point; checkpoint and data
-I/O pick up the retry policies automatically.
+I/O pick up the retry policies automatically.  Multi-host,
+``coordination`` keeps save/resume/quarantine decisions identical on
+every host and ``watchdog`` turns silent pod hangs into stack dumps,
+counters, and (optionally) a typed ``HangError``.
 """
 
 from torchacc_tpu.resilience.chaos import (
@@ -15,14 +18,24 @@ from torchacc_tpu.resilience.chaos import (
     chaos_loss,
     failpoint,
 )
+from torchacc_tpu.resilience.coordination import (
+    all_agree,
+    any_host,
+    barrier,
+    broadcast_from_primary,
+    max_over_hosts,
+    min_over_hosts,
+)
 from torchacc_tpu.resilience.guard import GuardMonitor, guard_apply, guard_init
 from torchacc_tpu.resilience.preemption import (
     clear_preemption,
     install_preemption_handler,
     preemption_requested,
     request_preemption,
+    sync_preemption,
 )
 from torchacc_tpu.resilience.retry import RetryPolicy, retry_call
+from torchacc_tpu.resilience.watchdog import Watchdog, dump_stacks, trip_stall
 
 __all__ = [
     "ChaosLoader",
@@ -36,6 +49,16 @@ __all__ = [
     "preemption_requested",
     "request_preemption",
     "clear_preemption",
+    "sync_preemption",
     "RetryPolicy",
     "retry_call",
+    "all_agree",
+    "any_host",
+    "barrier",
+    "broadcast_from_primary",
+    "max_over_hosts",
+    "min_over_hosts",
+    "Watchdog",
+    "dump_stacks",
+    "trip_stall",
 ]

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Set
 
@@ -45,9 +46,10 @@ def failpoint(name: str, **ctx: Any) -> None:
 
 @dataclass
 class _Rule:
-    times: int = 0                 # raise on the first `times` hits ...
+    times: int = 0                 # inject on the first `times` hits ...
     rate: float = 0.0              # ... plus with this seeded probability
     exc: Callable[[str], BaseException] = OSError
+    sleep_s: float = 0.0           # > 0: hang (sleep) instead of raising
     raised: int = 0
     hits: int = 0
 
@@ -76,6 +78,16 @@ class ChaosPlan:
         self._rules[point] = _Rule(times=times, rate=rate, exc=exc)
         return self
 
+    def hang(self, point: str, *, seconds: float,
+             times: int = 1) -> "ChaosPlan":
+        """Make the first ``times`` hits of ``point`` SLEEP ``seconds``
+        instead of raising — a deterministic mid-step/mid-fetch hang for
+        exercising the watchdog (resilience/watchdog.py) past its
+        deadline.  The sleep returns normally: what the run does about
+        the stall is entirely the watchdog's decision."""
+        self._rules[point] = _Rule(times=times, sleep_s=seconds)
+        return self
+
     def hit(self, point: str, ctx: Dict[str, Any]) -> None:
         rule = self._rules.get(point)
         if rule is None:
@@ -85,6 +97,12 @@ class ChaosPlan:
                   or (rule.rate > 0.0 and self._rng.random() < rule.rate))
         if inject:
             rule.raised += 1
+            if rule.sleep_s > 0.0:
+                logger.warning(
+                    f"chaos: injecting {rule.sleep_s:.1f}s hang "
+                    f"#{rule.raised} at {point} ({ctx or {}})")
+                time.sleep(rule.sleep_s)
+                return
             logger.warning(
                 f"chaos: injecting fault #{rule.raised} at {point} "
                 f"({ctx or {}})")

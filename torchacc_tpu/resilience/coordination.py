@@ -1,0 +1,184 @@
+"""Timeout-bounded cross-host agreement primitives.
+
+PR 1's fault-tolerance subsystem is per-process: the preemption flag,
+checkpoint quarantine, and ``restore_latest_valid`` can each diverge
+across the hosts of a pod, and a host that resumes from step 400 while
+its neighbours resume from step 500 corrupts the run silently (the first
+cross-host collective mixes states from different steps).  This module
+provides the small set of host-level agreement primitives the resilience
+layer needs — the MaxText/MegaScale pattern of "agree, then act":
+
+- :func:`broadcast_from_primary` — process 0's value everywhere;
+- :func:`min_over_hosts` / :func:`max_over_hosts` — reduce a host-local
+  integer (e.g. "my newest valid checkpoint step") across hosts;
+- :func:`any_host` / :func:`all_agree` — OR / AND over a host-local
+  boolean (preemption seen anywhere; restore succeeded everywhere);
+- :func:`barrier` — plain rendezvous.
+
+Every primitive is an **exact no-op** when ``jax.process_count() == 1``:
+no collective runs, no worker thread is spawned, no timeout is armed —
+single-host behaviour and performance are unchanged.  Multi-host, the
+underlying device collective (``jax.experimental.multihost_utils``) is
+run on a worker thread and bounded by ``timeout_s``: JAX collectives
+cannot be cancelled, so on expiry the caller gets a typed
+:class:`~torchacc_tpu.errors.CoordinationError` naming the primitive
+(the worker thread is abandoned — by then the pod is already wedged and
+the process is expected to exit and be restarted).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from torchacc_tpu.errors import CoordinationError
+from torchacc_tpu.utils.logger import logger
+
+#: Default wall-clock bound when a call site passes ``timeout_s=None``.
+#: ``Config.resilience.coord_timeout_s`` overrides this per-run.
+DEFAULT_TIMEOUT_S = 120.0
+
+
+def process_count() -> int:
+    """Number of JAX processes (1 before/without distributed init)."""
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:  # noqa: BLE001 - backend not initialised yet
+        return 1
+
+
+def process_index() -> int:
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def _bounded(fn: Callable[[], Any], *, timeout_s: Optional[float],
+             name: str) -> Any:
+    """Run ``fn`` (a collective) with a wall-clock bound.
+
+    The collective runs on a daemon worker thread; the caller waits at
+    most ``timeout_s``.  On expiry a :class:`CoordinationError` is
+    raised — the collective itself cannot be cancelled, so the worker
+    is left behind (documented at module level: a timed-out agreement
+    means the pod is wedged and the process should exit).
+    """
+    timeout_s = DEFAULT_TIMEOUT_S if timeout_s is None else timeout_s
+    box: dict = {}
+    done = threading.Event()
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised on caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name=f"coord-{name}")
+    t.start()
+    if not done.wait(timeout_s):
+        raise CoordinationError(
+            f"cross-host agreement '{name}' timed out after {timeout_s:.1f}s "
+            f"on process {process_index()}/{process_count()} — a host is "
+            "down or partitioned; restart the job (resume='auto' recovers "
+            "the run)", primitive=name, timeout_s=timeout_s)
+    if "error" in box:
+        raise CoordinationError(
+            f"cross-host agreement '{name}' failed on process "
+            f"{process_index()}/{process_count()}: {box['error']!r}",
+            primitive=name, timeout_s=timeout_s) from box["error"]
+    return box["value"]
+
+
+def _allgather(value: np.ndarray, *, timeout_s: Optional[float],
+               name: str) -> np.ndarray:
+    """Gather one small host-local array from every process; shape
+    ``(process_count,) + value.shape``."""
+    from jax.experimental import multihost_utils
+
+    return _bounded(
+        lambda: np.asarray(multihost_utils.process_allgather(value)),
+        timeout_s=timeout_s, name=name)
+
+
+# -- primitives ---------------------------------------------------------------
+
+def broadcast_from_primary(value: Any, *, timeout_s: Optional[float] = None,
+                           name: str = "broadcast") -> Any:
+    """Process 0's value on every host.
+
+    Accepts scalars and small ndarrays (the values being agreed on are
+    step numbers and flags, not tensors).  Single-process: returns
+    ``value`` unchanged — no collective, no timeout armed.
+    """
+    if process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(value)
+    out = _bounded(lambda: np.asarray(
+        multihost_utils.broadcast_one_to_all(arr)),
+        timeout_s=timeout_s, name=name)
+    return out.item() if np.ndim(value) == 0 and out.ndim == 0 else out
+
+
+def min_over_hosts(value: int, *, timeout_s: Optional[float] = None,
+                   name: str = "min-over-hosts") -> int:
+    """Smallest of the hosts' integers (e.g. the conservative resume
+    step).  Single-process: ``int(value)``, no collective."""
+    if process_count() == 1:
+        return int(value)
+    g = _allgather(np.asarray(int(value), np.int64),
+                   timeout_s=timeout_s, name=name)
+    return int(g.min())
+
+
+def max_over_hosts(value: int, *, timeout_s: Optional[float] = None,
+                   name: str = "max-over-hosts") -> int:
+    if process_count() == 1:
+        return int(value)
+    g = _allgather(np.asarray(int(value), np.int64),
+                   timeout_s=timeout_s, name=name)
+    return int(g.max())
+
+
+def any_host(flag: bool, *, timeout_s: Optional[float] = None,
+             name: str = "any-host") -> bool:
+    """True iff ANY host's flag is set (preemption seen anywhere).
+    Single-process: ``bool(flag)``, no collective."""
+    if process_count() == 1:
+        return bool(flag)
+    g = _allgather(np.asarray(bool(flag), np.int32),
+                   timeout_s=timeout_s, name=name)
+    return bool(g.any())
+
+
+def all_agree(flag: bool, *, timeout_s: Optional[float] = None,
+              name: str = "all-agree") -> bool:
+    """True iff EVERY host's flag is set (restore succeeded everywhere).
+    Single-process: ``bool(flag)``, no collective."""
+    if process_count() == 1:
+        return bool(flag)
+    g = _allgather(np.asarray(bool(flag), np.int32),
+                   timeout_s=timeout_s, name=name)
+    return bool(g.all())
+
+
+def barrier(name: str = "barrier",
+            *, timeout_s: Optional[float] = None) -> None:
+    """Rendezvous: returns once every host has reached it.
+    Single-process: immediate no-op."""
+    if process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    _bounded(lambda: multihost_utils.sync_global_devices(name),
+             timeout_s=timeout_s, name=name)
+    logger.debug(f"barrier '{name}' passed on "
+                 f"process {process_index()}/{process_count()}")

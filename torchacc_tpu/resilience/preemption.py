@@ -66,6 +66,34 @@ def preemption_requested() -> bool:
     return _event.is_set()
 
 
+def sync_preemption(timeout_s: Optional[float] = None) -> bool:
+    """Cross-host preemption sync point: True iff ANY host has the flag.
+
+    SIGTERM lands on one host's process; the others must join the
+    emergency save at the SAME step boundary or the checkpoint mixes
+    steps (the MaxText ``reached_preemption_sync_point`` pattern).
+    ``Trainer.fit`` calls this at each step boundary instead of the
+    local :func:`preemption_requested`.  Hosts learning of the request
+    via the sync set their local flag too, so every host takes the same
+    emergency-save branch.  Single-process: exactly the local flag — no
+    collective, no timeout armed.  A :class:`CoordinationError` from a
+    partitioned pod propagates (fail fast: the next collective would
+    hang anyway).
+    """
+    from torchacc_tpu.resilience.coordination import any_host, process_count
+
+    local = _event.is_set()
+    if process_count() == 1:
+        return local
+    agreed = any_host(local, timeout_s=timeout_s, name="preemption-sync")
+    if agreed and not local:
+        logger.warning(
+            "preemption requested on another host — joining the "
+            "emergency save at this step boundary")
+        _event.set()
+    return agreed
+
+
 def request_preemption(reason: str = "") -> None:
     """Programmatic preemption (chaos harness, external schedulers)."""
     if reason:

@@ -1,0 +1,284 @@
+"""Hang/straggler watchdog: per-section deadlines, stack dumps, HangError.
+
+A stuck collective, a deadlocked input source, or one straggling host
+hangs a multi-host pod *silently*: every other host blocks in the next
+collective and the run burns reservation time with zero diagnostics.
+The watchdog is the active half of the resilience story (MegaScale §4
+"hang diagnosis"): a daemon monitor thread checks an armed deadline; on
+expiry it
+
+1. dumps **all-thread stacks** via :mod:`faulthandler` (to a file under
+   ``dump_dir`` when set, else stderr) — the artefact that tells you
+   *where* the pod wedged without attaching a debugger;
+2. increments the ``watchdog_stalls`` counter (utils/metrics.py), which
+   rides the step log line and metrics.jsonl;
+3. with ``abort_on_hang``, records a typed
+   :class:`~torchacc_tpu.errors.HangError` that is raised at the next
+   watchdog interaction (``disarm``/``arm``/``beat``) once the stalled
+   section returns — a supervisor restarts the job into
+   ``fit(resume='auto')``.
+
+A section that never returns cannot have a Python exception delivered
+into it (the hang is below the interpreter, in a device wait or a
+syscall); for that case the dump + counter are the product, and an
+external supervisor timeout is the backstop (docs/resilience.md).
+
+The clock and the monitor thread are injectable/disable-able so unit
+tests drive expiry deterministically with a fake clock.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+from torchacc_tpu.errors import HangError
+from torchacc_tpu.utils.logger import logger
+
+_dump_seq_lock = threading.Lock()
+_dump_seq = 0
+
+
+def dump_stacks(label: str, dump_dir: Optional[str] = None) -> Optional[str]:
+    """Write all-thread stacks; returns the file path (None = stderr).
+
+    File names carry the JAX process index, the pid, and a process-wide
+    sequence number: a pod-wide stall makes EVERY host dump at once
+    into the same shared dump dir, and containerised hosts share pids
+    (often 1), so pid+seq alone would clobber the very evidence that
+    says which host wedged."""
+    global _dump_seq
+    if dump_dir:
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            with _dump_seq_lock:
+                _dump_seq += 1
+                seq = _dump_seq
+            from torchacc_tpu.resilience.coordination import process_index
+            path = os.path.join(
+                dump_dir, f"watchdog_{label}_proc{process_index()}"
+                          f"_{os.getpid()}_{seq}.txt")
+            with open(path, "w") as f:
+                f.write(f"watchdog stall: section '{label}' "
+                        f"(pid {os.getpid()})\n")
+                f.flush()
+                faulthandler.dump_traceback(file=f, all_threads=True)
+            return path
+        except OSError as e:  # unwritable dir — fall through to stderr
+            logger.warning(f"watchdog could not write stack dump: {e}")
+    try:
+        sys.stderr.write(f"watchdog stall: section '{label}' "
+                         f"(pid {os.getpid()})\n")
+        faulthandler.dump_traceback(all_threads=True)
+    except Exception:  # noqa: BLE001 - stderr may be closed at teardown
+        pass
+    return None
+
+
+def _stall_event(label: str, waited_s: float, deadline_s: float,
+                 dump_dir: Optional[str], abort: bool,
+                 note: str = "") -> tuple:
+    """The one stall-handling core every trip path shares: count the
+    stall, dump all-thread stacks, log, and (when aborting) BUILD the
+    typed error — the caller decides whether to raise it now
+    (:func:`trip_stall`) or defer it to the next step boundary
+    (:class:`Watchdog`).  Returns ``(dump_path, Optional[HangError])``."""
+    from torchacc_tpu.utils.metrics import counters
+
+    counters.inc("watchdog_stalls")
+    path = dump_stacks(label, dump_dir)
+    where = path or "stderr"
+    logger.error(
+        f"watchdog: '{label}' exceeded its {deadline_s:.1f}s deadline "
+        f"(waited {waited_s:.1f}s); all-thread stacks dumped to {where}"
+        + note)
+    err = None
+    if abort:
+        err = HangError(
+            f"'{label}' exceeded its {deadline_s:.1f}s deadline (waited "
+            f"{waited_s:.1f}s; stacks at {where}).  Restart with "
+            "resume='auto' to recover the run.",
+            label=label, deadline_s=deadline_s, waited_s=waited_s,
+            dump_path=path)
+    return path, err
+
+
+def trip_stall(label: str, waited_s: float, deadline_s: float, *,
+               dump_dir: Optional[str] = None,
+               abort: bool = False) -> Optional[str]:
+    """One-shot stall handler for call sites without a Watchdog thread
+    (the async loader's consumer wait).  Dumps stacks, counts the stall,
+    and raises :class:`HangError` when ``abort`` is set."""
+    path, err = _stall_event(label, waited_s, deadline_s, dump_dir, abort)
+    if err is not None:
+        raise err
+    return path
+
+
+class Watchdog:
+    """Arms a deadline around a section of the training loop.
+
+    Usage (what ``Trainer.fit`` does)::
+
+        wd = Watchdog(dump_dir=..., abort_on_hang=True)
+        wd.start()
+        ...
+        wd.arm("data_fetch", 120.0)   # re-arming replaces the deadline
+        batch = next(it)
+        wd.arm("train_step", 300.0)
+        trainer.step(batch)
+        wd.disarm()                   # raises a pending HangError here
+        ...
+        wd.close()
+
+    ``beat()`` resets the armed deadline without changing the label —
+    long sections with internal progress (a retry loop) stay "alive" by
+    beating, so slow-but-alive never false-positives.  ``clock`` and
+    ``poll_interval_s=None`` (no monitor thread; tests call
+    :meth:`check_now` directly) make expiry deterministic under test.
+    """
+
+    def __init__(self, *, dump_dir: Optional[str] = None,
+                 abort_on_hang: bool = False,
+                 poll_interval_s: Optional[float] = 0.25,
+                 clock=time.monotonic, name: str = "watchdog"):
+        self._dump_dir = dump_dir
+        self._abort = abort_on_hang
+        self._poll = poll_interval_s
+        self._clock = clock
+        self._name = name
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # armed-section state (all under _lock)
+        self._armed = False
+        self._label = ""
+        self._deadline_s = 0.0
+        self._armed_at = 0.0
+        self._gen = 0            # bumped on arm/disarm: one trip per arm
+        self._tripped_gen = -1
+        self._pending: Optional[HangError] = None
+        self._last_beat = clock()
+        self.stalls = 0
+        self.last_dump_path: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._poll is not None and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._monitor, daemon=True, name=self._name)
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the monitor thread.  Never raises (safe in ``finally``);
+        a pending HangError is dropped with a log line."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._lock:
+            if self._pending is not None:
+                logger.warning(
+                    f"watchdog closed with an unraised {self._pending!r}")
+                self._pending = None
+            self._armed = False
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self.check_now()
+            except Exception as e:  # noqa: BLE001 - monitor must survive
+                logger.warning(f"watchdog monitor error: {e!r}")
+
+    # -- arming -------------------------------------------------------------
+    def _take_pending(self) -> Optional[HangError]:
+        p, self._pending = self._pending, None
+        return p
+
+    def arm(self, label: str, deadline_s: Optional[float]) -> None:
+        """Start (or replace) the watched section.  Raises a pending
+        HangError from the previous section first, so a stall detected
+        mid-step surfaces at the next step boundary."""
+        with self._lock:
+            p = self._take_pending()
+            if p is None:
+                self._armed = deadline_s is not None
+                self._label = label
+                self._deadline_s = deadline_s or 0.0
+                now = self._clock()
+                self._armed_at = now
+                self._last_beat = now
+                self._gen += 1
+        if p is not None:
+            raise p
+
+    def beat(self) -> None:
+        """Progress heartbeat: resets the armed deadline."""
+        with self._lock:
+            now = self._clock()
+            self._last_beat = now
+            if self._armed:
+                self._armed_at = now
+
+    def disarm(self, raise_pending: bool = True) -> None:
+        with self._lock:
+            self._armed = False
+            self._gen += 1
+            self._last_beat = self._clock()
+            p = self._take_pending() if raise_pending else None
+            if not raise_pending:
+                self._pending = None
+        if p is not None:
+            raise p
+
+    @contextlib.contextmanager
+    def watch(self, label: str, deadline_s: Optional[float]):
+        """Context-manager form of arm/disarm."""
+        self.arm(label, deadline_s)
+        try:
+            yield self
+        except BaseException:
+            # don't let a pending HangError mask the in-flight exception
+            self.disarm(raise_pending=False)
+            raise
+        self.disarm()
+
+    def heartbeat_age_s(self) -> float:
+        """Seconds since the last arm/beat/disarm — the liveness gauge
+        the Trainer logs into metrics.jsonl."""
+        with self._lock:
+            return max(self._clock() - self._last_beat, 0.0)
+
+    # -- expiry -------------------------------------------------------------
+    def check_now(self) -> bool:
+        """Evaluate the armed deadline (monitor thread; tests call it
+        directly after advancing a fake clock).  Returns True when this
+        call tripped the stall."""
+        with self._lock:
+            if not self._armed or self._gen == self._tripped_gen:
+                return False
+            waited = self._clock() - self._armed_at
+            if waited <= self._deadline_s:
+                return False
+            self._tripped_gen = self._gen
+            label, deadline = self._label, self._deadline_s
+            self.stalls += 1
+        path, err = _stall_event(
+            label, waited, deadline, self._dump_dir, self._abort,
+            note=("; HangError will be raised at the next step boundary"
+                  if self._abort else ""))
+        self.last_dump_path = path
+        if err is not None:
+            with self._lock:
+                # only the generation that tripped may raise: if the
+                # section was disarmed between the dump and here, the
+                # step finished — log-only, no late abort of healthy code
+                if self._armed and self._gen == self._tripped_gen:
+                    self._pending = err
+        return True

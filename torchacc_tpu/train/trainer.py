@@ -523,6 +523,8 @@ class Trainer:
 
     def step(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         """One optimizer step; returns (async) metrics."""
+        from torchacc_tpu.resilience.chaos import failpoint
+        failpoint("trainer.step")
         if self.state is None:
             self.init()
         # keyed on structure AND leaf ranks: in_shardings depend on rank
@@ -646,7 +648,8 @@ class Trainer:
             from torchacc_tpu.checkpoint import CheckpointManager
             mgr = CheckpointManager(
                 checkpoint_dir, save_interval_steps=checkpoint_every,
-                retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries))
+                retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries),
+                coord_timeout_s=res_cfg.coord_timeout_s)
         start_step = 0
         if resume is not None:
             if resume != "auto":
@@ -681,10 +684,14 @@ class Trainer:
                     "batches")
         preempt_on = mgr is not None and res_cfg.emergency_checkpoint
         if preempt_on:
+            from torchacc_tpu.resilience.coordination import (
+                process_count as _process_count,
+            )
             from torchacc_tpu.resilience.preemption import (
                 clear_preemption,
                 install_preemption_handler,
                 preemption_requested,
+                sync_preemption,
             )
             install_preemption_handler()
             if preemption_requested():
@@ -696,6 +703,27 @@ class Trainer:
                     "clearing a stale preemption request at fit start")
                 clear_preemption()
         mw = open_metrics(metrics_dir)
+        # hang/straggler watchdog (resilience/watchdog.py): armed around
+        # the data fetch and the train step; a deadline expiry dumps
+        # all-thread stacks + counts a watchdog_stall, and (with
+        # resilience.abort_on_hang) raises HangError at the next step
+        # boundary.  step_deadline_s=None (default): no watchdog thread.
+        wd = None
+        fetch_deadline = None
+        if res_cfg.step_deadline_s is not None:
+            from torchacc_tpu.resilience.watchdog import Watchdog
+            wd = Watchdog(
+                dump_dir=metrics_dir or checkpoint_dir,
+                abort_on_hang=res_cfg.abort_on_hang,
+                poll_interval_s=min(
+                    max(res_cfg.step_deadline_s / 4.0, 0.01), 1.0),
+            ).start()
+            # when loader_deadline_s is set, the loader's OWN consumer-
+            # wait deadline (AsyncLoader._get_with_stall_deadline) owns
+            # fetch stalls — arming the fit-side watchdog too would trip
+            # the same stall twice (two dumps, two counter increments)
+            fetch_deadline = (None if res_cfg.loader_deadline_s
+                              else res_cfg.step_deadline_s)
         history = []
         t0 = _time.perf_counter()
         t_prev, s_prev = t0, start_step
@@ -713,7 +741,18 @@ class Trainer:
             bounded = (itertools.islice(data_it, start_step, max_steps)
                        if (max_steps is not None or start_step) else data_it)
         try:
-            for step_idx, batch in enumerate(bounded, start=start_step):
+            steps_it = enumerate(bounded, start=start_step)
+            while True:
+                if wd is not None:
+                    wd.arm("data_fetch", fetch_deadline)
+                try:
+                    step_idx, batch = next(steps_it)
+                except StopIteration:
+                    if wd is not None:
+                        wd.disarm()
+                    break
+                if wd is not None:
+                    wd.arm("train_step", res_cfg.step_deadline_s)
                 metrics = self.step(batch)
                 do_log = log_every and step_idx % log_every == 0
                 do_eval = (eval_loader is not None and eval_every
@@ -723,6 +762,15 @@ class Trainer:
                     rec = {"step": step_idx,
                            "loss": float(metrics["loss"]),
                            "time_s": round(now - t0, 2)}
+                    if wd is not None:
+                        # sample the age BEFORE beating: it reports how
+                        # long this section actually ran (≈ the step +
+                        # metrics sync), not a freshly-reset zero
+                        rec["heartbeat_age_s"] = round(
+                            wd.heartbeat_age_s(), 3)
+                        # the step itself finished — liveness proven;
+                        # eval/logging get their own deadline window
+                        wd.beat()
                     if step_idx > s_prev:
                         rec["steps_per_sec"] = round(
                             (step_idx - s_prev) / max(now - t_prev, 1e-9), 3)
@@ -749,12 +797,28 @@ class Trainer:
                                 if k != "step"})
                     logger.info(f"step {step_idx}: loss {rec['loss']:.4f}"
                                 f"{counters.suffix()}")
+                if wd is not None:
+                    # step boundary: a stall detected mid-step surfaces
+                    # as HangError HERE (abort_on_hang), where state is
+                    # consistent and resume='auto' recovers cleanly
+                    wd.disarm()
                 saved = False
                 if mgr is not None:
                     # label = completed-step count == state.step after
                     # this step
                     saved = mgr.save(step_idx + 1, self.state)
-                if preempt_on and preemption_requested():
+                # cross-host sync point: the emergency save triggers on
+                # EVERY host at this same boundary when ANY host saw the
+                # signal (exact local-flag check in single-process runs).
+                # The interval gate depends only on step_idx, so every
+                # host reaches (or skips) the collective symmetrically.
+                sync_every = res_cfg.preempt_sync_interval_steps
+                if preempt_on \
+                        and (sync_every <= 1
+                             or (step_idx + 1) % sync_every == 0
+                             or _process_count() == 1) \
+                        and sync_preemption(
+                            timeout_s=res_cfg.coord_timeout_s):
                     # blocking emergency save (Orbax emergency-checkpoint
                     # pattern): make the just-completed step durable, then
                     # return cleanly — the grace window is for saving,
@@ -774,6 +838,8 @@ class Trainer:
                         "(resume with fit(resume='auto'))")
                     break
         finally:
+            if wd is not None:
+                wd.close()
             # early exits (preemption, max_steps, errors) must shut the
             # async loader's producer thread down NOW — a daemon thread
             # abandoned inside the runtime trips std::terminate at

@@ -80,16 +80,44 @@ class Counters:
 counters = Counters()
 
 
-class MetricsWriter:
-    """Scalar metrics sink: JSONL always, TensorBoard when available."""
+def _process_index() -> int:
+    """JAX process index (0 before/without distributed init).  A
+    module-level seam — not a direct call site — so tests can
+    monkeypatch it; delegates to the one shared implementation in
+    resilience/coordination.py (lazy import: metrics must stay cheap to
+    import)."""
+    from torchacc_tpu.resilience.coordination import process_index
+    return process_index()
 
-    def __init__(self, logdir: str, *, tensorboard: bool = True):
+
+class MetricsWriter:
+    """Scalar metrics sink: JSONL always, TensorBoard when available.
+
+    Multi-host: on a shared filesystem every process appending to the
+    same ``metrics.jsonl`` interleaves half-written lines and TensorBoard
+    event files shadow each other, so by default only the primary
+    process (``jax.process_index() == 0``) writes — the SPMD metrics are
+    identical on every host anyway.  ``all_processes=True`` opts
+    non-primary processes into their own ``metrics.<process_index>.jsonl``
+    (per-host loader/watchdog counters DO differ); TensorBoard stays
+    primary-only.  Single-process behaviour is unchanged.
+    """
+
+    def __init__(self, logdir: str, *, tensorboard: bool = True,
+                 all_processes: bool = False):
         self.logdir = logdir
-        os.makedirs(logdir, exist_ok=True)
-        self._jsonl = open(os.path.join(logdir, "metrics.jsonl"), "a",
-                           buffering=1)
+        idx = _process_index()
+        self._jsonl = None
         self._tb = None
-        if tensorboard:
+        if idx != 0 and not all_processes:
+            logger.debug(
+                f"metrics writer inactive on process {idx} (primary-only "
+                "default; pass all_processes=True for per-process files)")
+            return
+        os.makedirs(logdir, exist_ok=True)
+        fname = "metrics.jsonl" if idx == 0 else f"metrics.{idx}.jsonl"
+        self._jsonl = open(os.path.join(logdir, fname), "a", buffering=1)
+        if tensorboard and idx == 0:
             try:
                 from torch.utils.tensorboard import SummaryWriter
                 self._tb = SummaryWriter(log_dir=logdir)
@@ -99,6 +127,8 @@ class MetricsWriter:
                     f"{logdir}/metrics.jsonl only")
 
     def log(self, step: int, scalars: Dict[str, Number]) -> None:
+        if self._jsonl is None:
+            return
         rec = {"step": int(step), "time": time.time()}
         for k, v in scalars.items():
             rec[k] = float(v)
@@ -111,17 +141,22 @@ class MetricsWriter:
             self._tb.add_text(tag, text, int(step))
 
     def flush(self) -> None:
-        self._jsonl.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
         if self._tb is not None:
             self._tb.flush()
 
     def close(self) -> None:
         self.flush()
-        self._jsonl.close()
+        if self._jsonl is not None:
+            self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
 
 
-def open_metrics(logdir: Optional[str]) -> Optional[MetricsWriter]:
+def open_metrics(logdir: Optional[str],
+                 all_processes: bool = False) -> Optional[MetricsWriter]:
     """None-safe constructor for call sites with an optional dir."""
-    return MetricsWriter(logdir) if logdir else None
+    if not logdir:
+        return None
+    return MetricsWriter(logdir, all_processes=all_processes)
