@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -32,7 +32,8 @@ chaos:
 	for s in 0 1 2; do \
 		echo "== chaos seed $$s =="; \
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
-			tests/test_watchdog.py -q || exit 1; \
+			tests/test_watchdog.py tests/test_elastic.py -m "not slow" \
+			-q || exit 1; \
 	done
 
 # multi-host robustness proof: 2-process jax.distributed fixtures
@@ -41,6 +42,17 @@ chaos:
 # from the in-process suites
 chaos-multihost:
 	JAX_PLATFORMS=cpu $(PYTEST) tests/ -m multihost -q
+
+# elastic-resume proof: corrupt-batch quarantine + topology-change
+# chaos scenarios under 3 seeds (fast, in-process), then the
+# subprocess DP=2 <-> DP=1 save/restore fixtures
+chaos-elastic:
+	for s in 0 1 2; do \
+		echo "== chaos-elastic seed $$s =="; \
+		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_elastic.py \
+			-m "not slow" -q || exit 1; \
+	done
+	JAX_PLATFORMS=cpu $(PYTEST) tests/test_elastic.py -m "elastic and slow" -q
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -7,6 +7,12 @@ from torchacc_tpu.checkpoint.reshard import (
     consolidate_checkpoint,
     reshard_checkpoint,
 )
+from torchacc_tpu.checkpoint.schema import (
+    check_compatibility,
+    schema_diff,
+    state_schema,
+    tree_digest,
+)
 
 __all__ = [
     "CheckpointManager",
@@ -14,4 +20,8 @@ __all__ = [
     "restore_checkpoint",
     "consolidate_checkpoint",
     "reshard_checkpoint",
+    "state_schema",
+    "schema_diff",
+    "check_compatibility",
+    "tree_digest",
 ]

@@ -4,24 +4,150 @@ Mirrors the reference CLI surface (setup.py:36-40 console script ->
 utils/consolidate_and_reshard_ckpts.py argparse main): point it at a
 sharded checkpoint, get a consolidated copy or a copy resharded for a
 new parallel layout.
+
+Operator additions for elastic resume (docs/resilience.md):
+
+- ``inspect``: print the schema manifest (mesh axes/sizes, process
+  count, step, per-leaf shapes/dtypes) of a checkpoint — or of every
+  marked step in a CheckpointManager directory — so compatibility can
+  be judged BEFORE burning a restore attempt on a pod.
+- ``--dry-run``: for consolidate/reshard, print what would be read and
+  written (and the schema diff against the target layout) without
+  touching anything.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 
 
+def _load_schema(ckpt_dir: str):
+    """Schema manifest for ``ckpt_dir``: the ``_MANIFEST`` inside a
+    manager step dir, the ``<dir>.schema.json`` sidecar of a standalone
+    save, or None."""
+    from torchacc_tpu.checkpoint.io import MANIFEST, _schema_sidecar
+
+    manifest = os.path.join(ckpt_dir, MANIFEST)
+    if os.path.exists(manifest):
+        try:
+            with open(manifest) as f:
+                m = json.load(f)
+            return m.get("schema") or {"tree": m.get("tree")}
+        except (OSError, ValueError):
+            return None
+    sidecar = _schema_sidecar(os.path.abspath(ckpt_dir))
+    if os.path.exists(sidecar):
+        try:
+            with open(sidecar) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+    return None
+
+
+def _schema_from_metadata(ckpt_dir: str):
+    """Fallback for checkpoints predating schema manifests: leaf
+    shapes/dtypes from orbax tree metadata (no mesh/process info — that
+    was never recorded)."""
+    import orbax.checkpoint as ocp
+
+    from torchacc_tpu.checkpoint.schema import state_schema
+
+    meta = ocp.StandardCheckpointer().metadata(os.path.abspath(ckpt_dir))
+    meta = getattr(meta, "item_metadata", meta)
+    schema = state_schema(meta)
+    # orbax metadata carries neither live shardings nor the writing
+    # pod's size — report "unknown", never the inspecting process's own
+    schema["mesh"] = None
+    schema["process_count"] = None
+    return schema
+
+
+def _print_schema(label: str, schema, *, leaves: bool, out=None):
+    out = out if out is not None else sys.stdout  # resolved at call time
+    mesh = schema.get("mesh")
+    tree = schema.get("tree") or {}
+    print(f"{label}:", file=out)
+    print(f"  mesh: "
+          + (" ".join(f"{k}={v}" for k, v in mesh.items()) if mesh
+             else "<not recorded>"), file=out)
+    if schema.get("process_count") is not None:
+        print(f"  processes: {schema['process_count']}", file=out)
+    print(f"  leaves: {tree.get('leaves', '?')}  "
+          f"digest: {str(tree.get('digest', '?'))[:16]}", file=out)
+    specs = schema.get("leaf_specs") or {}
+    if leaves and specs:
+        for path in sorted(specs):
+            s = specs[path]
+            print(f"    {path}: {tuple(s['shape'])} {s['dtype']}",
+                  file=out)
+
+
+def _cmd_inspect(args) -> int:
+    from torchacc_tpu.checkpoint.io import MANIFEST
+
+    d = args.ckpt_dir
+    if not os.path.isdir(d):
+        print(f"error: {d} is not a directory", file=sys.stderr)
+        return 2
+    # a CheckpointManager directory: numeric step subdirs with markers
+    steps = sorted(
+        int(n) for n in os.listdir(d)
+        if n.isdigit() and os.path.exists(os.path.join(d, n, MANIFEST)))
+    if steps:
+        for step in steps:
+            try:
+                with open(os.path.join(d, str(step), MANIFEST)) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError) as e:
+                # a truncated/corrupt marker is exactly what an operator
+                # points this tool at — report it, keep printing siblings
+                print(f"step {step}: unreadable {MANIFEST} ({e})",
+                      file=sys.stderr)
+                continue
+            schema = manifest.get("schema") or {"tree": manifest.get("tree")}
+            _print_schema(f"step {step}", schema, leaves=args.leaves)
+        return 0
+    schema = _load_schema(d)
+    if schema is None:
+        try:
+            schema = _schema_from_metadata(d)
+        except Exception as e:  # noqa: BLE001 - operator-facing tool
+            print(f"error: no schema manifest and orbax metadata "
+                  f"unreadable for {d}: {e!r}", file=sys.stderr)
+            return 2
+    _print_schema(d, schema, leaves=args.leaves)
+    return 0
+
+
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "inspect":
+        p = argparse.ArgumentParser(
+            prog="consolidate_and_reshard_ckpts inspect",
+            description="Print a checkpoint's schema manifest (mesh, "
+                        "step, leaf shapes/dtypes).")
+        p.add_argument("ckpt_dir", help="checkpoint (or manager) directory")
+        p.add_argument("--leaves", action="store_true",
+                       help="also list per-leaf shapes/dtypes")
+        return _cmd_inspect(p.parse_args(argv[1:]))
+
     p = argparse.ArgumentParser(
         prog="consolidate_and_reshard_ckpts",
-        description="Consolidate or reshard torchacc_tpu checkpoints.")
+        description="Consolidate or reshard torchacc_tpu checkpoints "
+                    "('inspect <dir>' prints the schema manifest).")
     p.add_argument("--ckpt_dir", required=True, help="source checkpoint")
     p.add_argument("--save_dir", required=True, help="destination")
     p.add_argument("--reshard_num", type=int, default=1,
                    help="target fsdp shard count (1 = consolidate only)")
     p.add_argument("--mesh_axis", default="fsdp",
                    help="mesh axis to reshard over (default fsdp)")
+    p.add_argument("--dry-run", action="store_true", dest="dry_run",
+                   help="print the plan (and the schema diff for "
+                        "reshard) without reading arrays or writing")
     args = p.parse_args(argv)
 
     import jax
@@ -32,6 +158,18 @@ def main(argv=None) -> int:
     )
 
     if args.reshard_num <= 1:
+        if args.dry_run:
+            schema = _load_schema(args.ckpt_dir)
+            if schema is None:
+                try:
+                    schema = _schema_from_metadata(args.ckpt_dir)
+                except Exception as e:  # noqa: BLE001
+                    print(f"error: cannot read {args.ckpt_dir}: {e!r}",
+                          file=sys.stderr)
+                    return 2
+            _print_schema(f"would consolidate {args.ckpt_dir} -> "
+                          f"{args.save_dir}", schema, leaves=False)
+            return 0
         consolidate_checkpoint(args.ckpt_dir, args.save_dir)
         return 0
 
@@ -49,9 +187,10 @@ def main(argv=None) -> int:
     mesh = Mesh(np.asarray(devs[:args.reshard_num]), (args.mesh_axis,))
 
     # shapes/dtypes from checkpoint metadata — no full host read
-    import os
-    meta = ocp.StandardCheckpointer().metadata(
-        os.path.abspath(args.ckpt_dir)).item_metadata
+    # (manager item dirs return the tree directly; standalone dirs wrap
+    # it in a metadata object)
+    meta = ocp.StandardCheckpointer().metadata(os.path.abspath(args.ckpt_dir))
+    meta = getattr(meta, "item_metadata", meta)
 
     def absify(x):
         shape = tuple(x.shape)
@@ -62,6 +201,19 @@ def main(argv=None) -> int:
                                     sharding=NamedSharding(mesh, spec))
 
     abstract = jax.tree.map(absify, meta)
+    if args.dry_run:
+        from torchacc_tpu.checkpoint.schema import schema_diff, state_schema
+
+        target = state_schema(abstract)
+        _print_schema(f"would reshard {args.ckpt_dir} -> {args.save_dir}",
+                      target, leaves=False)
+        saved = _load_schema(args.ckpt_dir)
+        if saved is not None:
+            diff = schema_diff(saved, target)
+            print("  changes vs source:"
+                  + ("".join(f"\n    {d}" for d in diff) if diff
+                     else " none"))
+        return 0
     reshard_checkpoint(args.ckpt_dir, args.save_dir, abstract)
     return 0
 

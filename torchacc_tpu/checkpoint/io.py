@@ -13,7 +13,6 @@ has no equivalent because nothing is ever flattened.
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import re
@@ -24,12 +23,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
-from jax.tree_util import tree_flatten_with_path
 
+from torchacc_tpu.checkpoint.schema import (
+    check_compatibility,
+    state_schema,
+    tree_digest,
+)
 from torchacc_tpu.errors import (
     CheckpointCorruptionError,
     CheckpointError,
     CheckpointNotFoundError,
+    StateSchemaError,
+    TopologyMismatchError,
 )
 from torchacc_tpu.resilience import coordination as coord
 from torchacc_tpu.resilience.chaos import failpoint
@@ -40,22 +45,27 @@ from torchacc_tpu.utils.logger import logger
 #: Marker file written into a step directory only after the write is
 #: durable; steps without it are partial writes and are never resumed.
 MANIFEST = "_MANIFEST"
-_MANIFEST_FORMAT = 1
+_MANIFEST_FORMAT = 2
+#: Durable data-pipeline state (loader.state_dict()) persisted next to
+#: the step's payload; written by the primary, before the marker.
+LOADER_STATE = "loader_state.json"
 
 
-def tree_digest(tree: Any) -> Dict[str, Any]:
-    """Structure summary of a state pytree: leaf count + sha256 over the
-    sorted ``path:shape:dtype`` lines.  Works on real arrays and on
-    ShapeDtypeStruct trees alike (None leaves are flattened out of both),
-    so a digest recorded at save time can be checked against a trainer's
-    abstract state before restoring."""
-    leaves, _ = tree_flatten_with_path(tree)
-    lines = sorted(
-        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        + f":{tuple(getattr(x, 'shape', ()))}:{getattr(x, 'dtype', '?')}"
-        for path, x in leaves)
-    h = hashlib.sha256("\n".join(lines).encode()).hexdigest()
-    return {"leaves": len(lines), "digest": h}
+def _jsonable(o: Any):
+    """json.dump ``default``: numpy scalars/arrays in loader states
+    serialise as plain Python numbers/lists."""
+    if hasattr(o, "item") and getattr(o, "ndim", None) == 0:
+        return o.item()
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    raise TypeError(f"not JSON-serialisable: {type(o).__name__}")
+
+
+def _schema_sidecar(path: str) -> str:
+    """Schema manifest for standalone ``save_checkpoint`` dirs: a
+    SIBLING file (``<path>.schema.json``), never inside the orbax item
+    directory, whose layout inference must not see foreign files."""
+    return path.rstrip("/") + ".schema.json"
 
 
 def _snapshot(state: Any) -> Any:
@@ -89,6 +99,15 @@ def save_checkpoint(path: str, state: Any, *, force: bool = False,
         state = _snapshot(state)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state, force=force)
+    if coord.process_index() == 0:
+        # schema manifest (mesh axes/sizes, process count, per-leaf
+        # shapes/dtypes) as a sibling file: restore/inspect judge
+        # compatibility from it without touching array data
+        try:
+            with open(_schema_sidecar(path), "w") as f:
+                json.dump(state_schema(state), f)
+        except OSError as e:  # advisory: never fail the save over it
+            logger.warning(f"could not write schema manifest for {path}: {e}")
     handle = AsyncSave(ckptr, path)
     if blocking:
         handle.wait()
@@ -135,7 +154,7 @@ def restore_checkpoint(
         return ckptr.restore(path)
     try:
         return ckptr.restore(path, abstract_state)
-    except Exception:
+    except Exception as restore_err:
         # Migration shim: checkpoints saved before the canonical-stacked
         # unification (models/transformer.py "ONE canonical param layout")
         # hold per-layer ``layers_{i}`` subtrees where the current layout
@@ -144,19 +163,46 @@ def restore_checkpoint(
         # target — otherwise re-raise the original mismatch untouched.
         legacy = _checkpoint_has_legacy_layers(ckptr, path)
         if legacy is False:
-            raise  # known-modern layout: the mismatch is genuine
+            # known-modern layout: the mismatch is genuine — surface it
+            # as a typed schema error with a per-leaf diff when the
+            # schema sidecar can explain it, else untouched
+            _raise_schema_error_if_explains(path, abstract_state,
+                                            restore_err)
+            raise
         # legacy is True (metadata shows layers_{i}) or None (metadata
         # unavailable on this orbax — decide from the host restore, the
         # one case that still pays full host RAM)
         host = ckptr.restore(path)
         converted, changed = _restack_legacy_layers(host)
         if not changed:
+            _raise_schema_error_if_explains(path, abstract_state,
+                                            restore_err)
             raise
         logger.warning(
             f"checkpoint at {path} uses the legacy unrolled per-layer "
             "param layout (layers_0..layers_N); restacking to the "
             "canonical stacked layout.  Re-save to migrate permanently.")
         return _reshard_into(converted, abstract_state)
+
+
+def _raise_schema_error_if_explains(path: str, abstract_state: Any,
+                                    cause: Exception) -> None:
+    """When the sidecar schema manifest shows a genuine state-tree drift
+    against the restore target, raise a typed :class:`StateSchemaError`
+    carrying the per-leaf diff (chained to orbax's original error) —
+    otherwise return and let the caller re-raise the original.  Explicit
+    restores deliberately reshard across meshes, so only *tree* drift is
+    judged here, never topology."""
+    try:
+        with open(_schema_sidecar(path)) as f:
+            saved = json.load(f)
+    except (OSError, ValueError):
+        return
+    from torchacc_tpu.checkpoint.schema import drift_error
+    err = drift_error(saved, state_schema(abstract_state),
+                      where=f"checkpoint at {path}")
+    if err is not None:
+        raise err from cause
 
 
 def _checkpoint_has_legacy_layers(ckptr, path: str) -> Optional[bool]:
@@ -324,11 +370,16 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: int = 3,
                  save_interval_steps: int = 1,
                  retry_policy: Optional[RetryPolicy] = None,
-                 coord_timeout_s: Optional[float] = None):
+                 coord_timeout_s: Optional[float] = None,
+                 elastic_resume: bool = False):
         self._dir = os.path.abspath(directory)
         self._retry = (retry_policy if retry_policy is not None
                        else RetryPolicy(max_retries=3))
         self._coord_timeout = coord_timeout_s
+        self._elastic = elastic_resume
+        # steps whose schema check returned "elastic": their restore may
+        # fall back to the online host-reshard path on an orbax failure
+        self._elastic_steps: set = set()
         self._should_save_logged = False
         # steps saved through this manager whose manifests are still
         # pending (orbax save is async; the marker must be written last)
@@ -340,7 +391,14 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self._dir, options=self._options)
 
     # -- save ---------------------------------------------------------------
-    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+    def save(self, step: int, state: Any, *, force: bool = False,
+             loader_state: Optional[Dict[str, Any]] = None) -> bool:
+        """Save ``state`` under ``step``.  ``loader_state`` (a loader's
+        ``state_dict()``, or a zero-arg callable returning one — invoked
+        only on steps that actually write) is persisted as
+        ``loader_state.json`` in the step directory when the step
+        commits, making resume O(1) for seekable sources instead of an
+        O(consumed) skip-replay."""
         # skip-check first so the donation-safe snapshot (copy) is only
         # paid on steps that actually write
         if not force:
@@ -381,7 +439,21 @@ class CheckpointManager:
                 f"checkpoint save of step {step} to {self._dir} failed "
                 f"after {self._retry.max_retries + 1} attempt(s)") from e
         if saved:
-            self._pending[step] = tree_digest(state)
+            if callable(loader_state):
+                # advisory, like its serialisation below: a loader whose
+                # state_dict() throws costs the O(1) resume, never the
+                # checkpoint that is already durably written
+                try:
+                    loader_state = loader_state()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        f"loader state_dict() failed for step {step} "
+                        f"({e!r}); resume will fall back to skip-replay")
+                    loader_state = None
+            self._pending[step] = {
+                "schema": state_schema(state),
+                "loader_state": loader_state,
+            }
         return saved
 
     def _commit_manifests(self) -> None:
@@ -404,12 +476,33 @@ class CheckpointManager:
                 f"(steps {sorted(pending)} stay unmarked)") from e
         if coord.process_count() > 1 and coord.process_index() != 0:
             return
-        for step, digest in sorted(pending.items()):
+        for step, meta in sorted(pending.items()):
             step_dir = os.path.join(self._dir, str(step))
             if not os.path.isdir(step_dir):
                 continue  # already rotated out by max_to_keep
+            schema = meta["schema"]
+            # loader state lands BEFORE the marker: a marked step either
+            # has its pipeline state or never had one, never a torn file.
+            # The write is advisory — a custom source whose state_dict()
+            # is not JSON-serialisable must cost the O(1) resume, never
+            # the commit markers of already-durable steps
+            if meta.get("loader_state") is not None:
+                try:
+                    ltmp = os.path.join(step_dir, LOADER_STATE + ".tmp")
+                    with open(ltmp, "w") as f:
+                        json.dump(meta["loader_state"], f,
+                                  default=_jsonable)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(ltmp, os.path.join(step_dir, LOADER_STATE))
+                except (TypeError, ValueError, OSError) as e:
+                    logger.warning(
+                        f"loader state for step {step} could not be "
+                        f"persisted ({e}); resume will fall back to "
+                        "skip-replay")
             manifest = {"format": _MANIFEST_FORMAT, "step": step,
-                        "time": time.time(), "tree": digest}
+                        "time": time.time(), "tree": schema["tree"],
+                        "schema": schema}
             tmp = os.path.join(step_dir, MANIFEST + ".tmp")
             with open(tmp, "w") as f:
                 json.dump(manifest, f)
@@ -454,6 +547,43 @@ class CheckpointManager:
             return max(legacy)
         return None
 
+    def read_loader_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """The data-pipeline state persisted with ``step`` (None when the
+        step predates durable loader state or was saved without one)."""
+        try:
+            with open(os.path.join(self._dir, str(step), LOADER_STATE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _check_schema(self, step: int, abstract_state: Any) -> None:
+        """Judge the saved-vs-current topology BEFORE orbax's
+        barrier-bearing restore: raises a typed
+        :class:`TopologyMismatchError`/:class:`StateSchemaError` with a
+        human-readable diff instead of an opaque orbax traceback.  A
+        permitted elastic change (dp/fsdp/host count, with
+        ``elastic_resume``) is logged + counted and marks the step for
+        the online-reshard fallback.  Steps without a recorded schema
+        (format-1 manifests) are waved through unchecked."""
+        manifest = self._read_manifest(step)
+        saved = (manifest or {}).get("schema")
+        if not saved:
+            return
+        current = state_schema(abstract_state)
+        verdict = check_compatibility(
+            saved, current, elastic=self._elastic,
+            where=f"checkpoint step {step} under {self._dir}")
+        if verdict == "elastic":
+            from torchacc_tpu.checkpoint.schema import changed_axes
+            from torchacc_tpu.utils.metrics import counters
+            counters.inc("elastic_reshards")
+            self._elastic_steps.add(step)
+            logger.warning(
+                f"elastic resume: checkpoint step {step} was saved under "
+                f"a different topology (axes "
+                f"{changed_axes(saved, current)}); resharding online "
+                "into the current mesh")
+
     # -- restore ------------------------------------------------------------
     def restore(self, abstract_state: Any, step: Optional[int] = None) -> Any:
         self._commit_manifests()
@@ -462,6 +592,7 @@ class CheckpointManager:
         if step is None:
             raise CheckpointNotFoundError(
                 f"no checkpoint found under {self._dir}")
+        self._check_schema(step, abstract_state)
 
         def _once():
             return self._restore_step_once(abstract_state, step)
@@ -488,11 +619,32 @@ class CheckpointManager:
         deadlock the pod)."""
         failpoint("checkpoint.restore", step=step)
         item_dir = os.path.join(self._dir, str(step), "default")
-        if os.path.isdir(item_dir):
-            return ocp.StandardCheckpointer().restore(
-                item_dir, abstract_state)
-        return self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+        try:
+            if os.path.isdir(item_dir):
+                return ocp.StandardCheckpointer().restore(
+                    item_dir, abstract_state)
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
+        except Exception as e:
+            if step not in self._elastic_steps:
+                raise
+            # online reshard (the in-place generalisation of the offline
+            # checkpoint/reshard.py restore+re-save): orbax rejected the
+            # direct cross-topology restore, so restore host-side and
+            # device_put each leaf into the CURRENT mesh's shardings.
+            # Single-host only by construction: multi-host elastic
+            # restores go through the consensus path, where a divergent
+            # fallback would wedge the pod (docs/resilience.md).
+            if coord.process_count() > 1:
+                raise
+            logger.warning(
+                f"elastic resume: direct restore of step {step} failed "
+                f"({e!r}); falling back to host-side reshard into the "
+                "current mesh")
+            src = item_dir if os.path.isdir(item_dir) \
+                else os.path.join(self._dir, str(step))
+            host = ocp.StandardCheckpointer().restore(src)
+            return _reshard_into(host, abstract_state)
 
     def validate_step(self, step: int,
                       abstract_state: Optional[Any] = None) -> bool:
@@ -534,26 +686,66 @@ class CheckpointManager:
             legacy = self.latest_step()  # logs the legacy-dir warning
             candidates = [legacy] if legacy is not None else []
         errors: List[str] = []
+        mismatched: List[int] = []
         for step in candidates:
             if not self.validate_step(step, abstract_state) \
                     and os.path.exists(self._manifest_path(step)):
                 errors.append(f"step {step}: structure mismatch")
+                mismatched.append(step)
                 continue
             try:
                 return self.restore(abstract_state, step=step), step
+            except (TopologyMismatchError, StateSchemaError):
+                # every retained step shares the run's topology — falling
+                # back a step cannot fix a mesh change; surface the diff
+                raise
             except CheckpointError as e:
                 cause = e.__cause__ or e
                 logger.warning(
                     f"checkpoint step {step} is unreadable ({cause!r}); "
                     "falling back to the previous step")
                 errors.append(f"step {step}: {cause!r}")
+                if step in self._elastic_steps:
+                    # a failed cross-topology restore is not corruption:
+                    # keep the step for offline reshard / same-topology
+                    # restore instead of quarantining healthy data
+                    continue
                 self._quarantine(step)
         if errors:
+            if len(mismatched) == len(errors):
+                # EVERY retained step carries the run's old state
+                # schema: the model changed, not the storage — surface
+                # the typed per-leaf diff (which resume='auto' will NOT
+                # swallow into a silent fresh start) instead of a
+                # corruption verdict
+                drift = self._schema_drift_error(max(mismatched),
+                                                 abstract_state)
+                if drift is not None:
+                    raise drift
             raise CheckpointCorruptionError(
                 f"no restorable checkpoint under {self._dir}: "
                 + "; ".join(errors))
         raise CheckpointNotFoundError(
             f"no checkpoint found under {self._dir}")
+
+    def _schema_drift_error(self, step: int,
+                            abstract_state: Any
+                            ) -> Optional[StateSchemaError]:
+        """A typed state-tree-drift error for ``step`` built from its
+        recorded schema, or None when the manifest predates schemas (or
+        the drift cannot be explained).  Deterministic given the shared
+        manifest + target state, so the multi-host path can raise it
+        identically on every host."""
+        from torchacc_tpu.checkpoint.schema import drift_error
+        saved = (self._read_manifest(step) or {}).get("schema")
+        if not saved:
+            return None
+        return drift_error(
+            saved, state_schema(abstract_state),
+            where=f"checkpoint step {step} under {self._dir}",
+            hint="(every older retained step shares this schema; "
+                 "intentional model change? point the run at a new "
+                 "checkpoint_dir)")
 
     def _newest_valid_step(self, abstract_state: Any,
                            ceiling: Optional[int]) -> int:
@@ -593,7 +785,7 @@ class CheckpointManager:
             item_dir = os.path.join(step_dir, "default")
             payload = item_dir if os.path.isdir(item_dir) else step_dir
             names = set(os.listdir(payload)) \
-                - {MANIFEST, "_CHECKPOINT_METADATA"}
+                - {MANIFEST, LOADER_STATE, "_CHECKPOINT_METADATA"}
             if not names:
                 return "payload missing"
             # known orbax layout markers (_METADATA / manifest.ocdbt /
@@ -656,12 +848,27 @@ class CheckpointManager:
                     bool(errors or self._mgr.all_steps()),
                     timeout_s=t, name="resume-empty")
                 if had_anything:
+                    if not errors:
+                        # nothing probed bad, yet no host could offer a
+                        # validated step: schema drift (all digests
+                        # mismatch).  Shared manifests + identical
+                        # target state make this deterministic pod-wide.
+                        marked = self.valid_steps()
+                        if marked:
+                            drift = self._schema_drift_error(
+                                max(marked), abstract_state)
+                            if drift is not None:
+                                raise drift
                     raise CheckpointCorruptionError(
                         f"no checkpoint step restorable on every host "
                         f"under {self._dir}"
                         + (f": {'; '.join(errors)}" if errors else ""))
                 raise CheckpointNotFoundError(
                     f"no checkpoint found under {self._dir} on any host")
+            # deterministic on every host (shared manifest, same target
+            # state): the pod raises the typed mismatch together, before
+            # any barrier-bearing restore is entered
+            self._check_schema(agreed, abstract_state)
             probe_err = self._probe_step(agreed)
             if coord.all_agree(probe_err is None, timeout_s=t,
                                name="resume-ok"):
@@ -681,6 +888,18 @@ class CheckpointManager:
                     return (self._restore_step_once(abstract_state,
                                                     agreed), agreed)
                 except Exception:
+                    if agreed in self._elastic_steps:
+                        # the step is not corrupt — the cross-topology
+                        # restore failed.  Quarantining it would let the
+                        # supervisor's crash-loop burn the whole retained
+                        # history; keep it for a same-topology restore or
+                        # an offline reshard instead.
+                        logger.error(
+                            f"elastic restore of step {agreed} failed on "
+                            "this pod; the step is kept (not quarantined) "
+                            "— reshard it offline or restore on the "
+                            "original topology")
+                        raise
                     self._quarantine(agreed)
                     raise
             if probe_err is not None:

@@ -26,12 +26,71 @@ from torchacc_tpu.utils.logger import logger
 
 
 def consolidate_checkpoint(src: str, dst: str) -> None:
-    """Merge a sharded checkpoint into a single consolidated one."""
-    state = restore_checkpoint(src)
-    state = jax.tree.map(np.asarray, state)
-    save_checkpoint(dst, state)
-    n = sum(x.size for x in jax.tree.leaves(state))
-    logger.info(f"consolidated {n/1e6:.1f}M elements: {src} -> {dst}")
+    """Merge a sharded checkpoint into a single consolidated one.
+
+    Multi-host, the work is primary-gated: only process 0 materialises
+    the full state in host RAM and writes ``dst`` — N hosts each paying
+    a state-sized ``np.asarray`` copy is an OOM hazard, and N racing
+    writers of one destination directory corrupt it.  The primary uses
+    an orbax checkpointer whose barriers span ONLY itself
+    (``active_processes={0}``): the default checkpointer's save/restore
+    are pod-wide collectives, and entering them on one host while the
+    peers sit at the consolidate barrier would deadlock the pod.
+    Non-primary hosts wait at that barrier so every process returns
+    with ``dst`` durable."""
+    import os
+
+    from torchacc_tpu.resilience import coordination as coord
+
+    from torchacc_tpu.errors import CheckpointError
+
+    multi = coord.process_count() > 1
+    if multi and coord.process_index() != 0:
+        # the rendezvous doubles as the verdict: a primary whose
+        # restore/save failed must not let the peers return as if dst
+        # were durable
+        if not coord.all_agree(True, name="consolidate"):
+            raise CheckpointError(
+                f"consolidate {src} -> {dst} failed on the primary host")
+        return
+    ok = False
+    try:
+        if multi:
+            import json
+
+            import orbax.checkpoint as ocp
+
+            from torchacc_tpu.checkpoint.io import _schema_sidecar
+            from torchacc_tpu.checkpoint.schema import state_schema
+
+            ckptr = ocp.Checkpointer(
+                ocp.StandardCheckpointHandler(),
+                multiprocessing_options=ocp.options.MultiprocessingOptions(
+                    primary_host=0, active_processes={0}))
+            try:
+                state = ckptr.restore(os.path.abspath(src))
+                state = jax.tree.map(np.asarray, state)
+                ckptr.save(os.path.abspath(dst), state)
+                with open(_schema_sidecar(os.path.abspath(dst)), "w") as f:
+                    json.dump(state_schema(state), f)
+            finally:
+                ckptr.close()
+        else:
+            state = restore_checkpoint(src)
+            state = jax.tree.map(np.asarray, state)
+            save_checkpoint(dst, state)
+        n = sum(x.size for x in jax.tree.leaves(state))
+        logger.info(f"consolidated {n/1e6:.1f}M elements: {src} -> {dst}")
+        ok = True
+    finally:
+        if multi:
+            try:
+                coord.all_agree(ok, name="consolidate")
+            except Exception:  # noqa: BLE001
+                if ok:
+                    raise
+                # the work already failed; the vote's own error (peers
+                # gone, timeout) must not mask the real cause
 
 
 def reshard_checkpoint(

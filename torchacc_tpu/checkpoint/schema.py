@@ -1,0 +1,232 @@
+"""Checkpoint schema manifests + topology-compatibility checks.
+
+PRs 1–2 made resume survive process death on the *identical* pod: a run
+rescheduled onto a different slice shape died inside orbax with an
+opaque shape/sharding error.  This module records, at save time, exactly
+what a restore needs to judge compatibility *before* entering orbax's
+barrier-bearing restore path:
+
+- the device mesh (axis names + sizes) the state was sharded over;
+- the JAX process count (hosts) that wrote it;
+- the pytree structure digest (leaf count + sha256 over sorted
+  ``path:shape:dtype`` lines — also what PR 1's ``_MANIFEST`` validated);
+- per-leaf shapes/dtypes (the ``inspect`` CLI and the human-readable
+  diff are built from these).
+
+On restore, :func:`check_compatibility` classifies the change:
+
+==========================  ===============================================
+change                      verdict
+==========================  ===============================================
+nothing                     ok
+dp / fsdp / process count   ok iff ``resilience.elastic_resume`` — these
+                            change the data layout only; global arrays
+                            reshard online into the new mesh
+tp / pp / sp / spu / ep     :class:`TopologyMismatchError`, always — these
+                            change the *program*, not just the layout
+leaf shapes/dtypes/paths    :class:`StateSchemaError` with a per-leaf diff
+==========================  ===============================================
+
+Both errors carry the human-readable diff so the operator sees *which*
+axes/leaves drifted without decoding an orbax traceback.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from jax.tree_util import tree_flatten_with_path
+
+from torchacc_tpu.errors import StateSchemaError, TopologyMismatchError
+
+SCHEMA_FORMAT = 1
+
+#: Axes whose extent may change between save and elastic restore: they
+#: partition the *data*, so a global-array checkpoint reshard s onto the
+#: new layout without changing the computation.
+ELASTIC_AXES: Tuple[str, ...] = ("dp", "fsdp")
+
+#: Axes that alter the program (parameter layout semantics, pipeline
+#: stages, sequence splits, expert placement) — never elastically
+#: resumable; use the offline reshard CLI deliberately instead.
+SENSITIVE_AXES: Tuple[str, ...] = ("tp", "pp", "sp", "spu", "ep")
+
+
+def _leaf_lines(tree: Any) -> List[str]:
+    leaves, _ = tree_flatten_with_path(tree)
+    return sorted(
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        + f":{tuple(getattr(x, 'shape', ()))}:{getattr(x, 'dtype', '?')}"
+        for path, x in leaves)
+
+
+def tree_digest(tree: Any) -> Dict[str, Any]:
+    """Structure summary of a state pytree: leaf count + sha256 over the
+    sorted ``path:shape:dtype`` lines.  Works on real arrays and on
+    ShapeDtypeStruct trees alike (None leaves are flattened out of both),
+    so a digest recorded at save time can be checked against a trainer's
+    abstract state before restoring."""
+    lines = _leaf_lines(tree)
+    h = hashlib.sha256("\n".join(lines).encode()).hexdigest()
+    return {"leaves": len(lines), "digest": h}
+
+
+def _leaf_specs(tree: Any) -> Dict[str, Dict[str, Any]]:
+    """``{path: {"shape": [...], "dtype": str}}`` for every leaf."""
+    leaves, _ = tree_flatten_with_path(tree)
+    out: Dict[str, Dict[str, Any]] = {}
+    for path, x in leaves:
+        p = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+        out[p] = {"shape": [int(s) for s in getattr(x, "shape", ())],
+                  "dtype": str(getattr(x, "dtype", "?"))}
+    return out
+
+
+def mesh_axes(tree: Any) -> Optional[Dict[str, int]]:
+    """Axis-name -> size of the first leaf carrying a NamedSharding
+    (SPMD state shares ONE mesh).  None when no leaf is mesh-sharded —
+    e.g. host/numpy trees or single-device arrays — in which case the
+    topology check is skipped (there is no topology to mismatch)."""
+    leaves, _ = tree_flatten_with_path(tree)
+    for _, x in leaves:
+        sh = getattr(x, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if shape:
+            return {str(k): int(v) for k, v in dict(shape).items()}
+    return None
+
+
+def state_schema(state: Any) -> Dict[str, Any]:
+    """The schema manifest recorded with every checkpoint: mesh
+    axes/sizes, process count, tree digest, per-leaf shape/dtype."""
+    from torchacc_tpu.resilience import coordination as coord
+
+    return {
+        "format": SCHEMA_FORMAT,
+        "mesh": mesh_axes(state),
+        "process_count": coord.process_count(),
+        "tree": tree_digest(state),
+        "leaf_specs": _leaf_specs(state),
+    }
+
+
+def schema_diff(saved: Dict[str, Any],
+                current: Dict[str, Any]) -> List[str]:
+    """Human-readable per-line diff between two schema manifests (mesh
+    axes, process count, then per-leaf shape/dtype drift)."""
+    out: List[str] = []
+    sm = saved.get("mesh") or {}
+    cm = current.get("mesh") or {}
+    for ax in sorted(set(sm) | set(cm)):
+        a, b = sm.get(ax, 1), cm.get(ax, 1)
+        if a != b:
+            out.append(f"mesh axis '{ax}': saved {a} -> current {b}")
+    sp = saved.get("process_count")
+    cp = current.get("process_count")
+    if sp is not None and cp is not None and sp != cp:
+        out.append(f"process count: saved {sp} -> current {cp}")
+    sl = saved.get("leaf_specs") or {}
+    cl = current.get("leaf_specs") or {}
+    for path in sorted(set(sl) - set(cl)):
+        out.append(f"leaf only in checkpoint: {path} "
+                   f"{tuple(sl[path]['shape'])}:{sl[path]['dtype']}")
+    for path in sorted(set(cl) - set(sl)):
+        out.append(f"leaf only in target: {path} "
+                   f"{tuple(cl[path]['shape'])}:{cl[path]['dtype']}")
+    for path in sorted(set(sl) & set(cl)):
+        a, b = sl[path], cl[path]
+        if a["shape"] != b["shape"] or a["dtype"] != b["dtype"]:
+            out.append(
+                f"leaf {path}: saved {tuple(a['shape'])}:{a['dtype']} -> "
+                f"target {tuple(b['shape'])}:{b['dtype']}")
+    return out
+
+
+def changed_axes(saved: Dict[str, Any],
+                 current: Dict[str, Any]) -> List[str]:
+    """Mesh axes whose extent differs (missing axes count as size 1);
+    a process-count change is reported as the pseudo-axis 'hosts'."""
+    sm = saved.get("mesh") or {}
+    cm = current.get("mesh") or {}
+    axes = [ax for ax in sorted(set(sm) | set(cm))
+            if sm.get(ax, 1) != cm.get(ax, 1)]
+    sp, cp = saved.get("process_count"), current.get("process_count")
+    if sp is not None and cp is not None and sp != cp:
+        axes.append("hosts")
+    return axes
+
+
+def tree_drift(saved: Dict[str, Any],
+               current: Dict[str, Any]) -> Optional[List[str]]:
+    """Per-leaf diff lines when the two schemas' state trees genuinely
+    drifted (digest or leaf count), else None — the ONE judgement both
+    the manager restore path and the standalone-restore error path
+    share."""
+    st, ct = saved.get("tree") or {}, current.get("tree") or {}
+    if not st.get("digest") or not ct.get("digest"):
+        return None
+    if st["digest"] == ct["digest"] and st.get("leaves") == ct.get("leaves"):
+        return None
+    diff = schema_diff(saved, current)
+    leaf_diff = [d for d in diff if d.startswith("leaf")]
+    return leaf_diff or diff
+
+
+def drift_error(saved: Dict[str, Any], current: Dict[str, Any],
+                *, where: str,
+                hint: str = "") -> Optional[StateSchemaError]:
+    """The ONE constructor for state-tree-drift errors: returns a
+    :class:`StateSchemaError` carrying the per-leaf diff when the trees
+    genuinely drifted, else None.  Every restore path (manager, resume
+    consensus, standalone sidecar) raises through here so the verdict
+    and its wording cannot diverge."""
+    drift = tree_drift(saved, current)
+    if drift is None:
+        return None
+    st, ct = saved.get("tree") or {}, current.get("tree") or {}
+    return StateSchemaError(
+        f"{where}: state-tree schema mismatch ({st.get('leaves')} saved "
+        f"leaves vs {ct.get('leaves')} target):\n  " + "\n  ".join(drift)
+        + (f"\n  {hint}" if hint else ""),
+        diff=drift)
+
+
+def check_compatibility(saved: Dict[str, Any], current: Dict[str, Any],
+                        *, elastic: bool = False,
+                        where: str = "checkpoint") -> str:
+    """Judge a restore before orbax sees it.
+
+    Returns ``"ok"`` (identical layout) or ``"elastic"`` (a data-axis /
+    host-count reshape that elastic resume will reshard online).
+    Raises :class:`StateSchemaError` on state-tree drift and
+    :class:`TopologyMismatchError` on a topology change that is not
+    (or not permitted to be) elastically resumable.
+    """
+    err = drift_error(saved, current, where=where)
+    if err is not None:
+        raise err
+    diff = schema_diff(saved, current)
+    if saved.get("mesh") is None or current.get("mesh") is None:
+        return "ok"  # no topology recorded on one side — nothing to judge
+    axes = changed_axes(saved, current)
+    if not axes:
+        return "ok"
+    bad = [ax for ax in axes if ax in SENSITIVE_AXES]
+    if bad:
+        raise TopologyMismatchError(
+            f"{where}: topology change on non-elastic axis(es) "
+            f"{bad} — tp/pp/sp/spu/ep reshapes change the program and "
+            f"cannot be resumed elastically (use the offline reshard "
+            f"CLI deliberately):\n  " + "\n  ".join(diff),
+            axes=bad, diff=diff)
+    if not elastic:
+        raise TopologyMismatchError(
+            f"{where}: topology changed on axis(es) {axes} and "
+            f"resilience.elastic_resume is off — set it to resume a "
+            f"run saved on a different data-parallel layout/host "
+            f"count:\n  " + "\n  ".join(diff),
+            axes=axes, diff=diff)
+    return "elastic"

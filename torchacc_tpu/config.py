@@ -390,6 +390,25 @@ class ResilienceConfig:
     # N-1 steps later).  Single-process runs check the local flag every
     # step regardless.
     preempt_sync_interval_steps: int = 1
+    # elastic resume (docs/resilience.md "Elastic resume"): allow
+    # fit(resume='auto') to restore a checkpoint saved under a DIFFERENT
+    # data-parallel layout / process count (the rescheduled-onto-a-
+    # different-slice-shape case) by resharding online into the current
+    # mesh.  tp/pp/sp/spu/ep changes are always rejected with a typed
+    # TopologyMismatchError — those change the program, not just the
+    # data layout.  Off (the default), ANY topology change is rejected
+    # with the schema diff instead of an opaque orbax error.
+    elastic_resume: bool = False
+    # validate every batch in the loader hot path (tree structure,
+    # shape/dtype drift vs the first batch, non-finite values); bad
+    # batches are skipped + counted (bad_batches_skipped), dumped to
+    # quarantine_dir, and after max_consecutive_bad_batches in a row a
+    # typed BadBatchError aborts the run (a broken source, not a blip)
+    batch_validation: bool = False
+    max_consecutive_bad_batches: int = 8
+    # where offending batches + provenance are dumped (None = skip the
+    # dump, still count/log)
+    quarantine_dir: Optional[str] = None
 
     def validate(self) -> None:
         _check(self.spike_zscore > 0,
@@ -426,6 +445,8 @@ class ResilienceConfig:
                "resilience.coord_timeout_s must be positive")
         _check(self.preempt_sync_interval_steps >= 1,
                "resilience.preempt_sync_interval_steps must be >= 1")
+        _check(self.max_consecutive_bad_batches >= 1,
+               "resilience.max_consecutive_bad_batches must be >= 1")
 
     def retry_policy(self, max_retries: int) -> Any:
         """The shared RetryPolicy view of the delay/deadline knobs."""

@@ -16,23 +16,45 @@ instead of killing the run — some sources misbehave precisely *because*
 they are driven from a side thread, so the fallback both simplifies the
 failure and often clears it.  Fatal failures raise a typed
 :class:`~torchacc_tpu.errors.DataLoaderError`.
+
+Durable pipeline state: ``state_dict()``/``load_state_dict()`` capture
+the consumer-side batch position (authoritative — the producer thread
+prefetches ahead of what training has actually consumed) plus the
+source's own state when it exposes the same protocol (PackedDataset
+does).  Resume is then O(1) for seekable sources; otherwise the loader
+falls back to the skip-replay path and counts the waste
+(``resume_replayed_batches``).
+
+Bad-batch quarantine (``resilience.batch_validation``): every fetched
+batch is validated in the hot path — tree structure and per-leaf
+shape/dtype against the first batch, plus non-finite scans of float
+leaves.  Offenders are skipped + counted (``bad_batches_skipped``) and
+dumped with provenance to ``resilience.quarantine_dir``; after
+``max_consecutive_bad_batches`` in a row a typed
+:class:`~torchacc_tpu.errors.BadBatchError` aborts the run — a broken
+*source*, not a blip.  ``ChaosPlan.corrupt_batch()`` injects offenders
+deterministically through the same seam.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterable, Iterator, Optional
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from torchacc_tpu.config import Config
 from torchacc_tpu.data.bucketing import pad_batch
-from torchacc_tpu.errors import DataLoaderError
+from torchacc_tpu.errors import BadBatchError, DataLoaderError
 from torchacc_tpu.parallel.sharding import batch_spec
-from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.resilience.chaos import failpoint, maybe_corrupt_batch
 from torchacc_tpu.resilience.retry import retry_call
 from torchacc_tpu.utils.logger import logger
 
@@ -48,12 +70,15 @@ class _Degrade:
     transfer failed — it must be retried by the consumer, not dropped.
     ``err`` is the producer's final exception: the consumer's first
     re-fetch seeds its truncation detector with it, so a generator
-    source that died does not read as a clean end-of-stream."""
+    source that died does not read as a clean end-of-stream.  ``idx``
+    is the source index of ``pending`` (or of the next fetch), so the
+    corruption/validation seams stay aligned across the handoff."""
 
-    def __init__(self, it: Iterator, pending=None, err=None):
+    def __init__(self, it: Iterator, pending=None, err=None, idx: int = 0):
         self.it = it
         self.pending = pending
         self.err = err
+        self.idx = idx
 
 
 class AsyncLoader:
@@ -70,6 +95,7 @@ class AsyncLoader:
         mesh: Optional[Mesh] = None,
         sharding: Optional[NamedSharding] = None,
         stall_dump_dir: Optional[str] = None,
+        quarantine_dir: Optional[str] = None,
     ):
         self._loader = loader
         self._config = config
@@ -99,6 +125,47 @@ class AsyncLoader:
         # watchdog's dumps; None = stderr)
         self._stall_dump_dir = stall_dump_dir
         self._rank_shardings: Dict[int, NamedSharding] = {}
+        # bad-batch quarantine (resilience subsystem): validation is
+        # opt-in — the non-finite scan touches every float element
+        self._validate_on = res.batch_validation
+        self._max_bad = res.max_consecutive_bad_batches
+        self._quarantine_dir = quarantine_dir or res.quarantine_dir
+        self._ref_spec: Optional[Dict[str, Any]] = None
+        self._ref_confirmed = 0  # batches that matched the reference
+        self._bad_streak = 0
+        # durable pipeline state: consumer-side batches delivered to the
+        # training loop (the producer prefetches AHEAD of this), plus
+        # the SOURCE position backing the last delivered batch — the two
+        # diverge when bad batches are quarantined (skipped batches
+        # consume source positions without being delivered), and resume
+        # must seek the source, not the delivery count
+        self._consumed = 0
+        self._src_pos = 0
+        self._resume_state: Optional[Dict[str, Any]] = None
+
+    # -- durable state -------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-serialisable resume state.  ``batches_consumed`` is the
+        CONSUMER-side count (batches the training loop actually
+        received — the producer prefetches ahead of it);
+        ``source_position`` is the source index resume must seek to
+        (>= batches_consumed once quarantine skipped offenders).  The
+        wrapped source's own ``state_dict()`` rides along when it
+        exposes one, its producer-side count overridden on restore."""
+        src_fn = getattr(self._loader, "state_dict", None)
+        return {
+            "version": 1,
+            "kind": "async_loader",
+            "batches_consumed": self._consumed,
+            "source_position": self._src_pos,
+            "source": src_fn() if callable(src_fn) else None,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Arm the NEXT iteration to resume at the saved position: O(1)
+        via the source's own ``load_state_dict`` when available, else a
+        logged + counted skip-replay of the consumed prefix."""
+        self._resume_state = dict(state)
 
     # -- fault-wrapped primitives -------------------------------------------
     def _fetch(self, it: Iterator, prior_err=None):
@@ -171,7 +238,126 @@ class AsyncLoader:
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
         return self._iterate(skip=0)
 
+    # -- batch validation / quarantine ---------------------------------------
+    def _check_batch(self, batch: Any) -> Optional[str]:
+        """Reason the batch is bad, or None.  Structure and per-leaf
+        shape/dtype are judged against the FIRST batch seen (which can
+        only be vetted for non-finite values — there is nothing earlier
+        to compare it to); float leaves are scanned for non-finites."""
+        if not isinstance(batch, dict):
+            return f"batch is {type(batch).__name__}, expected dict"
+        spec = {}
+        for k, v in batch.items():
+            arr_dtype = getattr(v, "dtype", None)
+            spec[k] = (tuple(getattr(v, "shape", np.shape(v))),
+                       str(arr_dtype if arr_dtype is not None
+                           else np.asarray(v).dtype))
+        ref = self._ref_spec
+        if ref is not None:
+            if set(spec) != set(ref):
+                return ("tree structure drift (missing "
+                        f"{sorted(set(ref) - set(spec))}, extra "
+                        f"{sorted(set(spec) - set(ref))})")
+            for k in spec:
+                if spec[k][0] != ref[k][0]:
+                    return (f"leaf {k!r}: shape {spec[k][0]} != expected "
+                            f"{ref[k][0]}")
+                if spec[k][1] != ref[k][1]:
+                    return (f"leaf {k!r}: dtype {spec[k][1]} != expected "
+                            f"{ref[k][1]}")
+            # this batch agrees with the reference — the reference is
+            # corroborated (see the BadBatchError hint below)
+            self._ref_confirmed += 1
+        for k, v in batch.items():
+            arr = np.asarray(v)
+            if np.issubdtype(arr.dtype, np.floating) \
+                    and not np.isfinite(arr).all():
+                return f"leaf {k!r}: non-finite values"
+        if ref is None:
+            self._ref_spec = spec
+        return None
+
+    def _on_bad_batch(self, batch: Any, index: int, reason: str) -> None:
+        """Count + dump + (past the consecutive limit) abort typed."""
+        from torchacc_tpu.utils.metrics import counters
+
+        self._bad_streak += 1
+        counters.inc("bad_batches_skipped")
+        dump = self._dump_quarantine(batch, index, reason)
+        logger.warning(
+            f"bad batch {index} skipped ({reason}); consecutive "
+            f"{self._bad_streak}/{self._max_bad}"
+            + (f"; quarantined to {dump}" if dump else ""))
+        if self._bad_streak >= self._max_bad:
+            # shape/dtype drift is judged against the FIRST batch; when
+            # nothing else ever matched it, the reference itself may be
+            # the outlier — tell the operator (deciding automatically is
+            # impossible: K consistent corrupt batches and a corrupt
+            # first batch are symmetric)
+            hint = ("" if self._ref_confirmed or "non-finite" in reason
+                    else " (note: the first batch — the validation "
+                         "reference — was never matched by any other "
+                         "batch and may itself be the corrupt one)")
+            raise BadBatchError(
+                f"{self._bad_streak} consecutive batches failed "
+                f"validation (last: batch {index}: {reason}) — the "
+                f"source is broken, not one batch{hint}",
+                index=index, reason=reason, consecutive=self._bad_streak)
+
+    def _dump_quarantine(self, batch: Any, index: int,
+                         reason: str) -> Optional[str]:
+        """Offending batch + provenance into ``quarantine_dir`` (best
+        effort — evidence must never crash the run it documents)."""
+        if not self._quarantine_dir:
+            return None
+        try:
+            os.makedirs(self._quarantine_dir, exist_ok=True)
+            stem = os.path.join(self._quarantine_dir, f"batch_{index:08d}")
+            arrays = ({str(k): np.asarray(v) for k, v in batch.items()}
+                      if isinstance(batch, dict) else {})
+            np.savez(stem + ".npz", **arrays)
+            prov = {"index": index, "reason": reason, "time": time.time(),
+                    "keys": sorted(arrays),
+                    "source": type(self._loader).__name__}
+            with open(stem + ".json", "w") as f:
+                json.dump(prov, f)
+            return stem + ".npz"
+        except Exception as e:  # noqa: BLE001 - evidence is best-effort
+            logger.warning(f"could not dump quarantined batch {index}: {e}")
+            return None
+
     def _iterate(self, skip: int) -> Iterator[Dict[str, jax.Array]]:
+        resume, self._resume_state = self._resume_state, None
+        if resume is not None:
+            n = int(resume.get("batches_consumed", 0))
+            # seek target: the source index AFTER the last delivered
+            # batch (quarantined batches consumed source positions the
+            # delivery count never saw); pre-quarantine states carry
+            # only batches_consumed, where the two were equal
+            spos = int(resume.get("source_position", n))
+            src_state = resume.get("source")
+            load_fn = getattr(self._loader, "load_state_dict", None)
+            if src_state is not None and callable(load_fn):
+                # O(1) path: the source repositions itself (seekable),
+                # or replays + counts internally (non-seekable).  The
+                # consumer-side position overrides the producer-side
+                # one recorded in the source state (prefetch skew).
+                src_state = dict(src_state)
+                src_state["batches_consumed"] = spos
+                load_fn(src_state)
+            elif spos:
+                from torchacc_tpu.utils.metrics import counters
+                counters.inc("resume_replayed_batches", spos)
+                logger.warning(
+                    f"resume: source exposes no durable state — "
+                    f"replaying {spos} consumed batches to realign the "
+                    "stream")
+                skip += spos
+            self._consumed = n
+            self._src_pos = spos
+        else:
+            self._consumed = skip
+            self._src_pos = skip
         q: queue.Queue = queue.Queue(maxsize=self._prefetch)
         err: list = []
         stop = threading.Event()
@@ -189,10 +375,15 @@ class AsyncLoader:
             return False
 
         it = iter(self._loader)
+        # first SOURCE index the producer will deliver: skipped batches
+        # occupy the indices before it (plain skip path), or the source
+        # was repositioned there (durable-state path)
+        base_idx = self._src_pos
 
         def produce():
             pending = None
             skipping = False
+            idx = base_idx
             try:
                 skipping = True
                 for _ in range(skip):
@@ -205,16 +396,30 @@ class AsyncLoader:
                     pending = self._fetch(it)
                     if pending is _EXHAUSTED:
                         break
+                    pending = maybe_corrupt_batch(pending, idx)
+                    if self._validate_on:
+                        reason = self._check_batch(pending)
+                        if reason is not None:
+                            bad, pending = pending, None
+                            idx += 1
+                            self._on_bad_batch(bad, idx - 1, reason)
+                            continue
+                        self._bad_streak = 0
                     dev = self._transfer(pending)
                     pending = None
-                    if not _put(dev):
+                    idx += 1
+                    # the source position AFTER this batch rides along:
+                    # the consumer records it per delivery, so a saved
+                    # state seeks past quarantined (skipped) offenders
+                    if not _put((dev, idx)):
                         return
             except Exception as e:
                 # no degrade for (a) failures while replaying the resume
                 # prefix — that would silently misalign the data stream
                 # against the restored step count — or (b) typed fatal
                 # errors (a dead generator source cannot be resumed from
-                # the consumer thread either)
+                # the consumer thread either; BadBatchError is a verdict
+                # on the source, not on this thread)
                 if self._sync_fallback and not skipping \
                         and not isinstance(e, DataLoaderError):
                     # hand the iterator (and any batch whose transfer
@@ -230,7 +435,8 @@ class AsyncLoader:
                     # for FETCH failures; after a transfer failure the
                     # iterator itself is healthy
                     _put(_Degrade(it, pending,
-                                  None if pending is not None else e))
+                                  None if pending is not None else e,
+                                  idx))
                     return
                 err.append(e)
                 logger.error(f"AsyncLoader producer failed: {e}")
@@ -244,15 +450,20 @@ class AsyncLoader:
                 item = self._get_with_stall_deadline(q)
                 if item is _SENTINEL:
                     if err:
+                        if isinstance(err[0], BadBatchError):
+                            raise err[0]  # typed verdict, not I/O failure
                         raise DataLoaderError(
                             "input pipeline failed (batch fetch/transfer "
                             "retries exhausted)") from err[0]
                     return
                 if isinstance(item, _Degrade):
                     yield from self._iterate_sync(item.it, item.pending,
-                                                  item.err)
+                                                  item.err, item.idx)
                     return
-                yield item
+                dev, pos = item
+                self._consumed += 1
+                self._src_pos = pos
+                yield dev
         finally:
             stop.set()
             # drain the queue so a producer blocked in _put can observe
@@ -292,22 +503,39 @@ class AsyncLoader:
                                abort=self._abort_on_hang)
                     tripped = True
 
-    def _iterate_sync(self, it: Iterator, pending=None,
-                      prior_err=None) -> Iterator[Dict[str, jax.Array]]:
+    def _iterate_sync(self, it: Iterator, pending=None, prior_err=None,
+                      idx: int = 0) -> Iterator[Dict[str, jax.Array]]:
         """Degraded mode: fetch + transfer inline on the consumer thread
         (no prefetch overlap); errors here are fatal and typed.
         ``pending`` is a batch the producer fetched but failed to
-        transfer — it goes first so nothing is dropped."""
+        transfer — it goes first (already corrupted/validated by the
+        producer) so nothing is dropped or double-checked."""
         while True:
             try:
-                batch = pending if pending is not None \
-                    else self._fetch(it, prior_err)
+                handed = pending is not None
+                batch = pending if handed else self._fetch(it, prior_err)
                 pending = prior_err = None
                 if batch is _EXHAUSTED:
                     return
-                yield self._transfer(batch)
+                if not handed:
+                    batch = maybe_corrupt_batch(batch, idx)
+                    if self._validate_on:
+                        reason = self._check_batch(batch)
+                        if reason is not None:
+                            bad = batch
+                            idx += 1
+                            self._on_bad_batch(bad, idx - 1, reason)
+                            continue
+                        self._bad_streak = 0
+                dev = self._transfer(batch)
+                idx += 1
+                self._consumed += 1
+                self._src_pos = idx
+                yield dev
             except StopIteration:  # pragma: no cover - defensive
                 return
+            except BadBatchError:
+                raise  # typed verdict on the source — never re-wrapped
             except Exception as e:
                 raise DataLoaderError(
                     "input pipeline failed in synchronous-fallback mode"

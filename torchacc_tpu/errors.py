@@ -42,6 +42,33 @@ class CheckpointCorruptionError(CheckpointError):
     unreadable array payload)."""
 
 
+class TopologyMismatchError(CheckpointError):
+    """The checkpoint was saved under a different mesh/process topology
+    than the one restoring it, and the change is not one elastic resume
+    supports (tp/pp/sp/spu/ep reshapes, or a data-parallel reshape with
+    ``resilience.elastic_resume`` off).
+
+    Carries the list of differing axes and the human-readable schema
+    diff so the operator sees *which* axes changed without decoding an
+    orbax traceback."""
+
+    def __init__(self, message: str, *, axes: Optional[list] = None,
+                 diff: Optional[list] = None):
+        super().__init__(message)
+        self.axes = list(axes or [])
+        self.diff = list(diff or [])
+
+
+class StateSchemaError(CheckpointError):
+    """The checkpoint's state-tree schema (leaf paths, shapes, dtypes)
+    does not match the target state.  Carries a human-readable diff —
+    the typed replacement for orbax's structure-mismatch traceback."""
+
+    def __init__(self, message: str, *, diff: Optional[list] = None):
+        super().__init__(message)
+        self.diff = list(diff or [])
+
+
 class TrainerStateError(TorchAccTPUError):
     """The Trainer was driven in an invalid order (e.g. ``save()`` before
     ``init()``/``step()``)."""
@@ -50,6 +77,22 @@ class TrainerStateError(TorchAccTPUError):
 class DataLoaderError(TorchAccTPUError):
     """The input pipeline failed fatally (batch fetch retries exhausted
     with synchronous fallback disabled or also failing)."""
+
+
+class BadBatchError(DataLoaderError):
+    """Too many consecutive batches failed validation (tree structure,
+    shape/dtype drift, non-finite values) — the *source* is broken, not
+    one batch.  Individual offenders are skipped, counted
+    (``bad_batches_skipped``) and dumped to the quarantine directory;
+    this error fires only after ``max_consecutive_bad_batches`` in a
+    row.  Carries the last offender's index and reason."""
+
+    def __init__(self, message: str, *, index: Optional[int] = None,
+                 reason: Optional[str] = None, consecutive: int = 0):
+        super().__init__(message)
+        self.index = index
+        self.reason = reason
+        self.consecutive = consecutive
 
 
 class CoordinationError(TorchAccTPUError):

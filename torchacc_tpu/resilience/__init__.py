@@ -17,6 +17,7 @@ from torchacc_tpu.resilience.chaos import (
     ChaosPlan,
     chaos_loss,
     failpoint,
+    maybe_corrupt_batch,
 )
 from torchacc_tpu.resilience.coordination import (
     all_agree,
@@ -42,6 +43,7 @@ __all__ = [
     "ChaosPlan",
     "chaos_loss",
     "failpoint",
+    "maybe_corrupt_batch",
     "GuardMonitor",
     "guard_apply",
     "guard_init",

@@ -44,6 +44,15 @@ def failpoint(name: str, **ctx: Any) -> None:
         plan.hit(name, ctx)
 
 
+def maybe_corrupt_batch(batch: Any, index: int) -> Any:
+    """Loader hot-path seam for :meth:`ChaosPlan.corrupt_batch`; costs
+    one global ``is None`` check when no plan is active."""
+    plan = _active
+    if plan is None:
+        return batch
+    return plan.corrupt(batch, index)
+
+
 @dataclass
 class _Rule:
     times: int = 0                 # inject on the first `times` hits ...
@@ -69,6 +78,7 @@ class ChaosPlan:
     seed: int = 0
     _rules: Dict[str, _Rule] = field(default_factory=dict)
     _rng: random.Random = field(default=None, repr=False)  # type: ignore
+    _corrupt: Optional[Dict[str, Any]] = field(default=None, repr=False)
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
@@ -109,9 +119,83 @@ class ChaosPlan:
             raise rule.exc(f"chaos-injected fault at {point} "
                            f"(#{rule.raised}, ctx={ctx})")
 
+    def corrupt_batch(self, *, at: Iterable[int] = (), times: int = 0,
+                      mode: str = "nonfinite",
+                      key: Optional[str] = None) -> "ChaosPlan":
+        """Corrupt loader batches in place of raising: the bad-batch
+        quarantine seam (``AsyncLoader`` with
+        ``resilience.batch_validation``) sees a batch that LOOKS fetched
+        but is broken — exactly what a flaky storage backend or a
+        corrupted shard produces.
+
+        ``at`` corrupts those 0-based source-batch indices; without
+        ``at``, the first ``times`` batches are corrupted.  ``mode``:
+
+        - ``'nonfinite'``: poison the first float leaf (or ``key``)
+          with NaN, keeping shape/dtype;
+        - ``'shape'``: drop the leading row of one leaf;
+        - ``'dtype'``: cast one leaf to a different dtype;
+        - ``'drop_key'``: remove one key from the batch dict.
+        """
+        if mode not in ("nonfinite", "shape", "dtype", "drop_key"):
+            raise ValueError(f"unknown corrupt_batch mode {mode!r}")
+        self._corrupt = {"at": {int(i) for i in at}, "times": times,
+                         "mode": mode, "key": key, "hits": 0,
+                         "injected": 0}
+        return self
+
+    def corrupt(self, batch: Any, index: int) -> Any:
+        """Apply the corrupt_batch rule to ``batch`` (source index
+        ``index``); returns the batch unchanged when no rule matches."""
+        import numpy as np
+        rule = self._corrupt
+        if rule is None or not isinstance(batch, dict) or not batch:
+            return batch
+        rule["hits"] += 1
+        if rule["at"]:
+            inject = index in rule["at"]
+        else:
+            inject = rule["injected"] < rule["times"]
+        if not inject:
+            return batch
+        rule["injected"] += 1
+        mode = rule["mode"]
+        out = dict(batch)
+        key = rule["key"]
+        if key is None:
+            if mode == "nonfinite":
+                key = next((k for k, v in out.items()
+                            if np.issubdtype(np.asarray(v).dtype,
+                                             np.floating)),
+                           next(iter(out)))
+            else:
+                key = next(iter(out))
+        logger.warning(f"chaos: corrupting batch {index} "
+                       f"(mode={mode}, key={key!r})")
+        if mode == "drop_key":
+            out.pop(key, None)
+            return out
+        v = np.asarray(out[key])
+        if mode == "nonfinite":
+            if np.issubdtype(v.dtype, np.floating):
+                v = v.copy()
+                v.reshape(-1)[0] = np.nan
+            else:  # no float leaf: a NaN float replacement is still bad
+                v = np.full(v.shape, np.nan, np.float32)
+        elif mode == "shape":
+            v = v[1:] if v.shape and v.shape[0] > 1 else np.expand_dims(v, 0)
+        elif mode == "dtype":
+            v = v.astype(np.float16 if v.dtype != np.float16 else np.int32)
+        out[key] = v
+        return out
+
     def stats(self) -> Dict[str, Dict[str, int]]:
-        return {p: {"hits": r.hits, "raised": r.raised}
-                for p, r in self._rules.items()}
+        out = {p: {"hits": r.hits, "raised": r.raised}
+               for p, r in self._rules.items()}
+        if self._corrupt is not None:
+            out["batch.corrupt"] = {"hits": self._corrupt["hits"],
+                                    "raised": self._corrupt["injected"]}
+        return out
 
     def __enter__(self) -> "ChaosPlan":
         global _active

@@ -649,7 +649,14 @@ class Trainer:
             mgr = CheckpointManager(
                 checkpoint_dir, save_interval_steps=checkpoint_every,
                 retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries),
-                coord_timeout_s=res_cfg.coord_timeout_s)
+                coord_timeout_s=res_cfg.coord_timeout_s,
+                elastic_resume=res_cfg.elastic_resume)
+        # durable data-pipeline state (docs/resilience.md "Elastic
+        # resume"): persisted with every checkpoint when the loader
+        # exposes it, restored in place of the O(consumed) skip-replay
+        loader_state_fn = getattr(loader, "state_dict", None)
+        loader_load_fn = getattr(loader, "load_state_dict", None)
+        resumed_loader_state = None
         start_step = 0
         if resume is not None:
             if resume != "auto":
@@ -678,10 +685,14 @@ class Trainer:
             else:
                 self.state = self._adopt_restored(state)
                 counters.inc("resumes")
+                if loader_load_fn is not None:
+                    resumed_loader_state = mgr.read_loader_state(start_step)
                 logger.info(
                     f"resume='auto': restored step {start_step} from "
-                    f"{checkpoint_dir}; skipping {start_step} consumed "
-                    "batches")
+                    f"{checkpoint_dir}; "
+                    + ("restoring durable loader state"
+                       if resumed_loader_state is not None else
+                       f"skipping {start_step} consumed batches"))
         preempt_on = mgr is not None and res_cfg.emergency_checkpoint
         if preempt_on:
             from torchacc_tpu.resilience.coordination import (
@@ -729,14 +740,34 @@ class Trainer:
         t_prev, s_prev = t0, start_step
         import itertools
         skip_fn = getattr(loader, "skip_batches", None)
-        if start_step and skip_fn is not None:
-            # skip the consumed prefix at the source (AsyncLoader: no
-            # pad/device-transfer for skipped batches)
+        if start_step and resumed_loader_state is not None:
+            # O(1) resume: the loader repositions itself from its
+            # durable state (seekable sources seek; non-seekable ones
+            # replay internally and count resume_replayed_batches)
+            loader_load_fn(resumed_loader_state)
+            data_it = iter(loader)
+            bounded = (data_it if max_steps is None else
+                       itertools.islice(data_it,
+                                        max(max_steps - start_step, 0)))
+        elif start_step and skip_fn is not None:
+            # skip-replay fallback: no durable loader state with this
+            # checkpoint — fast-forward the consumed prefix at the
+            # source (AsyncLoader: no pad/device-transfer for skipped
+            # batches), O(consumed) host iteration
+            counters.inc("resume_replayed_batches", start_step)
+            logger.warning(
+                f"resume='auto': no durable loader state at step "
+                f"{start_step} — replaying {start_step} consumed "
+                "batches (skip-replay)")
             data_it = skip_fn(start_step)
             bounded = (data_it if max_steps is None else
                        itertools.islice(data_it,
                                         max(max_steps - start_step, 0)))
         else:
+            if start_step:
+                # no durable state and no skip support: islice replays
+                # (and discards) the consumed prefix the slow way
+                counters.inc("resume_replayed_batches", start_step)
             data_it = iter(loader)
             bounded = (itertools.islice(data_it, start_step, max_steps)
                        if (max_steps is not None or start_step) else data_it)
@@ -805,8 +836,10 @@ class Trainer:
                 saved = False
                 if mgr is not None:
                     # label = completed-step count == state.step after
-                    # this step
-                    saved = mgr.save(step_idx + 1, self.state)
+                    # this step; the loader's durable state rides along
+                    # (callable: only materialised on steps that write)
+                    saved = mgr.save(step_idx + 1, self.state,
+                                     loader_state=loader_state_fn)
                 # cross-host sync point: the emergency save triggers on
                 # EVERY host at this same boundary when ANY host saw the
                 # signal (exact local-flag check in single-process runs).
@@ -824,7 +857,8 @@ class Trainer:
                     # return cleanly — the grace window is for saving,
                     # not for more steps
                     if not saved:
-                        mgr.save(step_idx + 1, self.state, force=True)
+                        mgr.save(step_idx + 1, self.state, force=True,
+                                 loader_state=loader_state_fn)
                     mgr.wait_until_finished()
                     counters.inc("preemptions")
                     counters.inc("emergency_saves")
