@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -32,7 +32,8 @@ chaos:
 	for s in 0 1 2; do \
 		echo "== chaos seed $$s =="; \
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
-			tests/test_watchdog.py tests/test_elastic.py -m "not slow" \
+			tests/test_watchdog.py tests/test_elastic.py \
+			tests/test_sdc.py -m "not slow" \
 			-q || exit 1; \
 	done
 
@@ -53,6 +54,17 @@ chaos-elastic:
 			-m "not slow" -q || exit 1; \
 	done
 	JAX_PLATFORMS=cpu $(PYTEST) tests/test_elastic.py -m "elastic and slow" -q
+
+# SDC-defense proof: bit-flip chaos (cross-replica localization,
+# recompute spot checks, deterministic replay) under 3 seeds, then the
+# 2-process DP=2 fixture where a flip on host 1 is localized to host 1
+chaos-sdc:
+	for s in 0 1 2; do \
+		echo "== chaos-sdc seed $$s =="; \
+		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_sdc.py \
+			-m "not slow" -q || exit 1; \
+	done
+	JAX_PLATFORMS=cpu $(PYTEST) tests/test_sdc.py -m "sdc and slow" -q
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

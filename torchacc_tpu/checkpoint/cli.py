@@ -14,6 +14,17 @@ Operator additions for elastic resume (docs/resilience.md):
 - ``--dry-run``: for consolidate/reshard, print what would be read and
   written (and the schema diff against the target layout) without
   touching anything.
+
+SDC triage (docs/resilience.md "SDC defense"):
+
+- ``replay``: print the per-leaf content digests (order-independent
+  XOR fold + wraparound sum of the raw bits, plus a value sum) of a
+  committed checkpoint step, so two copies of the same step — on two
+  pods, or before/after a transfer — can be diffed leaf-by-leaf
+  offline.  The full in-situ step replay (re-executing the training
+  step and printing the *gradient* digests) is
+  ``Trainer.fit(replay_step=N)``, which needs the model; this command
+  needs only the checkpoint.
 """
 
 from __future__ import annotations
@@ -123,8 +134,80 @@ def _cmd_inspect(args) -> int:
     return 0
 
 
+def _cmd_replay(args) -> int:
+    from torchacc_tpu.checkpoint.io import MANIFEST
+
+    d = args.ckpt_dir
+    if not os.path.isdir(d):
+        print(f"error: {d} is not a directory", file=sys.stderr)
+        return 2
+    step = args.step
+    if step is None:
+        # manager dir: newest marked step; else digest the dir itself
+        marked = sorted(
+            int(n) for n in os.listdir(d)
+            if n.isdigit() and os.path.exists(os.path.join(d, n, MANIFEST)))
+        if marked:
+            step = marked[-1]
+    if step is not None:
+        step_dir = os.path.join(d, str(step))
+        if not os.path.isdir(step_dir):
+            print(f"error: no step {step} under {d}", file=sys.stderr)
+            return 2
+        item = os.path.join(step_dir, "default")
+        d = item if os.path.isdir(item) else step_dir
+    import jax
+    import orbax.checkpoint as ocp
+
+    from torchacc_tpu.resilience.sdc import host_digests
+
+    try:
+        ckptr = ocp.StandardCheckpointer()
+        # restore via a sharding-free abstract tree from the metadata:
+        # digesting must work on ANY machine (that is the point of the
+        # tool), not just one with the writing pod's device count
+        meta = ckptr.metadata(os.path.abspath(d))
+        meta = getattr(meta, "item_metadata", meta)
+        dev = jax.sharding.SingleDeviceSharding(jax.devices("cpu")[0])
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                           sharding=dev), meta)
+        tree = ckptr.restore(os.path.abspath(d), abstract)
+    except Exception as e:  # noqa: BLE001 - operator-facing tool
+        print(f"error: cannot restore {d}: {e!r}", file=sys.stderr)
+        return 2
+    digs = host_digests(tree)
+    if args.json:
+        json.dump({"path": os.path.abspath(d), "step": step,
+                   "digests": digs}, sys.stdout, indent=1)
+        print()
+        return 0
+    label = f"{args.ckpt_dir}" + (f" step {step}" if step is not None else "")
+    print(f"digests of {label} ({len(digs)} leaves):")
+    for path in sorted(digs):
+        s = digs[path]
+        print(f"  {path}: xor={s['bits_xor']} sum={s['bits_sum']} "
+              f"value_sum={s['f32_sum']:.6g} "
+              f"{tuple(s['shape'])} {s['dtype']}")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "replay":
+        p = argparse.ArgumentParser(
+            prog="consolidate_and_reshard_ckpts replay",
+            description="Print per-leaf content digests of a committed "
+                        "checkpoint step (offline SDC triage; compare "
+                        "two copies leaf-by-leaf).")
+        p.add_argument("ckpt_dir",
+                       help="checkpoint (or manager) directory")
+        p.add_argument("--step", type=int, default=None,
+                       help="manager step to digest (default: newest "
+                            "marked step)")
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable output for diffing")
+        return _cmd_replay(p.parse_args(argv[1:]))
     if argv and argv[0] == "inspect":
         p = argparse.ArgumentParser(
             prog="consolidate_and_reshard_ckpts inspect",

@@ -49,6 +49,9 @@ _MANIFEST_FORMAT = 2
 #: Durable data-pipeline state (loader.state_dict()) persisted next to
 #: the step's payload; written by the primary, before the marker.
 LOADER_STATE = "loader_state.json"
+#: StepGuard EW statistics (resilience/guard.py) persisted the same
+#: advisory way, so the spike guard does not re-warm after resume.
+GUARD_STATE = "guard_state.json"
 
 
 def _jsonable(o: Any):
@@ -392,13 +395,17 @@ class CheckpointManager:
 
     # -- save ---------------------------------------------------------------
     def save(self, step: int, state: Any, *, force: bool = False,
-             loader_state: Optional[Dict[str, Any]] = None) -> bool:
+             loader_state: Optional[Dict[str, Any]] = None,
+             guard_state: Optional[Dict[str, Any]] = None) -> bool:
         """Save ``state`` under ``step``.  ``loader_state`` (a loader's
         ``state_dict()``, or a zero-arg callable returning one — invoked
         only on steps that actually write) is persisted as
         ``loader_state.json`` in the step directory when the step
         commits, making resume O(1) for seekable sources instead of an
-        O(consumed) skip-replay."""
+        O(consumed) skip-replay.  ``guard_state`` (dict or zero-arg
+        callable) rides the same way as ``guard_state.json`` — the
+        StepGuard's EW statistics, restored by ``fit(resume='auto')``
+        so the spike guard does not re-warm."""
         # skip-check first so the donation-safe snapshot (copy) is only
         # paid on steps that actually write
         if not force:
@@ -450,9 +457,18 @@ class CheckpointManager:
                         f"loader state_dict() failed for step {step} "
                         f"({e!r}); resume will fall back to skip-replay")
                     loader_state = None
+            if callable(guard_state):
+                try:
+                    guard_state = guard_state()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        f"guard state export failed for step {step} "
+                        f"({e!r}); statistics will re-warm on resume")
+                    guard_state = None
             self._pending[step] = {
                 "schema": state_schema(state),
                 "loader_state": loader_state,
+                "guard_state": guard_state,
             }
         return saved
 
@@ -481,25 +497,30 @@ class CheckpointManager:
             if not os.path.isdir(step_dir):
                 continue  # already rotated out by max_to_keep
             schema = meta["schema"]
-            # loader state lands BEFORE the marker: a marked step either
-            # has its pipeline state or never had one, never a torn file.
-            # The write is advisory — a custom source whose state_dict()
-            # is not JSON-serialisable must cost the O(1) resume, never
-            # the commit markers of already-durable steps
-            if meta.get("loader_state") is not None:
+            # loader/guard state land BEFORE the marker: a marked step
+            # either has its sidecar state or never had one, never a
+            # torn file.  The writes are advisory — a state that is not
+            # JSON-serialisable must cost the O(1) resume (or a guard
+            # re-warm), never the commit markers of already-durable
+            # steps
+            for key, fname, miss in (
+                    ("loader_state", LOADER_STATE,
+                     "resume will fall back to skip-replay"),
+                    ("guard_state", GUARD_STATE,
+                     "guard statistics will re-warm on resume")):
+                if meta.get(key) is None:
+                    continue
                 try:
-                    ltmp = os.path.join(step_dir, LOADER_STATE + ".tmp")
-                    with open(ltmp, "w") as f:
-                        json.dump(meta["loader_state"], f,
-                                  default=_jsonable)
+                    tmp2 = os.path.join(step_dir, fname + ".tmp")
+                    with open(tmp2, "w") as f:
+                        json.dump(meta[key], f, default=_jsonable)
                         f.flush()
                         os.fsync(f.fileno())
-                    os.replace(ltmp, os.path.join(step_dir, LOADER_STATE))
+                    os.replace(tmp2, os.path.join(step_dir, fname))
                 except (TypeError, ValueError, OSError) as e:
                     logger.warning(
-                        f"loader state for step {step} could not be "
-                        f"persisted ({e}); resume will fall back to "
-                        "skip-replay")
+                        f"{key} for step {step} could not be persisted "
+                        f"({e}); {miss}")
             manifest = {"format": _MANIFEST_FORMAT, "step": step,
                         "time": time.time(), "tree": schema["tree"],
                         "schema": schema}
@@ -552,6 +573,15 @@ class CheckpointManager:
         step predates durable loader state or was saved without one)."""
         try:
             with open(os.path.join(self._dir, str(step), LOADER_STATE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def read_guard_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """The StepGuard EW statistics persisted with ``step`` (None
+        when the step predates them or the guard was off)."""
+        try:
+            with open(os.path.join(self._dir, str(step), GUARD_STATE)) as f:
                 return json.load(f)
         except (OSError, ValueError):
             return None
@@ -785,7 +815,8 @@ class CheckpointManager:
             item_dir = os.path.join(step_dir, "default")
             payload = item_dir if os.path.isdir(item_dir) else step_dir
             names = set(os.listdir(payload)) \
-                - {MANIFEST, LOADER_STATE, "_CHECKPOINT_METADATA"}
+                - {MANIFEST, LOADER_STATE, GUARD_STATE,
+                   "_CHECKPOINT_METADATA"}
             if not names:
                 return "payload missing"
             # known orbax layout markers (_METADATA / manifest.ocdbt /

@@ -409,6 +409,24 @@ class ResilienceConfig:
     # where offending batches + provenance are dumped (None = skip the
     # dump, still count/log)
     quarantine_dir: Optional[str] = None
+    # SDC defense (resilience/sdc.py, docs/resilience.md "SDC defense"):
+    # when set, the jitted train step computes a per-DP-replica digest
+    # of the final gradients (xor-fold + wraparound-sum of the bit
+    # patterns + a float sum, per leaf) and every N steps the digests
+    # are fetched and compared across replicas — a disagreeing replica
+    # names the offending host(s) in a typed SDCError.  None = the step
+    # program carries no digest at all (zero overhead).
+    sdc_check_interval_steps: Optional[int] = None
+    # redundant-recompute spot check: every K steps, snapshot the state,
+    # re-execute the SAME compiled step on it and compare digests —
+    # bitwise-deterministic by construction, so any difference is the
+    # hardware flaking (catches single-host SDC that replica comparison
+    # cannot see at dp=1).  Costs one extra full step + a state-sized
+    # snapshot per check.
+    sdc_recompute_interval_steps: Optional[int] = None
+    # raise SDCError on a confirmed divergence/mismatch (False: record
+    # the quarantine entry, log, and count sdc_mismatches only)
+    sdc_abort: bool = True
 
     def validate(self) -> None:
         _check(self.spike_zscore > 0,
@@ -447,6 +465,12 @@ class ResilienceConfig:
                "resilience.preempt_sync_interval_steps must be >= 1")
         _check(self.max_consecutive_bad_batches >= 1,
                "resilience.max_consecutive_bad_batches must be >= 1")
+        if self.sdc_check_interval_steps is not None:
+            _check(self.sdc_check_interval_steps >= 1,
+                   "resilience.sdc_check_interval_steps must be >= 1")
+        if self.sdc_recompute_interval_steps is not None:
+            _check(self.sdc_recompute_interval_steps >= 1,
+                   "resilience.sdc_recompute_interval_steps must be >= 1")
 
     def retry_policy(self, max_retries: int) -> Any:
         """The shared RetryPolicy view of the delay/deadline knobs."""

@@ -131,6 +131,28 @@ class HangError(TorchAccTPUError):
         self.dump_path = dump_path
 
 
+class SDCError(TorchAccTPUError):
+    """Confirmed silent data corruption (resilience/sdc.py): a DP
+    replica's gradient digest disagrees with its peers (cross-replica
+    divergence) or a deterministic re-execution of the same step on the
+    same inputs produced different bits (redundant-recompute mismatch).
+
+    Either way the arithmetic — not the software — is suspect ("Cores
+    that don't count", Hochschild et al.).  Carries the step, the kind
+    (``'replica'`` | ``'recompute'``), the suspect host id(s) so a
+    supervisor can restart excluding them (elastic resume handles the
+    smaller world), and the per-leaf first-divergence report."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 kind: Optional[str] = None, hosts: Optional[list] = None,
+                 report: Optional[list] = None):
+        super().__init__(message)
+        self.step = step
+        self.kind = kind
+        self.hosts = list(hosts or [])
+        self.report = list(report or [])
+
+
 class AnomalyError(TorchAccTPUError):
     """Too many consecutive anomalous steps — the run is diverging, not
     glitching.  Carries a diagnosis so the operator sees *what* tripped

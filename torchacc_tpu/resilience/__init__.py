@@ -17,7 +17,15 @@ from torchacc_tpu.resilience.chaos import (
     ChaosPlan,
     chaos_loss,
     failpoint,
+    flip_bits_spec,
     maybe_corrupt_batch,
+)
+from torchacc_tpu.resilience.sdc import (
+    SDCMonitor,
+    host_digests,
+    read_quarantined_hosts,
+    record_quarantine,
+    replica_digests,
 )
 from torchacc_tpu.resilience.coordination import (
     all_agree,
@@ -43,7 +51,13 @@ __all__ = [
     "ChaosPlan",
     "chaos_loss",
     "failpoint",
+    "flip_bits_spec",
     "maybe_corrupt_batch",
+    "SDCMonitor",
+    "host_digests",
+    "read_quarantined_hosts",
+    "record_quarantine",
+    "replica_digests",
     "GuardMonitor",
     "guard_apply",
     "guard_init",

@@ -44,6 +44,16 @@ def failpoint(name: str, **ctx: Any) -> None:
         plan.hit(name, ctx)
 
 
+def flip_bits_spec() -> Optional[Dict[str, Any]]:
+    """The active plan's :meth:`ChaosPlan.flip_bits` rule (or None).
+    Hot-path seam for the SDC digest layer; costs one global ``is
+    None`` check when no plan is active."""
+    plan = _active
+    if plan is None:
+        return None
+    return plan._flip
+
+
 def maybe_corrupt_batch(batch: Any, index: int) -> Any:
     """Loader hot-path seam for :meth:`ChaosPlan.corrupt_batch`; costs
     one global ``is None`` check when no plan is active."""
@@ -79,6 +89,7 @@ class ChaosPlan:
     _rules: Dict[str, _Rule] = field(default_factory=dict)
     _rng: random.Random = field(default=None, repr=False)  # type: ignore
     _corrupt: Optional[Dict[str, Any]] = field(default=None, repr=False)
+    _flip: Optional[Dict[str, Any]] = field(default=None, repr=False)
 
     def __post_init__(self):
         self._rng = random.Random(self.seed)
@@ -118,6 +129,33 @@ class ChaosPlan:
                 f"({ctx or {}})")
             raise rule.exc(f"chaos-injected fault at {point} "
                            f"(#{rule.raised}, ctx={ctx})")
+
+    def flip_bits(self, *, host: int, at: int,
+                  leaf: Optional[str] = None, where: str = "step",
+                  mask: int = 0x0040_0000) -> "ChaosPlan":
+        """Deterministic SDC injection (resilience/sdc.py): at step
+        index ``at``, flip ``mask``'s bits in the first element of the
+        local gradients as seen by the DP replica(s) living on ``host``
+        — inside the per-replica digest region of the jitted step, so
+        exactly one replica's view of the (logically replicated) grads
+        diverges, the way a marginal chip's arithmetic would.
+
+        ``host`` is a JAX process index in multi-process runs; in
+        single-process runs each DP replica is its own simulated host.
+        ``leaf`` selects one grad leaf by path substring (None = every
+        leaf).  ``where``: ``'step'`` corrupts the in-step digest
+        (transient fault — the recompute arbiter sees clean bits and
+        localizes the host); ``'recompute'`` corrupts the redundant
+        re-execution instead.  The default mask flips the mantissa MSB:
+        a visible, always-finite perturbation.
+        """
+        if where not in ("step", "recompute"):
+            raise ValueError(f"flip_bits where must be 'step' or "
+                             f"'recompute', got {where!r}")
+        self._flip = {"host": int(host), "at": int(at), "leaf": leaf,
+                      "where": where, "mask": int(mask) & 0xFFFF_FFFF,
+                      "hits": 0}
+        return self
 
     def corrupt_batch(self, *, at: Iterable[int] = (), times: int = 0,
                       mode: str = "nonfinite",
@@ -195,6 +233,9 @@ class ChaosPlan:
         if self._corrupt is not None:
             out["batch.corrupt"] = {"hits": self._corrupt["hits"],
                                     "raised": self._corrupt["injected"]}
+        if self._flip is not None:
+            out["sdc.flip_bits"] = {"hits": self._flip["hits"],
+                                    "raised": self._flip["hits"]}
         return out
 
     def __enter__(self) -> "ChaosPlan":

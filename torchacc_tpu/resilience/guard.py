@@ -17,8 +17,14 @@ Aborting after N *consecutive* anomalies is host-side by necessity
 (Python must raise): :class:`GuardMonitor` reads the per-step anomaly
 verdict — one scalar device fetch per step, the price of the abort
 guarantee — and raises :class:`~torchacc_tpu.errors.AnomalyError` with a
-diagnosis.  Guard state is intentionally NOT checkpointed: statistics
-re-warm after resume (documented non-guarantee, docs/resilience.md).
+diagnosis.  Guard state is intentionally NOT part of the checkpointed
+``TrainState`` (layouts stay unchanged across guard on/off); instead the
+EW mean/var/count persist as an advisory ``guard_state.json`` sidecar
+with every committed step (``CheckpointManager.save``) and
+``fit(resume='auto')`` restores them, so the spike guard no longer
+re-warms after resume (the pre-PR-4 non-guarantee, now closed — see
+docs/resilience.md).  A checkpoint without the sidecar still resumes;
+only the statistics re-warm.
 """
 
 from __future__ import annotations

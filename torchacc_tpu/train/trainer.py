@@ -117,6 +117,14 @@ class Trainer:
             res.nan_guard and config.compute.dtype != "float16")
         self._guard_state = None
         self._guard_monitor = None
+        # SDC defense (resilience/sdc.py): with either sdc interval
+        # configured the jitted step also emits a per-DP-replica digest
+        # of the final grads; the host compares them on the cadence
+        self._sdc_on = (res.sdc_check_interval_steps is not None
+                        or res.sdc_recompute_interval_steps is not None)
+        self._sdc_monitor = None
+        self._sdc_host_step: Optional[int] = None
+        self._sdc_run_dir: Optional[str] = None
         self.state: Optional[TrainState] = None
         self.state_shardings = None
         self._abstract: Optional[TrainState] = None
@@ -335,9 +343,10 @@ class Trainer:
         shadow_on = self._shadow_on
         res_cfg = self.config.resilience
         guard_on = self._guard_on
+        sdc_on = self._sdc_on
 
         def train_step(state: TrainState, batch: Dict[str, jax.Array],
-                       gstate=None):
+                       gstate=None, sdc_flip=None):
             # bf16 compute-params: the forward differentiates the bf16
             # shadow out of opt_state (no full-tree f32->bf16 cast in
             # the step); the optimizer applies the bf16 grads to the f32
@@ -412,6 +421,16 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 loss_val = loss_s / scale
 
+            sdc_digests = None
+            if sdc_on:
+                # per-DP-replica digest of the final grads (post-psum,
+                # logically replicated over dp): each replica folds its
+                # OWN physical copy, so a flaky chip's bits diverge
+                # here and nowhere upstream can hide them
+                from torchacc_tpu.resilience.sdc import replica_digests
+                sdc_digests = replica_digests(grads, sdc_flip,
+                                              mesh=self.mesh)
+
             from torchacc_tpu.train.amp import global_norm_f32
 
             # f32-accumulated: bf16 grad trees (shadow mode) would
@@ -470,6 +489,8 @@ class Trainer:
             if guard_on:
                 metrics["anomaly"] = (~ok).astype(jnp.float32)
                 metrics["anomaly_kind"] = kind
+            if sdc_on:
+                metrics["sdc_digests"] = sdc_digests
             new_state = TrainState(step=state.step + 1, params=new_params,
                                    opt_state=new_opt, scaler=new_scaler)
             if offload_live:
@@ -498,35 +519,37 @@ class Trainer:
         # sharding').  Pinning the outputs with in-graph
         # with_sharding_constraint instead keeps the layouts AND skips
         # the output-annotate path, so multi-device SPMD offload works.
+        in_sh = [self.state_shardings, self._batch_shardings(sample_batch)]
+        out_sh = [self.state_shardings]
         if guard_on:
-            # guard statistics ride as a donated third operand (replicated
-            # scalars); deliberately NOT part of TrainState so checkpoint
-            # layouts are unchanged — stats re-warm after resume
-            return jax.jit(
-                train_step,
-                in_shardings=(self.state_shardings,
-                              self._batch_shardings(sample_batch),
-                              self._metrics_sharding),
-                out_shardings=(None if offload_live else
-                               (self.state_shardings, self._metrics_sharding,
-                                self._metrics_sharding)),
-                donate_argnums=(0, 2),
-            )
+            # guard statistics ride as a donated operand (replicated
+            # scalars); deliberately NOT part of TrainState so
+            # checkpoint layouts are unchanged — the EW stats persist
+            # as an advisory guard_state.json sidecar per committed
+            # step instead, and fit(resume='auto') restores them
+            in_sh.append(self._metrics_sharding)
+            out_sh.append(self._metrics_sharding)
+        if sdc_on:
+            # the chaos/no-op digest flip operand: tiny replicated
+            # arrays rebuilt host-side each step, never donated
+            in_sh.append(self._metrics_sharding)
+        out_sh.append(self._metrics_sharding)  # metrics dict (prefix)
+        if guard_on and sdc_on:
+            fn = train_step
+        elif guard_on:
+            fn = lambda s, b, g: train_step(s, b, g)
+        elif sdc_on:
+            fn = lambda s, b, f: train_step(s, b, None, f)
+        else:
+            fn = lambda s, b: train_step(s, b)
         return jax.jit(
-            train_step,
-            in_shardings=(self.state_shardings,
-                          self._batch_shardings(sample_batch)),
-            out_shardings=(None if offload_live else
-                           (self.state_shardings, self._metrics_sharding)),
-            donate_argnums=(0,),
+            fn,
+            in_shardings=tuple(in_sh),
+            out_shardings=(None if offload_live else tuple(out_sh)),
+            donate_argnums=(0, 2) if guard_on else (0,),
         )
 
-    def step(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
-        """One optimizer step; returns (async) metrics."""
-        from torchacc_tpu.resilience.chaos import failpoint
-        failpoint("trainer.step")
-        if self.state is None:
-            self.init()
+    def _ensure_compiled(self, batch: Dict[str, jax.Array]) -> None:
         # keyed on structure AND leaf ranks: in_shardings depend on rank
         structure = (jax.tree.structure(batch),
                      tuple(getattr(x, "ndim", 0)
@@ -534,22 +557,126 @@ class Trainer:
         if self._train_step is None or structure != self._train_step_structure:
             self._train_step = self._build_train_step(batch)
             self._train_step_structure = structure
-        if self._guard_on and self._guard_state is None:
-            from torchacc_tpu.resilience.guard import GuardMonitor, guard_init
+
+    def _ensure_guard(self) -> None:
+        from torchacc_tpu.resilience.guard import GuardMonitor, guard_init
+        if self._guard_state is None:
             self._guard_state = jax.device_put(guard_init(),
                                                self._metrics_sharding)
+        if self._guard_monitor is None:
             self._guard_monitor = GuardMonitor(self.config.resilience)
+
+    def _ensure_sdc_monitor(self):
+        from torchacc_tpu.resilience.sdc import SDCMonitor, leaf_paths_of
+        if self._sdc_monitor is None:
+            if self._abstract is None:
+                self.resolve_shardings()
+            self._sdc_monitor = SDCMonitor(
+                self.config.resilience, self.mesh,
+                leaf_paths_of(self._abstract.params),
+                run_dir=self._sdc_run_dir)
+        # fit() learns the run dir after the monitor may exist
+        self._sdc_monitor.run_dir = self._sdc_run_dir
+        return self._sdc_monitor
+
+    def _export_guard_state(self) -> Optional[Dict[str, Any]]:
+        """StepGuard EW statistics as JSON-able scalars (f32 -> f64 ->
+        JSON decimal round-trips bit-exactly), persisted with each
+        committed checkpoint step."""
+        if self._guard_state is None:
+            return None
+        import numpy as np
+        return {k: np.asarray(v).item()
+                for k, v in jax.device_get(self._guard_state).items()}
+
+    def _import_guard_state(self, d: Dict[str, Any]) -> None:
+        """Restore persisted EW statistics (missing keys keep their
+        fresh-init values, so older sidecars stay loadable)."""
+        from torchacc_tpu.resilience.guard import guard_init
+        init = guard_init()
+        gs = {k: jnp.asarray(d.get(k, v), v.dtype)
+              for k, v in init.items()}
+        self._guard_state = jax.device_put(gs, self._metrics_sharding)
+
+    def _sdc_rerun(self, snap, batch: Dict[str, jax.Array],
+                   step_idx: int):
+        """Re-execute the SAME compiled step on the pre-step snapshot
+        (donated — it is disposable) and return the digest matrix: same
+        executable + same input bits, so on healthy hardware the result
+        is bitwise identical by construction."""
+        state_snap, gstate_snap = snap
+        flip = self._sdc_monitor.flips(step_idx, "recompute")
+        args = [state_snap, batch]
+        if self._guard_on:
+            args.append(gstate_snap)
+        args.append(flip)
         with jax.sharding.set_mesh(self.mesh):
-            if self._guard_on:
-                self.state, self._guard_state, metrics = self._train_step(
-                    self.state, batch, self._guard_state)
-            else:
-                self.state, metrics = self._train_step(self.state, batch)
+            out = self._train_step(*args)
+        return jax.device_get(out[-1]["sdc_digests"])
+
+    def step(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """One optimizer step; returns (async) metrics."""
+        from torchacc_tpu.resilience.chaos import failpoint
+        failpoint("trainer.step")
+        if self.state is None:
+            self.init()
+        self._ensure_compiled(batch)
+        if self._guard_on:
+            self._ensure_guard()
+        sdc_check = sdc_spot = False
+        sdc_snap = flip = None
+        if self._sdc_on:
+            mon = self._ensure_sdc_monitor()
+            if self._sdc_host_step is None:
+                self._sdc_host_step = int(self.state.step)
+            si = self._sdc_host_step
+            res = self.config.resilience
+            ci = res.sdc_check_interval_steps
+            ri = res.sdc_recompute_interval_steps
+            sdc_check = ci is not None and si % ci == 0
+            sdc_spot = ri is not None and si % ri == 0
+            flip = mon.flips(si, "step")
+            if sdc_spot or (sdc_check and mon.needs_arbiter()):
+                # donation-safe pre-step snapshot (checkpoint.io
+                # machinery): the redundant recompute / tie arbiter
+                # re-runs the step on these exact bits
+                from torchacc_tpu.checkpoint.io import _snapshot
+                sdc_snap = (_snapshot(self.state),
+                            _snapshot(self._guard_state)
+                            if self._guard_on else None)
+        args = [self.state, batch]
+        if self._guard_on:
+            args.append(self._guard_state)
+        if self._sdc_on:
+            args.append(flip)
+        with jax.sharding.set_mesh(self.mesh):
+            out = self._train_step(*args)
+        if self._guard_on:
+            self.state, self._guard_state, metrics = out
+        else:
+            self.state, metrics = out
+        digests = metrics.pop("sdc_digests", None)
         if self._guard_on:
             # the abort-after-N guarantee costs one scalar fetch per step
             # (see ResilienceConfig); raises AnomalyError with a
             # diagnosis once max_consecutive_anomalies is reached
             self._guard_monitor.observe(int(self.state.step) - 1, metrics)
+        if self._sdc_on:
+            # advance BEFORE observe: the state already committed this
+            # step, and a caller catching SDCError to keep stepping
+            # must not desynchronize the cadence from state.step
+            si = self._sdc_host_step
+            self._sdc_host_step = si + 1
+            if sdc_check or sdc_spot:
+                rerun = (None if sdc_snap is None
+                         else (lambda: self._sdc_rerun(sdc_snap, batch,
+                                                       si)))
+                # verdict from replicated data — identical on every
+                # process, so any raise (and any arbiter re-execution,
+                # a collective) happens in lockstep pod-wide
+                self._sdc_monitor.observe(
+                    si, jax.device_get(digests),
+                    check=sdc_check, spot=sdc_spot, recompute=rerun)
         return metrics
 
     # -- checkpointing ------------------------------------------------------
@@ -585,6 +712,10 @@ class Trainer:
         double-linked list" abort on the first post-restore step); the
         copy is bitwise-exact, lands buffers the runtime owns, and costs
         one state-sized copy only at restore time."""
+        # any restored state invalidates the cached host-side step index
+        # (an in-process supervisor re-entering fit(resume='auto') after
+        # a failure must not attribute SDC verdicts to phantom steps)
+        self._sdc_host_step = None
         with jax.sharding.set_mesh(self.mesh):
             state = jax.jit(
                 lambda s: s, out_shardings=self.state_shardings)(state)
@@ -613,6 +744,7 @@ class Trainer:
         metrics_dir: Optional[str] = None,
         metrics_step_offset: int = 0,
         resume: Optional[str] = None,
+        replay_step: Optional[int] = None,
     ):
         """Run the training loop (reference analogue: the HF-Trainer
         integration the reference enables via accelerate_hf_trainer.py —
@@ -638,6 +770,16 @@ class Trainer:
         — a rescheduled job resumes losing at most the in-flight step.
         See docs/resilience.md for guarantees and non-guarantees.
 
+        ``replay_step=N`` (requires ``checkpoint_dir``) is the SDC
+        triage mode: restore the committed checkpoint at step ``N`` and
+        its durable loader state, re-execute that ONE step twice on
+        snapshots (the restored state is never consumed), print the
+        per-leaf gradient digests, and return the single replay record
+        — no training happens.  Same checkpoint + same loader state ⇒
+        bitwise-identical digests on healthy hardware, so a suspected
+        SDC incident is reproducible offline (docs/resilience.md
+        "SDC defense").
+
         Returns a list of {step, loss, ...} log records."""
         import time as _time
 
@@ -651,11 +793,40 @@ class Trainer:
                 retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries),
                 coord_timeout_s=res_cfg.coord_timeout_s,
                 elastic_resume=res_cfg.elastic_resume)
+        # SDC quarantine records land in the run dir; a restarted pod
+        # that still contains a quarantined host gets warned loudly
+        self._sdc_run_dir = checkpoint_dir or metrics_dir
+        if self._sdc_run_dir:
+            from torchacc_tpu.resilience.coordination import process_index
+            from torchacc_tpu.resilience.sdc import read_quarantined_hosts
+            q = read_quarantined_hosts(self._sdc_run_dir)
+            if q:
+                me = process_index()
+                logger.warning(
+                    f"run dir {self._sdc_run_dir} quarantines host(s) "
+                    f"{sorted(q)} for silent data corruption "
+                    "(sdc_quarantine.json); "
+                    + ("THIS host is one of them — the restart should "
+                       "have excluded it" if me in q else
+                       "verify the restart excluded them"))
+        if replay_step is not None:
+            if mgr is None:
+                raise TrainerStateError(
+                    "fit(replay_step=N) requires checkpoint_dir")
+            try:
+                return self._replay(loader, mgr, replay_step)
+            finally:
+                mgr.close()
         # durable data-pipeline state (docs/resilience.md "Elastic
         # resume"): persisted with every checkpoint when the loader
         # exposes it, restored in place of the O(consumed) skip-replay
         loader_state_fn = getattr(loader, "state_dict", None)
         loader_load_fn = getattr(loader, "load_state_dict", None)
+        # StepGuard EW statistics persist with every committed step
+        # (guard_state.json, advisory) so the spike guard does NOT
+        # re-warm after resume; materialised only on steps that write
+        guard_state_fn = (self._export_guard_state if self._guard_on
+                          else None)
         resumed_loader_state = None
         start_step = 0
         if resume is not None:
@@ -687,6 +858,14 @@ class Trainer:
                 counters.inc("resumes")
                 if loader_load_fn is not None:
                     resumed_loader_state = mgr.read_loader_state(start_step)
+                if self._guard_on:
+                    gs = mgr.read_guard_state(start_step)
+                    if gs is not None:
+                        self._import_guard_state(gs)
+                        logger.info(
+                            "restored StepGuard EW statistics "
+                            f"(count={gs.get('count')}) — the spike "
+                            "guard does not re-warm")
                 logger.info(
                     f"resume='auto': restored step {start_step} from "
                     f"{checkpoint_dir}; "
@@ -839,7 +1018,8 @@ class Trainer:
                     # this step; the loader's durable state rides along
                     # (callable: only materialised on steps that write)
                     saved = mgr.save(step_idx + 1, self.state,
-                                     loader_state=loader_state_fn)
+                                     loader_state=loader_state_fn,
+                                     guard_state=guard_state_fn)
                 # cross-host sync point: the emergency save triggers on
                 # EVERY host at this same boundary when ANY host saw the
                 # signal (exact local-flag check in single-process runs).
@@ -858,7 +1038,8 @@ class Trainer:
                     # not for more steps
                     if not saved:
                         mgr.save(step_idx + 1, self.state, force=True,
-                                 loader_state=loader_state_fn)
+                                 loader_state=loader_state_fn,
+                                 guard_state=guard_state_fn)
                     mgr.wait_until_finished()
                     counters.inc("preemptions")
                     counters.inc("emergency_saves")
@@ -887,6 +1068,106 @@ class Trainer:
             if mw is not None:
                 mw.close()
         return history
+
+    # -- deterministic replay (SDC triage) ----------------------------------
+    def _replay(self, loader, mgr, replay_step: int):
+        """``fit(replay_step=N)``: restore the committed step ``N`` and
+        its durable loader state, re-execute that one step TWICE on
+        donation-safe snapshots (``self.state`` is restored but never
+        consumed), and print/return the per-leaf digest matrix.  Two
+        invocations with the same checkpoint + loader state produce
+        bitwise-identical digests on healthy hardware — the offline
+        reproduction path for a suspected SDC incident."""
+        import itertools
+
+        from torchacc_tpu.checkpoint.io import _snapshot
+        from torchacc_tpu.errors import CheckpointNotFoundError
+        from torchacc_tpu.resilience.sdc import format_digest_matrix
+        forced_sdc = not self._sdc_on
+        if forced_sdc:
+            # replay IS a digest run: force digests into the step
+            # program — for the duration of the replay ONLY (a later
+            # fit() on this trainer keeps its zero-overhead program);
+            # restored in the finally below even when validation or the
+            # restore itself raises
+            self._sdc_on = True
+            self._train_step = None
+        data_it = None
+        try:
+            if replay_step not in mgr.valid_steps():
+                raise CheckpointNotFoundError(
+                    f"fit(replay_step={replay_step}): no committed "
+                    f"checkpoint at that step (valid: {mgr.valid_steps()})")
+            self.state = self._adopt_restored(
+                mgr.restore(self.abstract_state(), step=replay_step))
+            loader_state = mgr.read_loader_state(replay_step)
+            load_fn = getattr(loader, "load_state_dict", None)
+            if loader_state is not None and load_fn is not None:
+                load_fn(loader_state)
+                data_it = iter(loader)
+            else:
+                skip_fn = getattr(loader, "skip_batches", None)
+                if skip_fn is not None and replay_step:
+                    data_it = skip_fn(replay_step)
+                else:
+                    data_it = iter(loader)
+                    if replay_step:
+                        data_it = itertools.islice(data_it, replay_step,
+                                                   None)
+            try:
+                batch = next(iter(data_it))
+            except StopIteration:
+                raise TrainerStateError(
+                    f"fit(replay_step={replay_step}): the loader is "
+                    "exhausted before the replayed step's batch — "
+                    "replay needs the same data stream the run used")
+            self._ensure_compiled(batch)
+            if self._guard_on:
+                self._ensure_guard()
+            mon = self._ensure_sdc_monitor()
+            si = int(self.state.step)
+            self._sdc_host_step = si
+            runs = []
+            for where in ("step", "recompute"):
+                args = [_snapshot(self.state), batch]
+                if self._guard_on:
+                    args.append(_snapshot(self._guard_state))
+                args.append(mon.flips(si, where))
+                with jax.sharding.set_mesh(self.mesh):
+                    out = self._train_step(*args)
+                metrics = out[-1]
+                runs.append((jax.device_get(metrics["sdc_digests"]),
+                             float(jax.device_get(metrics["loss"]))))
+            (d1, loss), (d2, _) = runs
+            deterministic = bool((d1 == d2).all())
+            table = format_digest_matrix(d1, mon.leaf_paths)
+            logger.info(f"replay of step {si}: loss={loss:.6g} "
+                        f"deterministic={deterministic} "
+                        f"({d1.shape[0]} replica(s), {d1.shape[1]} leaves)")
+            for path, rows in table.items():
+                r0 = rows[0]
+                agree = all(r == r0 or (r["bits_xor"] == r0["bits_xor"]
+                                        and r["bits_sum"] == r0["bits_sum"])
+                            for r in rows[1:])
+                logger.info(
+                    f"  {path}: xor={r0['bits_xor']} sum={r0['bits_sum']} "
+                    f"f32_sum={r0['f32_sum']:.6g}"
+                    + ("" if agree else "  << replicas DISAGREE"))
+            if not deterministic:
+                logger.error(
+                    f"replay of step {si} is NOT bitwise deterministic "
+                    "on this machine — the hardware replaying it is "
+                    "itself suspect")
+            return [{"replay_step": replay_step, "step": si, "loss": loss,
+                     "deterministic": deterministic, "digests": table}]
+        finally:
+            if forced_sdc:
+                self._sdc_on = False
+                self._train_step = None
+                self._train_step_structure = None
+            close = getattr(data_it, "close", None)
+            if close is not None:
+                close()
 
     # -- eval ---------------------------------------------------------------
     def eval_step(self, batch: Dict[str, jax.Array]) -> jax.Array:

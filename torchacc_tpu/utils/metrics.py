@@ -39,9 +39,11 @@ class Counters:
     The resilience subsystem increments these (``anomalies_skipped``,
     ``ckpt_retries``, ``resumes``, ``loader_retries``,
     ``loader_fallbacks``, ``preemptions``, ``emergency_saves``,
-    ``watchdog_stalls``, and the elastic-resume trio
+    ``watchdog_stalls``, the elastic-resume trio
     ``resume_replayed_batches`` / ``bad_batches_skipped`` /
-    ``elastic_reshards``) and the Trainer surfaces the non-zero ones in
+    ``elastic_reshards``, and the SDC-defense trio ``sdc_checks`` /
+    ``replica_divergences`` / ``sdc_mismatches``) and the Trainer
+    surfaces the non-zero ones in
     every step log line AND every metrics.jsonl step record — an
     operator sees a run degrading without grepping worker logs.
     Thread-safe: retries fire from the async-loader producer thread.
