@@ -23,6 +23,13 @@ test-inproc:
 bench:
 	python bench.py
 
+# tier-1-adjacent regression gate: drive the REAL bench.py model path
+# (accelerate + trainer.step + metrics) for a few steps on CPU — fast
+# enough for every PR, catches hot-loop wiring breakage that unit tests
+# with tiny ad-hoc models can miss
+bench-smoke:
+	JAX_PLATFORMS=cpu python bench.py --fast --platform cpu --iters 2
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -33,7 +40,7 @@ chaos:
 		echo "== chaos seed $$s =="; \
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
 			tests/test_watchdog.py tests/test_elastic.py \
-			tests/test_sdc.py -m "not slow" \
+			tests/test_sdc.py tests/test_perf.py -m "not slow" \
 			-q || exit 1; \
 	done
 

@@ -252,6 +252,16 @@ def main() -> int:
                          "timed iterations")
     ap.add_argument("--no-decode", action="store_true",
                     help="skip the greedy-decode throughput row")
+    ap.add_argument("--dispatch-depth", type=int, default=2,
+                    help="perf.dispatch_depth: train steps the host may "
+                         "keep in flight (lagged readback; 1 = resolve "
+                         "every step immediately)")
+    ap.add_argument("--guards", action="store_true",
+                    help="enable StepGuard (nan+spike) and per-step SDC "
+                         "digest checks to measure the resilience "
+                         "layer's hot-loop cost; read it off the "
+                         "host_blocked_ms_per_step detail row at "
+                         "--dispatch-depth 1 vs >1")
     args = ap.parse_args()
 
     wd = Watchdog()
@@ -322,6 +332,11 @@ def _bench(args, wd: Watchdog) -> int:
     # Megatron-style main-params AMP: bf16 shadow in opt_state kills the
     # ~2.8 GB/step f32->bf16 param-cast traffic (docs/PERF.md)
     cfg.compute.bf16_compute_params = True
+    cfg.perf.dispatch_depth = max(1, args.dispatch_depth)
+    if args.guards:
+        cfg.resilience.nan_guard = True
+        cfg.resilience.spike_guard = True
+        cfg.resilience.sdc_check_interval_steps = 1
 
     trainer, _ = accelerate(mc, None, cfg, optimizer=optax.adamw(1e-4))
     trainer.init()
@@ -344,11 +359,17 @@ def _bench(args, wd: Watchdog) -> int:
     with contextlib.ExitStack() as stack:
         if args.profile:
             stack.enter_context(jax.profiler.trace(args.profile))
+        trainer.blocked.take_ms()  # zero the host-blocked meter
         t0 = time.perf_counter()
         for _ in range(iters):
             m = trainer.step(batch_data)
         float(m["loss"])
         dt = (time.perf_counter() - t0) / iters
+        # host time spent blocked on the device per step (guard verdict
+        # fetches, SDC digest pulls) — the dispatch-pipelining win shows
+        # as this dropping when --dispatch-depth > 1 under --guards
+        host_blocked_ms = trainer.blocked.take_ms() / iters
+        trainer.drain()  # resolve any still-in-flight verdicts
 
     decode_tps = None
     if not args.no_decode:
@@ -418,14 +439,19 @@ def _bench(args, wd: Watchdog) -> int:
             "n_chips": n_chips,
             "decode_tokens_per_sec_per_chip": (
                 round(decode_tps, 1) if decode_tps else None),
+            "dispatch_depth": max(1, args.dispatch_depth),
+            "host_blocked_ms_per_step": round(host_blocked_ms, 3),
+            "guards": bool(args.guards),
             "fast": bool(args.fast),
             "profile": args.profile,
             "wall_s": round(time.monotonic() - _T0, 1),
         },
     }
     # cache as last-known-good so a later transport outage can still surface
-    # a verifiable number (full runs only: --fast shapes aren't the headline)
-    if not args.fast and (args.platform in (None, "tpu")):
+    # a verifiable number (full runs only: --fast shapes aren't the
+    # headline, and --guards deliberately pays resilience overhead)
+    if not args.fast and not args.guards \
+            and (args.platform in (None, "tpu")):
         _write_last_good(result)
     _emit(result)
     return 0

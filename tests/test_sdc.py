@@ -287,11 +287,11 @@ def test_sdc_host_step_resyncs_after_restore(devices, tmp_path):
     t = _trainer(sdc_check_interval_steps=1)
     t.fit(bs, max_steps=2, log_every=0, checkpoint_dir=d,
           checkpoint_every=2)
-    assert t._sdc_host_step == 2
-    t._sdc_host_step = 99  # simulate a stale index from a failed run
+    assert t._host_step == 2
+    t._host_step = 99  # simulate a stale index from a failed run
     t.fit(bs, max_steps=4, log_every=0, checkpoint_dir=d,
           checkpoint_every=1000, resume="auto")
-    assert t._sdc_host_step == 4  # re-derived from restored step 2
+    assert t._host_step == 4  # re-derived from restored step 2
     assert counters.get("sdc_checks") == 4  # 2 + 2, no phantom indices
 
 

@@ -31,6 +31,7 @@ from torchacc_tpu.config import (
     EPConfig,
     FSDPConfig,
     MemoryConfig,
+    PerfConfig,
     PPConfig,
     ResilienceConfig,
     SPConfig,
@@ -51,6 +52,7 @@ __all__ = [
     "PPConfig",
     "SPConfig",
     "EPConfig",
+    "PerfConfig",
     "ResilienceConfig",
     "accelerate",
     "errors",

@@ -394,6 +394,16 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self._dir, options=self._options)
 
     # -- save ---------------------------------------------------------------
+    def should_save(self, step: int) -> bool:
+        """Whether :meth:`save` would write at ``step`` (interval gate).
+        Conservatively True when the orbax probe is unavailable — the
+        trainer uses this to decide whether to drain in-flight verdicts
+        before a save, and draining on a skip step is harmless."""
+        try:
+            return bool(self._mgr.should_save(step))
+        except Exception:  # noqa: BLE001 - older orbax: let save decide
+            return True
+
     def save(self, step: int, state: Any, *, force: bool = False,
              loader_state: Optional[Dict[str, Any]] = None,
              guard_state: Optional[Dict[str, Any]] = None) -> bool:

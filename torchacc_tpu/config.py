@@ -326,6 +326,36 @@ class EPConfig:
 
 
 @dataclass
+class PerfConfig:
+    """Hot-loop performance policy: host/device desynchronisation.
+
+    The reference hides host latency behind LazyTensor async execution
+    (PAPER.md); the TPU-native analogue is *dispatch pipelining*: the
+    host enqueues step N+1 before step N finishes and only ever reads
+    back results that are already complete.  Every per-step host fetch
+    the resilience layer needs (guard verdicts, SDC digests, logged
+    loss) is taken at lag ``dispatch_depth - 1`` from a lagged-readback
+    ring buffer (train/trainer.py), so dispatch/trace latency hides
+    behind device work instead of landing on step time.  See
+    docs/performance.md for the tuning table and the
+    guarantee-vs-latency trade-off per resilience feature.
+    """
+
+    # How many train steps the host may keep in flight.  1 (default)
+    # resolves every step immediately — bitwise-identical records,
+    # aborts and SDC verdicts to the pre-pipelining behaviour.  k =
+    # dispatch_depth - 1 is the verdict lag: guard abort-after-N becomes
+    # abort-within-N+k, SDC verdicts for step S land while step S+k is
+    # in flight.  2 already hides one full dispatch latency; deeper
+    # pipelines only help when dispatch/trace time exceeds a step time.
+    dispatch_depth: int = 1
+
+    def validate(self) -> None:
+        _check(self.dispatch_depth >= 1,
+               "perf.dispatch_depth must be >= 1")
+
+
+@dataclass
 class ResilienceConfig:
     """Fault tolerance: anomaly guards, retries, preemption handling.
 
@@ -427,6 +457,17 @@ class ResilienceConfig:
     # raise SDCError on a confirmed divergence/mismatch (False: record
     # the quarantine entry, log, and count sdc_mismatches only)
     sdc_abort: bool = True
+    # bound the per-leaf digest fold on check steps: leaves with more
+    # elements than this fold a deterministic strided subsample of at
+    # most this many elements (element 0 — the chaos flip site — is
+    # always included).  None (default) folds every element.  At 10B+
+    # params the full fold's read traffic is measurable; a 1e6 bound
+    # keeps the check O(leaves) while still covering every leaf.  All
+    # digest comparisons (replica, recompute, replay) use the same
+    # bound, so verdict semantics are unchanged — only coverage within
+    # a leaf is sampled.  Digests taken under different bounds are not
+    # comparable to each other.
+    sdc_digest_max_elems: Optional[int] = None
 
     def validate(self) -> None:
         _check(self.spike_zscore > 0,
@@ -471,6 +512,9 @@ class ResilienceConfig:
         if self.sdc_recompute_interval_steps is not None:
             _check(self.sdc_recompute_interval_steps >= 1,
                    "resilience.sdc_recompute_interval_steps must be >= 1")
+        if self.sdc_digest_max_elems is not None:
+            _check(self.sdc_digest_max_elems >= 1,
+                   "resilience.sdc_digest_max_elems must be >= 1")
 
     def retry_policy(self, max_retries: int) -> Any:
         """The shared RetryPolicy view of the delay/deadline knobs."""
@@ -549,6 +593,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     dist: DistConfig = field(default_factory=DistConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    perf: PerfConfig = field(default_factory=PerfConfig)
     # Gradient accumulation micro-steps per optimizer step (non-PP path;
     # under PP the pipeline's num_micro_batches plays this role).
     grad_accum: int = 1
@@ -562,6 +607,7 @@ class Config:
         self.data.validate()
         self.dist.validate()
         self.resilience.validate()
+        self.perf.validate()
         _check(self.grad_accum >= 1, "grad_accum must be >= 1")
 
     # -- mesh ---------------------------------------------------------------
@@ -625,6 +671,7 @@ _TYPE_MAP = {
     "data": DataConfig,
     "dist": DistConfig,
     "resilience": ResilienceConfig,
+    "perf": PerfConfig,
     "dp": DPConfig,
     "tp": TPConfig,
     "fsdp": FSDPConfig,

@@ -48,6 +48,72 @@ def _boundary_needs_f32(dtype) -> bool:
     return dtype != jnp.float32 and not on_tpu()
 
 
+def _data_pin(mesh, mb: int):
+    """Shared row-pin eligibility for both schedules: the live data
+    axes of ``mesh``, their total extent, and whether the per-micro
+    rows divide evenly so the ``P(None, data_axes, ...)`` pin is legal
+    (an uneven pin is degenerate under GSPMD — see the 1F1B warning).
+    Returns ``(data_axes, ext, pin_rows)``."""
+    from torchacc_tpu.config import DATA_AXES
+    data_axes = tuple(a for a in DATA_AXES
+                      if mesh is not None and a in mesh.shape)
+    ext = 1
+    for a in data_axes:
+        ext *= mesh.shape[a]
+    return data_axes, ext, ext > 1 and mb % ext == 0
+
+
+def _micro_splitter(data_axes, mesh, M: int, mb: int, pin_rows: bool):
+    """``[B, ...] -> [M, mb, ...]`` micro split with explicit sharding
+    guidance (the fix for XLA's "Involuntary full rematerialization" on
+    the multichip step).
+
+    With ``pin_rows``, the batch layout ``P(data_axes, ...)`` cannot
+    reach the schedule's row pin ``P(None, data_axes, ...)`` *through*
+    the split reshape in one GSPMD hop — the partitioner's last resort
+    is replicate-then-repartition of the whole activation.  Routing the
+    value through the reshape-natural spec
+    (parallel/sharding.micro_split_spec) splits the move into (a) a
+    movement-free reshape and (b) an ordinary per-dim reshard
+    (all-gather over the M axes + dynamic-slice of the rows).  Without
+    ``pin_rows`` this is a plain reshape, exactly as before."""
+    if not pin_rows:
+        return lambda a: a.reshape((M, mb) + a.shape[1:])
+    from torchacc_tpu.parallel.sharding import micro_split_spec
+
+    def split(a):
+        a = jax.lax.with_sharding_constraint(
+            a, P(data_axes, *([None] * (a.ndim - 1))))
+        m = a.reshape((M, mb) + a.shape[1:])
+        nat = micro_split_spec(data_axes, mesh, M, mb, m.ndim)
+        if nat is not None:
+            m = jax.lax.with_sharding_constraint(m, nat)
+        return jax.lax.with_sharding_constraint(
+            m, P(None, data_axes, *([None] * (m.ndim - 2))))
+    return split
+
+
+def _micro_merger(data_axes, mesh, M: int, mb: int, pin_rows: bool):
+    """The mirror of :func:`_micro_splitter` for the way OUT —
+    ``[M, mb, ...] -> [B, ...]`` around the loss-reduction/gradient
+    boundary: pinned layout -> natural split spec (explicit per-dim
+    reshard) -> movement-free merge reshape -> batch layout."""
+    if not pin_rows:
+        return lambda a: a.reshape((M * mb,) + a.shape[2:])
+    from torchacc_tpu.parallel.sharding import micro_split_spec
+
+    def merge(a):
+        a = jax.lax.with_sharding_constraint(
+            a, P(None, data_axes, *([None] * (a.ndim - 2))))
+        nat = micro_split_spec(data_axes, mesh, M, mb, a.ndim)
+        if nat is not None:
+            a = jax.lax.with_sharding_constraint(a, nat)
+        out = a.reshape((M * mb,) + a.shape[2:])
+        return jax.lax.with_sharding_constraint(
+            out, P(data_axes, *([None] * (out.ndim - 1))))
+    return merge
+
+
 def _per_slot_blocks(apply_block, per_stage, unroll_stage):
     """Heterogeneous-layer support (gemma2/3 layer_pattern): the block
     applier may be a SEQUENCE of per-slot callables — slot j of every
@@ -159,9 +225,14 @@ def pipeline_blocks(
     wire_dtype = (jnp.float32 if _boundary_needs_f32(compute_dtype)
                   else compute_dtype)
     carry_in = (x.astype(wire_dtype),) + tuple(carry_in[1:])
-    # batch -> micro-batches [M, mb, ...] for every rider in the carry
-    micro = tuple(jax.tree.map(
-        lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in)
+    # batch -> micro-batches [M, mb, ...] for every rider in the carry,
+    # with the same explicit split-sharding guidance as 1F1B (see
+    # _micro_splitter): micro ROWS ride the data axes so the per-tick
+    # stage compute is data-parallel, and the split reshape itself is
+    # movement-free instead of an involuntary full rematerialization
+    data_axes, _, pin_rows = _data_pin(mesh, mb)
+    split = _micro_splitter(data_axes, mesh, M, mb, pin_rows)
+    micro = tuple(jax.tree.map(split, c) for c in carry_in)
 
     param_spec = jax.tree.map(lambda _: P(None, pp_axis), staged)
     data_spec = tuple(P() for _ in micro)
@@ -300,7 +371,10 @@ def pipeline_blocks(
         outs = jax.lax.psum(
             jnp.where(me == Pn - 1, outs.astype(wire_dtype),
                       jnp.zeros_like(outs, wire_dtype)), pp_axis)
-        return (outs.reshape((B,) + outs.shape[2:]),
+        # merge back to [B, ...] with the explicit pinned -> natural ->
+        # batch-layout routing (auto-axes constraints are legal inside
+        # the pp-manual region); mirrors the entry split
+        return (_micro_merger(data_axes, mesh, M, mb, pin_rows)(outs),
                 jax.lax.psum(aux_local, pp_axis))
 
     out, aux_total = jax.shard_map(
@@ -435,9 +509,6 @@ def pipeline_train_1f1b(
     wire_dtype = (jnp.float32 if _boundary_needs_f32(compute_dtype)
                   else compute_dtype)
     carry_in_f = (x.astype(wire_dtype),) + tuple(carry_in[1:])
-    micro = tuple(jax.tree.map(
-        lambda a: a.reshape((M, mb) + a.shape[1:]), c) for c in carry_in_f)
-    labels_micro = labels.reshape((M, mb) + labels.shape[1:])
 
     # Pin the data-axis sharding to the per-micro ROW dim: each data
     # replica carries its 1/ext slice of every micro-batch through the
@@ -451,13 +522,8 @@ def pipeline_train_1f1b(
     # them take the same branch and the collective is uniform within its
     # group (verified on the emulated CPU mesh, whose in-process
     # communicator is the strictest rendezvous we have).
-    from torchacc_tpu.config import DATA_AXES
-    data_axes = tuple(a for a in DATA_AXES
-                      if mesh is not None and a in mesh.shape)
-    ext = 1
-    for a in data_axes:
-        ext *= mesh.shape[a]
-    if ext > 1 and mb % ext != 0:
+    data_axes, ext, pin_rows = _data_pin(mesh, mb)
+    if ext > 1 and not pin_rows:
         # An uneven row pin is degenerate under GSPMD: depending on the
         # mb/ext ratio the constraint is silently dropped, padded with
         # empty shards, or rejected at an inner jit output boundary
@@ -471,13 +537,9 @@ def pipeline_train_1f1b(
             f"are replicated across data replicas (redundant compute).  "
             f"Pick num_micro_batches so that batch / num_micro_batches "
             f"is a multiple of {ext} to restore data-sharded 1F1B.")
-    elif ext > 1:
-        def _pin(a):
-            return jax.lax.with_sharding_constraint(
-                a, P(None, data_axes, *([None] * (a.ndim - 2))))
-
-        micro = jax.tree.map(_pin, micro)
-        labels_micro = _pin(labels_micro)
+    split = _micro_splitter(data_axes, mesh, M, mb, pin_rows)
+    micro = tuple(jax.tree.map(split, c) for c in carry_in_f)
+    labels_micro = split(labels)
     # Control-flow mode.  With any non-pp axis live (dp/fsdp/tp/...),
     # the stage body and the last-stage head contain GSPMD-inserted
     # collectives over those axes; putting them inside an me-gated
@@ -840,7 +902,13 @@ def pipeline_train_1f1b(
     dhead = jax.tree.map(lambda a, ref: jnp.sum(a, 0).astype(ref.dtype),
                          dhead_st, head_params)
     dx_micro = jnp.sum(dx_st, 0)  # only stage 0 wrote
-    dx = dx_micro.reshape((B,) + dx_micro.shape[2:]).astype(x.dtype)
+    # the merge reshape back to [B, ...] mirrors the entry split: route
+    # pinned-rows -> natural -> batch layout explicitly, or GSPMD's only
+    # path from the pin through this reshape is a full rematerialization
+    # of the embedding cotangent (the MULTICHIP bench's involuntary-
+    # full-remat warning on jvp()/reduce_sum)
+    dx = _micro_merger(data_axes, mesh, M, mb, pin_rows)(
+        dx_micro).astype(x.dtype)
     return (loss_sum, count), (d_stacked, dhead, dx)
 
 

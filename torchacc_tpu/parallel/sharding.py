@@ -183,6 +183,46 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def micro_split_spec(data_axes: Sequence[str], mesh,
+                     num_micro: int, micro_rows: int,
+                     ndim: int) -> Optional[PartitionSpec]:
+    """Reshape-NATURAL sharding of a ``[B, ...] -> [M, mb, ...]``
+    micro-batch split, or None when no per-dim factorisation exists.
+
+    A batch dim sharded contiguously over ``data_axes`` propagates
+    through the split reshape to ``P(m_axes, mb_axes)`` exactly when a
+    leading run of the axes tiles the micro dim completely (their
+    product divides ``M``) and — if any axes remain — that run covers
+    ``M`` exactly while the remainder divides ``mb``.  Pipeline
+    schedules pin micro ROWS to the data axes (``P(None, data_axes)``,
+    parallel/pp.py) so the per-tick dynamic index over M stays local;
+    going from the batch layout to that pin *through the reshape* in
+    one hop is exactly what GSPMD cannot do ("Involuntary full
+    rematerialization", replicate-then-repartition).  Constraining the
+    reshape's output to this natural spec first makes the reshape
+    itself movement-free; the natural->pin hop then lowers as ordinary
+    per-dim reshards (all-gather + dynamic-slice).  The mirror is used
+    on the way out, around the loss-reduction/gradient reshape back to
+    ``[B, ...]``.
+    """
+    extents = [int(mesh.shape[a]) for a in data_axes]
+    m_axes: List[str] = []
+    prod = 1
+    i = 0
+    while i < len(data_axes) and num_micro % (prod * extents[i]) == 0:
+        prod *= extents[i]
+        m_axes.append(data_axes[i])
+        i += 1
+    mb_axes = list(data_axes[i:])
+    if mb_axes:
+        rest = math.prod(extents[i:])
+        if prod != num_micro or micro_rows % rest != 0:
+            return None
+    return PartitionSpec(tuple(m_axes) if m_axes else None,
+                         tuple(mb_axes) if mb_axes else None,
+                         *([None] * max(ndim - 2, 0)))
+
+
 def activation_constraint(x: jax.Array,
                           logical_axes: Sequence[Optional[str]],
                           rules: LogicalRules = DEFAULT_RULES) -> jax.Array:

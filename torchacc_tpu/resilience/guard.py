@@ -17,7 +17,13 @@ Aborting after N *consecutive* anomalies is host-side by necessity
 (Python must raise): :class:`GuardMonitor` reads the per-step anomaly
 verdict — one scalar device fetch per step, the price of the abort
 guarantee — and raises :class:`~torchacc_tpu.errors.AnomalyError` with a
-diagnosis.  Guard state is intentionally NOT part of the checkpointed
+diagnosis.  Under dispatch pipelining (``perf.dispatch_depth`` = 1 + k,
+train/trainer.py) the monitor observes each step at lag k from the
+lagged-readback ring buffer: the fetch then reads an already-completed
+scalar instead of serialising dispatch, the anomaly is still attributed
+to the step that produced it, and abort-after-N becomes
+abort-within-N+k — at the default depth 1 (k = 0) the semantics are
+bitwise identical to the unpipelined loop (docs/performance.md).  Guard state is intentionally NOT part of the checkpointed
 ``TrainState`` (layouts stay unchanged across guard on/off); instead the
 EW mean/var/count persist as an advisory ``guard_state.json`` sidecar
 with every committed step (``CheckpointManager.save``) and

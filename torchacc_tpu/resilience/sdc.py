@@ -73,13 +73,22 @@ QUARANTINE_FILE = "sdc_quarantine.json"
 # -- traced digest fold -------------------------------------------------------
 
 def _leaf_digest(x: jax.Array, hit: jax.Array,
-                 xor_mask: jax.Array) -> jax.Array:
+                 xor_mask: jax.Array,
+                 max_elems: Optional[int] = None) -> jax.Array:
     """Fold one grad leaf to ``[3] uint32``: XOR fold + wraparound sum
     of the f32 bit patterns (order-independent -> exact under any
     reduction order / sharding) + the f32 sum's bit pattern (order-
     dependent; report-only).  ``hit`` conditionally XORs ``xor_mask``
     into the first element first — the chaos seam; when False the value
-    is bitwise untouched."""
+    is bitwise untouched.
+
+    ``max_elems`` (resilience.sdc_digest_max_elems) bounds the fold's
+    read traffic on huge leaves: a leaf with more elements folds a
+    deterministic strided subsample of at most ``max_elems`` elements
+    spread across the whole leaf.  Element 0 — the chaos flip site — is
+    always in the subsample (the stride starts at 0), so the injection
+    seam keeps working; the subsampled fold is still exact and
+    order-independent over its (shape-determined) subset."""
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     if bits.ndim == 0:
         bits = jnp.where(hit, bits ^ xor_mask, bits)
@@ -90,6 +99,9 @@ def _leaf_digest(x: jax.Array, hit: jax.Array,
         idx = (0,) * bits.ndim
         b0 = bits[idx]
         bits = bits.at[idx].set(jnp.where(hit, b0 ^ xor_mask, b0))
+        if max_elems is not None and bits.size > max_elems:
+            stride = -(-bits.size // max_elems)  # ceil: <= max_elems kept
+            bits = bits.reshape(-1)[::stride]
         xor = jax.lax.reduce(bits, jnp.uint32(0), jax.lax.bitwise_xor,
                              tuple(range(bits.ndim)))
         usum = jnp.sum(bits, dtype=jnp.uint32)
@@ -100,7 +112,8 @@ def _leaf_digest(x: jax.Array, hit: jax.Array,
 
 
 def replica_digests(grads: Any, flip: Dict[str, jax.Array], *,
-                    mesh, axis: str = "dp") -> jax.Array:
+                    mesh, axis: str = "dp",
+                    max_elems: Optional[int] = None) -> jax.Array:
     """Traced: per-DP-replica digest matrix ``uint32 [dp, leaves, 3]``.
 
     Runs inside the jitted train step.  ``grads`` is the final gradient
@@ -110,7 +123,9 @@ def replica_digests(grads: Any, flip: Dict[str, jax.Array], *,
     operand built by :func:`flip_operands`: ``mask`` (int32 ``[dp]``,
     nonzero replicas get the bit flip), ``leaf`` (int32 leaf index, -1
     = all), ``xor`` (uint32 mask).  The output is replicated so every
-    process can fetch all rows.
+    process can fetch all rows.  ``max_elems`` bounds the per-leaf fold
+    on check steps (see :func:`_leaf_digest`) — the 10B+-param digest
+    cost knob (resilience.sdc_digest_max_elems).
     """
     leaves = jax.tree.leaves(grads)
 
@@ -120,7 +135,8 @@ def replica_digests(grads: Any, flip: Dict[str, jax.Array], *,
         rows = []
         for i, x in enumerate(ls):
             hit = hit_r & ((flip["leaf"] < 0) | (flip["leaf"] == i))
-            rows.append(_leaf_digest(x, hit, flip["xor"]))
+            rows.append(_leaf_digest(x, hit, flip["xor"],
+                                     max_elems=max_elems))
         return jnp.stack(rows)[None]  # [1, leaves, 3] per replica
 
     digs = jax.shard_map(

@@ -5,12 +5,25 @@ all-reduces, `mark_step` cuts and compiles the graph.  TPU-native: ONE
 jitted, donated train-step function whose shardings make XLA insert every
 collective (psum for DP, all-gather/reduce-scatter for FSDP, all-to-all
 for EP) — there is nothing to hook and no graph to cut.
+
+Dispatch pipelining (``perf.dispatch_depth``): the host keeps up to
+``dispatch_depth`` steps in flight and reads back only *lagged* results
+— the analogue of the reference's LazyTensor async execution, where the
+host records IR ahead of the device.  Every per-step host fetch the
+resilience layer needs (the StepGuard verdict scalar, SDC digest
+matrices, the logged loss) is taken from a ring buffer of in-flight
+steps at lag ``k = dispatch_depth - 1``, so it reads an
+already-completed value instead of serialising dispatch behind
+execution.  ``dispatch_depth=1`` (default) resolves every step
+immediately — bitwise-identical behaviour to the unpipelined loop.
+See docs/performance.md for the guarantee-vs-latency table.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +59,24 @@ def shift_labels(input_ids: jax.Array,
         valid = (next_seg == segment_ids) & (segment_ids >= 0)
         labels = jnp.where(valid, labels, -100)
     return labels
+
+
+@dataclasses.dataclass
+class _InFlightStep:
+    """One dispatched-but-unresolved train step in the lagged-readback
+    ring buffer.  ``metrics`` are device arrays (futures until the step
+    completes); ``rerun`` is the SDC redundant-recompute closure bound
+    to the snapshot, batch and compiled executable captured at dispatch
+    time, so a recompile mid-flight cannot change what the verdict
+    re-executes."""
+
+    step: int
+    metrics: Dict[str, jax.Array]
+    digests: Optional[jax.Array] = None
+    tokens: Optional[int] = None
+    sdc_check: bool = False
+    sdc_spot: bool = False
+    rerun: Optional[Callable[[], Any]] = None
 
 
 class Trainer:
@@ -123,8 +154,18 @@ class Trainer:
         self._sdc_on = (res.sdc_check_interval_steps is not None
                         or res.sdc_recompute_interval_steps is not None)
         self._sdc_monitor = None
-        self._sdc_host_step: Optional[int] = None
         self._sdc_run_dir: Optional[str] = None
+        # dispatch pipelining (perf.dispatch_depth, module docstring):
+        # the ring buffer of in-flight steps, the host-side mirror of
+        # state.step (no per-step device fetch to learn the index), and
+        # the host-blocked-time meter every blocking fetch reports to
+        from torchacc_tpu.utils.metrics import BlockedMeter
+        self._lag = config.perf.dispatch_depth - 1
+        self._inflight: "collections.deque[_InFlightStep]" = \
+            collections.deque()
+        self.last_resolved: Optional[_InFlightStep] = None
+        self._host_step: Optional[int] = None
+        self.blocked = BlockedMeter()
         self.state: Optional[TrainState] = None
         self.state_shardings = None
         self._abstract: Optional[TrainState] = None
@@ -189,6 +230,7 @@ class Trainer:
         with jax.sharding.set_mesh(self.mesh):
             self.state = jax.jit(
                 init_fn, out_shardings=self.state_shardings)(rng)
+        self._host_step = 0
         n_params = sum(x.size for x in jax.tree.leaves(self.state.params))
         logger.info(f"initialised {n_params/1e6:.1f}M params on mesh "
                     f"{dict(self.mesh.shape)}")
@@ -222,6 +264,7 @@ class Trainer:
             # during init (the large-model case this path exists for)
             self.state = jax.jit(mk, out_shardings=sh,
                                  donate_argnums=0)(params)
+        self._host_step = 0
         return self.state
 
     # -- train step ---------------------------------------------------------
@@ -428,8 +471,9 @@ class Trainer:
                 # OWN physical copy, so a flaky chip's bits diverge
                 # here and nowhere upstream can hide them
                 from torchacc_tpu.resilience.sdc import replica_digests
-                sdc_digests = replica_digests(grads, sdc_flip,
-                                              mesh=self.mesh)
+                sdc_digests = replica_digests(
+                    grads, sdc_flip, mesh=self.mesh,
+                    max_elems=res_cfg.sdc_digest_max_elems)
 
             from torchacc_tpu.train.amp import global_norm_f32
 
@@ -586,8 +630,12 @@ class Trainer:
         if self._guard_state is None:
             return None
         import numpy as np
-        return {k: np.asarray(v).item()
-                for k, v in jax.device_get(self._guard_state).items()}
+        # blocks on the NEWEST dispatched step (save steps are sync
+        # points regardless — orbax waits on the arrays); metered so
+        # host_blocked_ms attributes the wait honestly
+        with self.blocked.blocked():
+            gs = jax.device_get(self._guard_state)
+        return {k: np.asarray(v).item() for k, v in gs.items()}
 
     def _import_guard_state(self, d: Dict[str, Any]) -> None:
         """Restore persisted EW statistics (missing keys keep their
@@ -599,11 +647,13 @@ class Trainer:
         self._guard_state = jax.device_put(gs, self._metrics_sharding)
 
     def _sdc_rerun(self, snap, batch: Dict[str, jax.Array],
-                   step_idx: int):
+                   step_idx: int, fn=None):
         """Re-execute the SAME compiled step on the pre-step snapshot
         (donated — it is disposable) and return the digest matrix: same
         executable + same input bits, so on healthy hardware the result
-        is bitwise identical by construction."""
+        is bitwise identical by construction.  ``fn`` pins the compiled
+        executable captured at dispatch time (under dispatch pipelining
+        the verdict may resolve after a recompile)."""
         state_snap, gstate_snap = snap
         flip = self._sdc_monitor.flips(step_idx, "recompute")
         args = [state_snap, batch]
@@ -611,11 +661,20 @@ class Trainer:
             args.append(gstate_snap)
         args.append(flip)
         with jax.sharding.set_mesh(self.mesh):
-            out = self._train_step(*args)
+            out = (fn or self._train_step)(*args)
         return jax.device_get(out[-1]["sdc_digests"])
 
     def step(self, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
-        """One optimizer step; returns (async) metrics."""
+        """One optimizer step; returns (async) metrics.
+
+        Dispatches the step and resolves the step at lag
+        ``perf.dispatch_depth - 1`` from the in-flight ring buffer
+        (module docstring): guard/SDC verdicts and any metric fetch for
+        step N happen while step N+k is already executing, so they read
+        completed values.  ``self.last_resolved`` carries the entry
+        resolved by this call (None while the pipeline is filling).  At
+        the default depth 1 every step resolves immediately — exactly
+        the pre-pipelining behaviour, fetch-for-fetch."""
         from torchacc_tpu.resilience.chaos import failpoint
         failpoint("trainer.step")
         if self.state is None:
@@ -623,13 +682,16 @@ class Trainer:
         self._ensure_compiled(batch)
         if self._guard_on:
             self._ensure_guard()
+        if self._host_step is None:
+            # one-time resync after a restore: the only host<->device
+            # step-index round-trip the loop ever pays
+            with self.blocked.blocked():
+                self._host_step = int(self.state.step)
+        si = self._host_step
         sdc_check = sdc_spot = False
         sdc_snap = flip = None
         if self._sdc_on:
             mon = self._ensure_sdc_monitor()
-            if self._sdc_host_step is None:
-                self._sdc_host_step = int(self.state.step)
-            si = self._sdc_host_step
             res = self.config.resilience
             ci = res.sdc_check_interval_steps
             ri = res.sdc_recompute_interval_steps
@@ -656,28 +718,87 @@ class Trainer:
         else:
             self.state, metrics = out
         digests = metrics.pop("sdc_digests", None)
-        if self._guard_on:
-            # the abort-after-N guarantee costs one scalar fetch per step
-            # (see ResilienceConfig); raises AnomalyError with a
-            # diagnosis once max_consecutive_anomalies is reached
-            self._guard_monitor.observe(int(self.state.step) - 1, metrics)
-        if self._sdc_on:
-            # advance BEFORE observe: the state already committed this
-            # step, and a caller catching SDCError to keep stepping
-            # must not desynchronize the cadence from state.step
-            si = self._sdc_host_step
-            self._sdc_host_step = si + 1
-            if sdc_check or sdc_spot:
-                rerun = (None if sdc_snap is None
-                         else (lambda: self._sdc_rerun(sdc_snap, batch,
-                                                       si)))
-                # verdict from replicated data — identical on every
-                # process, so any raise (and any arbiter re-execution,
-                # a collective) happens in lockstep pod-wide
-                self._sdc_monitor.observe(
-                    si, jax.device_get(digests),
-                    check=sdc_check, spot=sdc_spot, recompute=rerun)
+        # advance BEFORE any verdict resolves: the state already
+        # committed this step, and a caller catching SDCError /
+        # AnomalyError to keep stepping must not desynchronize the
+        # cadence from state.step
+        self._host_step = si + 1
+        rerun = None
+        if sdc_snap is not None:
+            fn = self._train_step
+            # shallow-copy the batch dict too (same hazard as the
+            # metrics copy below): a caller reusing one dict per step
+            # must not change what a lagged arbiter re-executes
+            rerun = (lambda snap=sdc_snap, b=dict(batch), s=si, f=fn:
+                     self._sdc_rerun(snap, b, s, fn=f))
+        ids = batch.get("input_ids") if hasattr(batch, "get") else None
+        # shallow-copy the metrics into the entry: the pre-PR API let
+        # callers mutate the returned dict freely (observation was
+        # already done); under lag the resolution happens k steps later
+        # and must not read a caller-modified dict
+        self._inflight.append(_InFlightStep(
+            step=si, metrics=dict(metrics), digests=digests,
+            tokens=(ids.shape[0] * ids.shape[1]
+                    if getattr(ids, "ndim", 0) >= 2 else None),
+            sdc_check=sdc_check, sdc_spot=sdc_spot, rerun=rerun))
+        self.last_resolved = None
+        if len(self._inflight) > self._lag:
+            self.last_resolved = self.resolve_oldest()
         return metrics
+
+    # -- lagged readback ----------------------------------------------------
+    @property
+    def pending(self) -> int:
+        """Dispatched-but-unresolved step count (<= perf.dispatch_depth)."""
+        return len(self._inflight)
+
+    def resolve_oldest(self) -> Optional[_InFlightStep]:
+        """Resolve the oldest in-flight step: fetch its verdict scalars
+        (already complete at lag > 0), run the guard/SDC monitors
+        attributed to THAT step, and return the entry.
+
+        Raises :class:`AnomalyError` / :class:`SDCError` exactly as the
+        unpipelined loop did, at most ``dispatch_depth - 1`` steps late
+        (abort-after-N becomes abort-within-N+k); the entry is popped
+        first, so a caller catching the error stays consistent."""
+        if not self._inflight:
+            return None
+        e = self._inflight.popleft()
+        if self._guard_on:
+            # the abort guarantee costs one scalar fetch per resolved
+            # step (see ResilienceConfig); raises AnomalyError with a
+            # diagnosis once max_consecutive_anomalies is reached
+            with self.blocked.blocked():
+                self._guard_monitor.observe(e.step, e.metrics)
+        if self._sdc_on and (e.sdc_check or e.sdc_spot):
+            with self.blocked.blocked():
+                digests = jax.device_get(e.digests)
+            # verdict from replicated data — identical on every
+            # process, so any raise (and any arbiter re-execution, a
+            # collective) happens in lockstep pod-wide: every process
+            # resolves at the same loop point because dispatch_depth is
+            # config, not discovered
+            self._sdc_monitor.observe(
+                e.step, digests,
+                check=e.sdc_check, spot=e.sdc_spot, recompute=e.rerun)
+        # the verdict is recorded — release the digest matrix and the
+        # rerun closure (which captures a state-sized arbiter snapshot
+        # at dp<=2) NOW, not when the entry itself dies: last_resolved
+        # and drain()'s return keep entries alive past this point, and
+        # the snapshot budget is documented as peaking at the in-flight
+        # count, never in-flight + resolved
+        e.digests = None
+        e.rerun = None
+        return e
+
+    def drain(self) -> List[_InFlightStep]:
+        """Resolve every in-flight step (end of run, preemption, or
+        before anything that must see all verdicts).  Returns the
+        resolved entries in step order."""
+        out = []
+        while self._inflight:
+            out.append(self.resolve_oldest())
+        return out
 
     # -- checkpointing ------------------------------------------------------
     def abstract_state(self) -> TrainState:
@@ -714,8 +835,12 @@ class Trainer:
         one state-sized copy only at restore time."""
         # any restored state invalidates the cached host-side step index
         # (an in-process supervisor re-entering fit(resume='auto') after
-        # a failure must not attribute SDC verdicts to phantom steps)
-        self._sdc_host_step = None
+        # a failure must not attribute guard/SDC verdicts to phantom
+        # steps) AND the in-flight ring: entries dispatched before the
+        # failure refer to a timeline the restore just discarded
+        self._host_step = None
+        self._inflight.clear()
+        self.last_resolved = None
         with jax.sharding.set_mesh(self.mesh):
             state = jax.jit(
                 lambda s: s, out_shardings=self.state_shardings)(state)
@@ -827,6 +952,18 @@ class Trainer:
         # re-warm after resume; materialised only on steps that write
         guard_state_fn = (self._export_guard_state if self._guard_on
                           else None)
+        # a previous fit that exited exceptionally (AnomalyError /
+        # SDCError / HangError — the documented non-draining exits) may
+        # have left entries in the ring; they belong to the discarded
+        # timeline, and resolving them into THIS run would attribute
+        # verdicts and records to phantom steps.  Normal exits drained,
+        # so this is a no-op for them.  The blocked meter is discarded
+        # with them: time accrued before fit (warm-up steps, a previous
+        # run) must not inflate the first record's host_blocked_ms —
+        # the triage signal docs/performance.md tunes against.
+        self._inflight.clear()
+        self.last_resolved = None
+        self.blocked.take_ms()
         resumed_loader_state = None
         start_step = 0
         if resume is not None:
@@ -855,6 +992,9 @@ class Trainer:
                     "starting fresh")
             else:
                 self.state = self._adopt_restored(state)
+                # the restored step is known — no device fetch needed to
+                # re-derive the host-side index
+                self._host_step = start_step
                 counters.inc("resumes")
                 if loader_load_fn is not None:
                     resumed_loader_state = mgr.read_loader_state(start_step)
@@ -950,6 +1090,85 @@ class Trainer:
             data_it = iter(loader)
             bounded = (itertools.islice(data_it, start_step, max_steps)
                        if (max_steps is not None or start_step) else data_it)
+        def _emit(entry, allow_eval: bool = True) -> None:
+            """Log/eval for a RESOLVED step (lagged by
+            perf.dispatch_depth - 1 behind dispatch): the loss fetch
+            reads a completed value, so log steps no longer stall the
+            pipeline.  Gating on the resolved index keeps the record
+            trajectory identical across dispatch depths; under lag the
+            eval runs on the newest state (documented in
+            docs/performance.md).  ``allow_eval=False`` (the emergency-
+            save drain) suppresses the eval pass — the grace window is
+            for verdicts and the checkpoint, not a full eval."""
+            nonlocal t_prev, s_prev
+            r = entry.step
+            do_log = log_every and r % log_every == 0
+            do_eval = (allow_eval and eval_loader is not None
+                       and eval_every and r and r % eval_every == 0)
+            if not (do_log or do_eval):
+                return
+            now = _time.perf_counter()
+            with self.blocked.blocked():
+                loss = float(entry.metrics["loss"])
+            rec = {"step": r, "loss": loss,
+                   "time_s": round(now - t0, 2)}
+            if wd is not None:
+                # sample the age BEFORE beating: it reports how
+                # long this section actually ran (≈ the step +
+                # metrics sync), not a freshly-reset zero
+                rec["heartbeat_age_s"] = round(
+                    wd.heartbeat_age_s(), 3)
+                # the step itself finished — liveness proven;
+                # eval/logging get their own deadline window
+                wd.beat()
+            if r > s_prev:
+                rec["steps_per_sec"] = round(
+                    (r - s_prev) / max(now - t_prev, 1e-9), 3)
+                if entry.tokens:
+                    rec["tokens_per_sec"] = round(
+                        rec["steps_per_sec"] * entry.tokens, 1)
+            if do_eval:
+                # dispatch the WHOLE eval pass, then resolve all losses
+                # in one batched fetch — the host never serialises
+                # against the device per eval batch
+                evs = [self.eval_step(eb) for eb in eval_loader]
+                with self.blocked.blocked():
+                    vals = jax.device_get(evs)
+                rec["eval_loss"] = (sum(float(v) for v in vals)
+                                    / max(len(vals), 1))
+            # restamp AFTER eval so its wall time is not charged
+            # to the next interval's steps/tokens-per-sec
+            t_prev, s_prev = _time.perf_counter(), r
+            # how long the host spent blocked on the device since the
+            # last record, and at what pipeline depth — the tentpole's
+            # measurement seam (utils/metrics.BlockedMeter)
+            rec["host_blocked_ms"] = round(self.blocked.take_ms(), 3)
+            rec["dispatch_depth"] = self._lag + 1
+            # degradation counters ride the record so operators
+            # see retries/skips/resumes in metrics.jsonl too
+            for k, v in counters.snapshot().items():
+                rec[k] = v
+            history.append(rec)
+            if mw is not None:
+                mw.log(metrics_step_offset + r,
+                       {f"train/{k}": v for k, v in rec.items()
+                        if k != "step"})
+            logger.info(f"step {r}: loss {rec['loss']:.4f}"
+                        f"{counters.suffix()}")
+
+        def _drain_all(allow_eval: bool = True) -> None:
+            """Resolve every in-flight step, emitting its record, with a
+            fresh watchdog window per entry — exactly like an in-loop
+            step.  Any pending AnomalyError/SDCError raises HERE."""
+            while self.pending:
+                if wd is not None:
+                    wd.arm("train_step", res_cfg.step_deadline_s)
+                entry = self.resolve_oldest()
+                if entry is not None:
+                    _emit(entry, allow_eval=allow_eval)
+                if wd is not None:
+                    wd.disarm()
+
         try:
             steps_it = enumerate(bounded, start=start_step)
             while True:
@@ -962,51 +1181,15 @@ class Trainer:
                         wd.disarm()
                     break
                 if wd is not None:
+                    # the deadline is armed around dispatch + the LAGGED
+                    # resolution point: in steady state the blocking
+                    # fetch inside step() waits on step N-k, so expiry
+                    # still means "a step's device work did not finish
+                    # in time" (docs/resilience.md watchdog table)
                     wd.arm("train_step", res_cfg.step_deadline_s)
-                metrics = self.step(batch)
-                do_log = log_every and step_idx % log_every == 0
-                do_eval = (eval_loader is not None and eval_every
-                           and step_idx and step_idx % eval_every == 0)
-                if do_log or do_eval:
-                    now = _time.perf_counter()
-                    rec = {"step": step_idx,
-                           "loss": float(metrics["loss"]),
-                           "time_s": round(now - t0, 2)}
-                    if wd is not None:
-                        # sample the age BEFORE beating: it reports how
-                        # long this section actually ran (≈ the step +
-                        # metrics sync), not a freshly-reset zero
-                        rec["heartbeat_age_s"] = round(
-                            wd.heartbeat_age_s(), 3)
-                        # the step itself finished — liveness proven;
-                        # eval/logging get their own deadline window
-                        wd.beat()
-                    if step_idx > s_prev:
-                        rec["steps_per_sec"] = round(
-                            (step_idx - s_prev) / max(now - t_prev, 1e-9), 3)
-                        ids = batch.get("input_ids")
-                        if ids is not None:
-                            rec["tokens_per_sec"] = round(
-                                rec["steps_per_sec"] * ids.shape[0]
-                                * ids.shape[1], 1)
-                    if do_eval:
-                        evs = [float(self.eval_step(eb))
-                               for eb in eval_loader]
-                        rec["eval_loss"] = sum(evs) / max(len(evs), 1)
-                    # restamp AFTER eval so its wall time is not charged
-                    # to the next interval's steps/tokens-per-sec
-                    t_prev, s_prev = _time.perf_counter(), step_idx
-                    # degradation counters ride the record so operators
-                    # see retries/skips/resumes in metrics.jsonl too
-                    for k, v in counters.snapshot().items():
-                        rec[k] = v
-                    history.append(rec)
-                    if mw is not None:
-                        mw.log(metrics_step_offset + step_idx,
-                               {f"train/{k}": v for k, v in rec.items()
-                                if k != "step"})
-                    logger.info(f"step {step_idx}: loss {rec['loss']:.4f}"
-                                f"{counters.suffix()}")
+                self.step(batch)
+                if self.last_resolved is not None:
+                    _emit(self.last_resolved)
                 if wd is not None:
                     # step boundary: a stall detected mid-step surfaces
                     # as HangError HERE (abort_on_hang), where state is
@@ -1014,6 +1197,14 @@ class Trainer:
                     wd.disarm()
                 saved = False
                 if mgr is not None:
+                    # verdict-before-durability: a checkpoint must never
+                    # commit a step whose guard/SDC verdict is still in
+                    # flight — drain the ring first so the abort raises
+                    # BEFORE the save, exactly as the unpipelined loop
+                    # ordered it (no-op at dispatch_depth=1, and on
+                    # non-writing steps via the should_save probe)
+                    if self.pending and mgr.should_save(step_idx + 1):
+                        _drain_all()
                     # label = completed-step count == state.step after
                     # this step; the loader's durable state rides along
                     # (callable: only materialised on steps that write)
@@ -1035,8 +1226,13 @@ class Trainer:
                     # blocking emergency save (Orbax emergency-checkpoint
                     # pattern): make the just-completed step durable, then
                     # return cleanly — the grace window is for saving,
-                    # not for more steps
+                    # not for more steps.  Same verdict-before-durability
+                    # ordering as interval saves: the in-flight steps'
+                    # device work is already done, so resolving them
+                    # costs fetches, not step time.  Eval is suppressed
+                    # — the grace window must not fund an eval pass
                     if not saved:
+                        _drain_all(allow_eval=False)
                         mgr.save(step_idx + 1, self.state, force=True,
                                  loader_state=loader_state_fn,
                                  guard_state=guard_state_fn)
@@ -1052,6 +1248,14 @@ class Trainer:
                         f"step {step_idx + 1} is durable; stopping fit "
                         "(resume with fit(resume='auto'))")
                     break
+            # drain the dispatch pipeline: the final k in-flight steps
+            # still owe their guard/SDC verdicts and log records — a
+            # run must never end (or hand off to a preemption restart)
+            # with unresolved anomalies.  Exception exits skip this: an
+            # abort raise discards younger in-flight steps (their
+            # updates are past the abort point and no checkpoint
+            # committed them), and a hung device cannot be drained.
+            _drain_all()
         finally:
             if wd is not None:
                 wd.close()
@@ -1126,7 +1330,7 @@ class Trainer:
                 self._ensure_guard()
             mon = self._ensure_sdc_monitor()
             si = int(self.state.step)
-            self._sdc_host_step = si
+            self._host_step = si
             runs = []
             for where in ("step", "recompute"):
                 args = [_snapshot(self.state), batch]

@@ -22,6 +22,7 @@ Usage::
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -31,6 +32,45 @@ from typing import Dict, Optional, Union
 from torchacc_tpu.utils.logger import logger
 
 Number = Union[int, float]
+
+
+class BlockedMeter:
+    """Host-blocked wall-time accumulator — the ``host_blocked_ms`` seam.
+
+    The hot loop's enemy is the host *waiting on the device*: every
+    ``int()``/``float()``/``device_get`` of a step output serialises
+    dispatch behind execution.  The trainer wraps each such fetch in
+    :meth:`blocked`; ``take_ms()`` pops the accumulated total, so every
+    step record quantifies exactly how much host-blocked time its
+    interval paid (docs/performance.md "host_blocked_ms triage").  With
+    dispatch pipelining (``perf.dispatch_depth > 1``) the fetches hit
+    already-completed values and the number collapses toward the
+    transfer cost alone.
+
+    Not thread-safe by design: all metered fetches happen on the
+    trainer's thread (the async-loader producer never touches it).
+    """
+
+    __slots__ = ("_acc",)
+
+    def __init__(self):
+        self._acc = 0.0
+
+    @contextlib.contextmanager
+    def blocked(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._acc += time.perf_counter() - t0
+
+    def peek_ms(self) -> float:
+        return self._acc * 1e3
+
+    def take_ms(self) -> float:
+        """Pop the accumulated blocked time (ms) since the last take."""
+        v, self._acc = self._acc * 1e3, 0.0
+        return v
 
 
 class Counters:
