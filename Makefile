@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -30,6 +30,13 @@ bench:
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --fast --platform cpu --iters 2
 
+# serving gate (docs/serving.md): drive the continuous-batching engine
+# on a mixed-length staggered workload on CPU; reports tokens/s + TTFT
+# and per-token latency percentiles, and FAILS unless greedy outputs
+# are token-identical to batch-synchronous generate()
+serve-smoke:
+	JAX_PLATFORMS=cpu python bench.py --serve --fast --platform cpu
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -40,7 +47,8 @@ chaos:
 		echo "== chaos seed $$s =="; \
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
 			tests/test_watchdog.py tests/test_elastic.py \
-			tests/test_sdc.py tests/test_perf.py -m "not slow" \
+			tests/test_sdc.py tests/test_perf.py \
+			tests/test_serving.py -m "not slow" \
 			-q || exit 1; \
 	done
 

@@ -341,18 +341,84 @@ class PerfConfig:
     guarantee-vs-latency trade-off per resilience feature.
     """
 
-    # How many train steps the host may keep in flight.  1 (default)
-    # resolves every step immediately — bitwise-identical records,
-    # aborts and SDC verdicts to the pre-pipelining behaviour.  k =
+    # How many train steps the host may keep in flight.  1 resolves
+    # every step immediately — bitwise-identical records, aborts and
+    # SDC verdicts to the pre-pipelining behaviour.  k =
     # dispatch_depth - 1 is the verdict lag: guard abort-after-N becomes
     # abort-within-N+k, SDC verdicts for step S land while step S+k is
-    # in flight.  2 already hides one full dispatch latency; deeper
-    # pipelines only help when dispatch/trace time exceeds a step time.
-    dispatch_depth: int = 1
+    # in flight.  The default of 2 hides one full dispatch latency
+    # (bitwise depth-invariant trajectories/params — proven by the PR-5
+    # burn-in, tests/test_perf.py); deeper pipelines only help when
+    # dispatch/trace time exceeds a step time.  Set 1 to restore
+    # immediate per-step verdicts.
+    dispatch_depth: int = 2
 
     def validate(self) -> None:
         _check(self.dispatch_depth >= 1,
                "perf.dispatch_depth must be >= 1")
+
+
+@dataclass
+class ServeConfig:
+    """Serving engine policy (torchacc_tpu/serve/, docs/serving.md).
+
+    The training side of the framework mirrors the reference; serving is
+    native: a paged KV cache (fixed-size blocks in a preallocated pool,
+    per-sequence block tables — vLLM's PagedAttention layout expressed
+    as JAX arrays), a continuous-batching scheduler that admits new
+    requests into free decode slots every iteration and interleaves
+    chunked prefill with decode, and a request front-end with admission
+    control against KV-pool headroom + per-request SLO metrics.  See
+    docs/serving.md for the tuning table.
+    """
+
+    # tokens per KV block.  Small blocks waste less memory on the last
+    # partial block per sequence; large blocks mean fewer gather steps
+    # per attention call.  On real TPU the Pallas paged-attention kernel
+    # wants a multiple of 128 (lane dim); the jnp fallback takes any
+    # value (CPU tests use 8-16).
+    block_size: int = 16
+    # blocks in the pool.  Per-layer KV bytes = num_blocks * block_size
+    # * kv_heads * head_dim * 2 (k+v) * dtype_bytes.  Block 0 is
+    # reserved as the null block (inactive slots write there), so the
+    # usable pool is num_blocks - 1.
+    num_blocks: int = 512
+    # max sequences decoding in one batched step (the decode batch is a
+    # fixed [max_slots] program; free slots run masked on the null
+    # block).  Raise until decode step time stops improving — decode is
+    # parameter-bandwidth-bound, so batching is nearly free until the
+    # MXU saturates.
+    max_slots: int = 8
+    # chunked prefill: tokens of ONE sequence prefilled per engine
+    # iteration, interleaved with the decode step so a long prompt
+    # never stalls in-flight decodes for its whole length.
+    prefill_chunk: int = 64
+    # 'fcfs' (arrival order) | 'sjf' (shortest prompt first — better
+    # mean TTFT under mixed lengths, can starve long prompts)
+    policy: str = "fcfs"
+    # engine iterations the host may keep in flight before reading
+    # tokens back (the PR-5 lagged-readback ring applied to decode):
+    # the sampled-token feedback loop stays ON DEVICE between
+    # iterations, the host reads iteration i's tokens while i+k is
+    # dispatching.  1 = resolve every iteration immediately.
+    decode_depth: int = 2
+    # default per-request new-token cap (requests may set their own)
+    max_new_tokens: int = 128
+    # bound on the admission queue; submit() raises when full
+    max_queue: int = 4096
+
+    def validate(self) -> None:
+        _check(self.block_size >= 1, "serve.block_size must be >= 1")
+        _check(self.num_blocks >= 2,
+               "serve.num_blocks must be >= 2 (block 0 is the reserved "
+               "null block)")
+        _check(self.max_slots >= 1, "serve.max_slots must be >= 1")
+        _check(self.prefill_chunk >= 1, "serve.prefill_chunk must be >= 1")
+        _check(self.policy in ("fcfs", "sjf"),
+               f"serve.policy must be fcfs|sjf, got {self.policy}")
+        _check(self.decode_depth >= 1, "serve.decode_depth must be >= 1")
+        _check(self.max_new_tokens >= 1, "serve.max_new_tokens must be >= 1")
+        _check(self.max_queue >= 1, "serve.max_queue must be >= 1")
 
 
 @dataclass
@@ -594,6 +660,7 @@ class Config:
     dist: DistConfig = field(default_factory=DistConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     perf: PerfConfig = field(default_factory=PerfConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
     # Gradient accumulation micro-steps per optimizer step (non-PP path;
     # under PP the pipeline's num_micro_batches plays this role).
     grad_accum: int = 1
@@ -608,6 +675,7 @@ class Config:
         self.dist.validate()
         self.resilience.validate()
         self.perf.validate()
+        self.serve.validate()
         _check(self.grad_accum >= 1, "grad_accum must be >= 1")
 
     # -- mesh ---------------------------------------------------------------
@@ -672,6 +740,7 @@ _TYPE_MAP = {
     "dist": DistConfig,
     "resilience": ResilienceConfig,
     "perf": PerfConfig,
+    "serve": ServeConfig,
     "dp": DPConfig,
     "tp": TPConfig,
     "fsdp": FSDPConfig,

@@ -27,10 +27,15 @@ def _sample(logits, rng, temperature, top_k=0, top_p=1.0):
     if temperature <= 0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
-    if top_k and top_k > 0:
-        # k-th largest as the cutoff (O(V log k), not a full sort)
-        kth = jax.lax.top_k(logits, min(top_k, logits.shape[-1]))[0][
-            ..., -1:]
+    if top_k and 0 < top_k < logits.shape[-1]:
+        # k-th largest as the cutoff (O(V log k), not a full sort).
+        # top_k >= vocab is a no-op by definition (the k-th largest is
+        # the global min, so nothing is truncated) — skip the full-width
+        # lax.top_k sort entirely rather than pay O(V log V) to mask
+        # nothing.  Serving replays rely on top_k=V and top_k=0 tracing
+        # to the SAME program, so the sampled stream cannot drift on
+        # the guard.
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
         # keep the smallest prefix of descending-prob tokens with

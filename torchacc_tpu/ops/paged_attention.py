@@ -1,0 +1,266 @@
+"""Block-table (paged) attention for the serving engine.
+
+The serving KV cache (torchacc_tpu/serve/kv_cache.py) stores keys and
+values in fixed-size BLOCKS inside one preallocated pool; each sequence
+owns a BLOCK TABLE mapping its logical positions to pool blocks.  This
+module computes attention of per-slot queries over that paged layout —
+the vLLM PagedAttention computation expressed TPU-natively:
+
+- ``_paged_attention_pallas``: a Pallas TPU kernel (one program per
+  (slot, q head, kv block); the block table + context lengths ride as
+  scalar-prefetch operands so each grid step's BlockSpec index map can
+  address the pool block directly — no gather materialisation in HBM).
+  Online softmax over the block sweep, exactly the flash-attention
+  decomposition used by ops/flash_attention.py.
+- ``_paged_attention_xla``: a pure-jnp gather fallback, numerically
+  matched to ops/attention.attention_reference (f32 scores, NEG_INF
+  mask, masked probabilities zeroed) — the correctness anchor the
+  kernel is tested against and the path CPU runs take.
+
+``impl`` selection follows ops/attn.py: 'auto' = pallas on TPU, xla
+elsewhere; 'pallas' forces the kernel (interpret mode off-TPU);
+'xla' forces the fallback.
+
+Geometry: queries are ``[S, T, H, D]`` — S slots, T tokens per slot
+(T=1 for decode, T=chunk for chunked prefill), already rope-rotated.
+The pool is ``[NB, BS, KH, D]`` (blocks, block size, kv heads, head
+dim) per layer.  ``context_lens[s]`` counts ALL banked tokens of slot s
+including the T chunk tokens (the cache write happens before the
+attention call), and ``q_start[s]`` is the global position of the
+slot's first query row — causality is ``kv_pos <= q_start + t``.
+Slots with ``context_lens == 0`` (free slots parked on the null block)
+produce all-zero outputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from torchacc_tpu.ops._common import NEG_INF, interpret_mode as _interpret
+from torchacc_tpu.ops._common import on_tpu as _on_tpu
+
+
+def _repeat_kv_heads(x: jax.Array, num_q_heads: int) -> jax.Array:
+    """[.., KH, D] -> [.., H, D] for GQA/MQA (same broadcast as
+    ops/attention._repeat_kv, axis adjusted for the paged layout)."""
+    kh = x.shape[-2]
+    if kh == num_q_heads:
+        return x
+    assert num_q_heads % kh == 0, (num_q_heads, kh)
+    return jnp.repeat(x, num_q_heads // kh, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# jnp gather fallback (the correctness anchor; runs everywhere)
+# ---------------------------------------------------------------------------
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "logit_softcap"))
+def _paged_attention_xla(q, k_pool, v_pool, block_tables, context_lens,
+                         q_start, scale, window, logit_softcap):
+    s_, t_, h, d = q.shape
+    nb, bs, kh, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    # gather each slot's pages into a dense [S, MB*BS, ...] view; the
+    # pool read is O(S * MB * BS) — fine for the fallback, the kernel
+    # never materialises this
+    k = k_pool[block_tables].reshape(s_, mb * bs, kh, d)
+    v = v_pool[block_tables].reshape(s_, mb * bs, kh, d)
+    k = _repeat_kv_heads(k, h)
+    v = _repeat_kv_heads(v, h)
+    scores = jnp.einsum("sthd,skhd->shtk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if logit_softcap > 0.0:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+    kv_pos = jnp.arange(mb * bs, dtype=jnp.int32)            # [K]
+    q_pos = q_start[:, None] + jnp.arange(t_, dtype=jnp.int32)  # [S, T]
+    mask = kv_pos[None, None, :] < context_lens[:, None, None]
+    mask &= kv_pos[None, None, :] <= q_pos[:, :, None]
+    left, right = window
+    if left >= 0:
+        mask &= kv_pos[None, None, :] >= q_pos[:, :, None] - left
+    if right >= 0:
+        mask &= kv_pos[None, None, :] <= q_pos[:, :, None] + right
+    mask = mask[:, None, :, :]                               # [S, 1, T, K]
+    scores = jnp.where(mask, scores, NEG_INF)
+    lse = jax.nn.logsumexp(scores, axis=-1)
+    probs = jnp.where(mask, jnp.exp(scores - lse[..., None]), 0.0)
+    out = jnp.einsum("shtk,skhd->sthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+def _paged_fwd_kernel(tbl_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                      m_scr, l_scr, acc_scr,
+                      *, scale, block_size, t_len, num_kv_blocks,
+                      window, logit_softcap):
+    si = pl.program_id(0)
+    bi = pl.program_id(2)
+
+    @pl.when(bi == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx = lens_ref[si, 0]
+    q0 = lens_ref[si, 1]
+    k_start = bi * block_size
+
+    @pl.when(k_start < ctx)
+    def _compute():
+        q = q_ref[0, 0, :, :]                               # [T, D]
+        k = k_ref[0, :, 0, :]                               # [BS, D]
+        v = v_ref[0, :, 0, :]                               # [BS, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [T, BS]
+        if logit_softcap > 0.0:
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
+        kv_pos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (t_len, block_size), 1)
+        q_pos = q0 + jax.lax.broadcasted_iota(
+            jnp.int32, (t_len, block_size), 0)
+        mask = (kv_pos < ctx) & (kv_pos <= q_pos)
+        left, right = window
+        if left >= 0:
+            mask &= kv_pos >= q_pos - left
+        if right >= 0:
+            mask &= kv_pos <= q_pos + right
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev == NEG_INF, 0.0, alpha)
+        l_scr[...] = jnp.broadcast_to(
+            (alpha * l_scr[:, 0] + jnp.sum(p, axis=1))[:, None],
+            l_scr.shape)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+
+    @pl.when(bi == num_kv_blocks - 1)
+    def _finalize():
+        l = l_scr[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0, :, :] = (acc_scr[...] / l_safe[:, None]).astype(
+            o_ref.dtype)
+
+
+_LANES = 128
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, block_tables, context_lens,
+                            q_start, scale, window, logit_softcap):
+    s_, t_, h, d = q.shape
+    nb, bs, kh, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    group = h // kh
+    # lens = [S, 2] (context_len, q_start) scalar-prefetch operand; the
+    # block table prefetches alongside so every BlockSpec index map can
+    # address the pool block for (slot, kv-block) before the body runs
+    lens = jnp.stack([context_lens.astype(jnp.int32),
+                      q_start.astype(jnp.int32)], axis=1)
+    qT = q.swapaxes(1, 2)                                   # [S, H, T, D]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_, h, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, t_, d),
+                         lambda s, hh, b, tbl, lens: (s, hh, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda s, hh, b, tbl, lens:
+                         (tbl[s, b], 0, hh // group, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda s, hh, b, tbl, lens:
+                         (tbl[s, b], 0, hh // group, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t_, d),
+                               lambda s, hh, b, tbl, lens: (s, hh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((t_, _LANES), jnp.float32),
+            pltpu.VMEM((t_, _LANES), jnp.float32),
+            pltpu.VMEM((t_, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_fwd_kernel, scale=scale, block_size=bs, t_len=t_,
+        num_kv_blocks=mb, window=window, logit_softcap=logit_softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(qT.shape, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lens, qT, k_pool, v_pool)
+    return out.swapaxes(1, 2)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def paged_attention(
+    q: jax.Array,
+    k_pool: jax.Array,
+    v_pool: jax.Array,
+    block_tables: jax.Array,
+    context_lens: jax.Array,
+    q_start: jax.Array,
+    *,
+    scale: Optional[float] = None,
+    window: Tuple[int, int] = (-1, -1),
+    logit_softcap: float = 0.0,
+    impl: str = "auto",
+) -> jax.Array:
+    """Causal attention of ``q [S, T, H, D]`` over a paged KV pool.
+
+    ``k_pool``/``v_pool``: [num_blocks, block_size, kv_heads, head_dim]
+    (one layer's pool).  ``block_tables [S, MB]`` maps slot-s logical
+    block j to a pool block; ``context_lens [S]`` is the total banked
+    length per slot (chunk included); ``q_start [S]`` the global
+    position of each slot's first query row.  Returns [S, T, H, D];
+    slots with ``context_lens == 0`` return zeros.
+
+    ``impl``: 'auto' (pallas on TPU, xla elsewhere) | 'pallas'
+    (interpret mode off-TPU) | 'xla'.
+    """
+    if q.ndim != 4:
+        raise ValueError(f"q must be [slots, t, heads, head_dim], got "
+                         f"{q.shape}")
+    if k_pool.shape != v_pool.shape:
+        raise ValueError(f"k_pool {k_pool.shape} != v_pool {v_pool.shape}")
+    s_, t_, h, d = q.shape
+    kh = k_pool.shape[2]
+    if h % kh != 0:
+        raise ValueError(
+            f"num q heads ({h}) must be a multiple of kv heads ({kh})")
+    if block_tables.shape[0] != s_ or context_lens.shape != (s_,):
+        raise ValueError(
+            f"block_tables {block_tables.shape} / context_lens "
+            f"{context_lens.shape} do not match {s_} slots")
+    if scale is None:
+        scale = d ** -0.5
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    fn = (_paged_attention_pallas if impl == "pallas"
+          else _paged_attention_xla)
+    if impl not in ("pallas", "xla"):
+        raise ValueError(f"impl must be auto|pallas|xla, got {impl!r}")
+    return fn(q, k_pool, v_pool, block_tables.astype(jnp.int32),
+              context_lens.astype(jnp.int32), q_start.astype(jnp.int32),
+              float(scale), tuple(window), float(logit_softcap))

@@ -1,0 +1,36 @@
+"""Serving subsystem: paged KV cache + continuous batching + front-end.
+
+The training side of this framework mirrors the reference (TorchAcc is
+training-only; its accuracy benchmark shells out to vLLM for
+inference).  Serving here is native:
+
+- :mod:`torchacc_tpu.serve.kv_cache` — fixed-size KV blocks in a
+  preallocated pool with per-sequence block tables (the vLLM
+  PagedAttention memory layout as JAX arrays) and the host-side block
+  allocator.
+- :mod:`torchacc_tpu.serve.scheduler` — the continuous-batching
+  scheduler: a stateless jitted decode step over (params, pools, slot
+  state), chunked prefill interleaved with decode, and a
+  lagged-readback ring (the PR-5 dispatch-pipelining pattern) so
+  per-token host sync stays off the critical path.
+- :mod:`torchacc_tpu.serve.engine` — the request front-end: queue,
+  admission control against KV-pool headroom, per-request SLO metrics
+  (TTFT, per-token latency, queue wait) riding utils/metrics.
+
+See docs/serving.md for architecture + tuning.
+"""
+
+from torchacc_tpu.serve.engine import Request, RequestResult, ServeEngine
+from torchacc_tpu.serve.kv_cache import BlockPool, blocks_needed, make_pools
+from torchacc_tpu.serve.scheduler import PagedDecoder, Scheduler
+
+__all__ = [
+    "BlockPool",
+    "PagedDecoder",
+    "Request",
+    "RequestResult",
+    "Scheduler",
+    "ServeEngine",
+    "blocks_needed",
+    "make_pools",
+]

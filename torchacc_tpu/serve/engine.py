@@ -1,0 +1,354 @@
+"""Request-level serving front-end: queue, admission control, SLO metrics.
+
+``ServeEngine`` is the surface a serving binary drives:
+
+    engine = ServeEngine(model, params, config)
+    rid = engine.submit(Request(prompt_ids=[...], max_new_tokens=64))
+    while engine.step():
+        ...                       # or engine.run() / engine.generate()
+    result = engine.result(rid)   # tokens + per-request SLO metrics
+
+Admission control: a request enters a decode slot only when the block
+pool has headroom for its WHOLE reservation (prompt + max_new +
+in-flight overhang, scheduler.blocks_for) — a sequence admitted is a
+sequence that can always finish; there is no mid-decode OOM or
+preemption path to handle.  Until then it waits in the queue
+(``serve.policy``: 'fcfs' arrival order, 'sjf' shortest prompt first).
+
+Per-request SLO metrics (each ``RequestResult``): queue wait, TTFT
+(submit -> first token RESOLVED on the host — readback lag included,
+it is real user-visible latency), per-token inter-arrival latencies,
+and tokens/s.  Aggregates ride ``utils/metrics``: the shared Counters
+(serve_requests_completed, serve_tokens_generated) and an optional
+MetricsWriter (``metrics_dir=``) receiving one record per completed
+request — the same observability seam the trainer uses.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence as Seq
+
+import numpy as np
+
+from torchacc_tpu.config import Config
+from torchacc_tpu.serve.scheduler import Scheduler, Sequence
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import BlockedMeter, counters, open_metrics
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  Sampling params default to greedy."""
+
+    prompt_ids: Seq[int]
+    max_new_tokens: Optional[int] = None     # None = config.serve default
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Tokens + the per-request SLO metrics (docs/serving.md)."""
+
+    request_id: int
+    prompt_ids: List[int]
+    tokens: List[int]                        # generated tokens only
+    finish_reason: str                       # 'eos' | 'length'
+    queue_wait_s: float                      # submit -> slot admission
+    ttft_s: float                            # submit -> first token
+    total_s: float                           # submit -> finish
+    token_latencies_s: List[float]           # inter-token gaps
+    tokens_per_sec: float
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+class ServeEngine:
+    """Continuous-batching serving engine over a paged KV cache.
+
+    Parameters
+    ----------
+    model: a zoo ``TransformerLM`` (or its ``ModelConfig``)
+    params: the model's param tree (cast to serving precision by the
+        caller — see examples/serve.py)
+    config: the framework :class:`Config`; ``config.serve`` is the
+        tuning block
+    mesh: optional device mesh entered around every dispatch so the
+        pool/param shardings resolve (single-chip runs omit it)
+    metrics_dir: optional MetricsWriter directory for per-request
+        SLO records
+    """
+
+    def __init__(self, model, params, config: Optional[Config] = None,
+                 mesh=None, metrics_dir: Optional[str] = None):
+        cfg = getattr(model, "cfg", model)
+        config = config or Config()
+        config.serve.validate()
+        self.cfg = cfg
+        self.config = config
+        self.mesh = mesh
+        self.blocked = BlockedMeter()
+        with self._mesh_ctx():
+            self.scheduler = Scheduler(cfg, params, config.serve,
+                                       attention_impl=cfg.attention_impl,
+                                       blocked=self.blocked)
+        self._queue: "collections.deque[Sequence]" = collections.deque()
+        self._all: Dict[int, Sequence] = {}
+        self._next_id = 0
+        self._metrics = open_metrics(metrics_dir)
+        self._completed = 0
+        self._agg = self._fresh_agg()
+
+    @staticmethod
+    def _fresh_agg() -> Dict:
+        return {"ttft": [], "waits": [], "gaps": [], "tokens": 0,
+                "requests": 0, "t0": None, "t1": None}
+
+    def _mesh_ctx(self):
+        import contextlib
+        import jax
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        return jax.sharding.set_mesh(self.mesh)
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, req: Request) -> int:
+        """Queue a request; returns its id.  Raises when the request
+        can NEVER be served (pool too small, position table exceeded)
+        or the queue is full — fail at the front door, not mid-decode."""
+        prompt = np.asarray(list(req.prompt_ids), np.int32)
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError("prompt_ids must be a non-empty 1-D sequence")
+        max_new = (req.max_new_tokens
+                   if req.max_new_tokens is not None
+                   else self.config.serve.max_new_tokens)
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new} (a decode "
+                f"slot always generates at least one token)")
+        serve = self.config.serve
+        seq = Sequence(sid=self._next_id, prompt=prompt, max_new=max_new,
+                       temperature=req.temperature, top_k=req.top_k,
+                       top_p=req.top_p, eos_id=req.eos_id, seed=req.seed)
+        need = self.scheduler.blocks_for(seq)
+        if need > self.scheduler.max_blocks_per_seq:
+            raise ValueError(
+                f"request needs {need} KV blocks (prompt "
+                f"{prompt.shape[0]} + max_new {max_new}) but a sequence "
+                f"may own at most {self.scheduler.max_blocks_per_seq} "
+                f"(min of pool size serve.num_blocks - 1 and the model's "
+                f"position reach max_seq_len); raise serve.num_blocks / "
+                f"the model max_seq_len or lower max_new_tokens")
+        total = prompt.shape[0] + max_new
+        if self.cfg.pos_emb == "learned" and total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the learned "
+                f"position table max_seq_len {self.cfg.max_seq_len}")
+        if len(self._queue) >= serve.max_queue:
+            raise RuntimeError(
+                f"admission queue full ({serve.max_queue}); shed load "
+                f"upstream or raise serve.max_queue")
+        seq.t_submit = time.monotonic()
+        self._next_id += 1
+        self._all[seq.sid] = seq
+        self._queue.append(seq)
+        counters.inc("serve_requests_submitted")
+        return seq.sid
+
+    # -- the loop -----------------------------------------------------------
+
+    def _admit(self) -> None:
+        """Move queue entries into free slots while headroom lasts.
+        'sjf' reorders by prompt length (better mean TTFT under mixed
+        lengths); 'fcfs' preserves arrival order.  Admission stops at
+        the first request that does not fit — sjf may skip past it
+        only when a shorter request fits in the remaining headroom."""
+        if not self._queue or self.scheduler.free_slot() is None:
+            # at capacity: don't copy/sort the (possibly thousands
+            # deep) queue on the per-token hot loop when nothing can
+            # possibly admit
+            return
+        if self.config.serve.policy == "fcfs":
+            # fcfs admits only from the head — O(1) early exit
+            if not self.scheduler.can_admit(self._queue[0]):
+                return
+        elif not self.scheduler.pool.can_alloc(
+                min(self.scheduler.blocks_for(s) for s in self._queue)):
+            # sjf: one O(Q) min beats the O(Q log Q) sort + scan when
+            # even the cheapest reservation cannot fit
+            return
+        order = list(self._queue)
+        if self.config.serve.policy == "sjf":
+            order.sort(key=lambda s: (s.prompt_len, s.sid))
+        admitted = []
+        for seq in order:
+            if not self.scheduler.can_admit(seq):
+                if self.config.serve.policy == "fcfs":
+                    break
+                continue
+            self.scheduler.admit(seq)
+            admitted.append(seq)
+            counters.inc("serve_requests_admitted")
+        for seq in admitted:
+            self._queue.remove(seq)
+
+    def step(self) -> bool:
+        """One engine iteration (admission + scheduler.step + completion
+        accounting).  Returns True while there is work anywhere."""
+        self._admit()
+        with self._mesh_ctx():
+            self.scheduler.step()
+        self._drain_events()
+        # scheduler.busy() == False already implies the ring drained
+        # (an empty slot table with entries in flight is impossible:
+        # eviction only happens at resolution), so nothing to flush
+        return bool(self._queue) or self.scheduler.busy()
+
+    def run(self, max_iters: int = 1_000_000) -> None:
+        """Drive until every submitted request completed."""
+        idle = 0
+        for _ in range(max_iters):
+            if not self.step():
+                return
+            # defensive no-progress detection: queued work that can
+            # never admit while nothing is running is a config error
+            if (self._queue and not self.scheduler.busy()):
+                idle += 1
+                if idle > 3:
+                    raise RuntimeError(
+                        "serving stalled: queued requests cannot be "
+                        "admitted and no sequence is running (pool "
+                        "fragmentation should be impossible — report)")
+            else:
+                idle = 0
+        raise RuntimeError(f"run() exceeded {max_iters} iterations")
+
+    def generate(self, requests: List[Request]) -> List[RequestResult]:
+        """Convenience batch API: submit everything, run to completion,
+        return results in submission order."""
+        ids = [self.submit(r) for r in requests]
+        self.run()
+        return [self.result(i) for i in ids]
+
+    # -- results / metrics --------------------------------------------------
+
+    def _drain_events(self) -> None:
+        """Account every sequence the scheduler finished since the last
+        drain — O(newly finished), never a scan over every request the
+        engine has ever served."""
+        fin = self.scheduler.finished
+        while fin:
+            seq = fin.pop()
+            self._completed += 1
+            counters.inc("serve_requests_completed")
+            counters.inc("serve_tokens_generated", len(seq.out_tokens))
+            # SLO aggregates accumulate HERE, at completion — stats()
+            # stays correct for long-running servers that pop/discard
+            # results to bound memory (the aggregate sample lists grow
+            # with completed tokens; reset_stats() starts a fresh
+            # window)
+            a = self._agg
+            a["requests"] += 1
+            a["tokens"] += len(seq.out_tokens)
+            a["ttft"].append(max(seq.t_first_token - seq.t_submit, 0.0))
+            a["waits"].append(max(seq.t_admit - seq.t_submit, 0.0))
+            a["gaps"].extend(b - x for x, b in
+                             zip(seq.token_times, seq.token_times[1:]))
+            a["t0"] = (seq.t_submit if a["t0"] is None
+                       else min(a["t0"], seq.t_submit))
+            a["t1"] = (seq.t_finish if a["t1"] is None
+                       else max(a["t1"], seq.t_finish))
+            if self._metrics is not None:
+                r = self.result(seq.sid)
+                self._metrics.log(self._completed, {
+                    "serve/ttft_s": r.ttft_s,
+                    "serve/queue_wait_s": r.queue_wait_s,
+                    "serve/total_s": r.total_s,
+                    "serve/tokens": len(r.tokens),
+                    "serve/tokens_per_sec": r.tokens_per_sec,
+                })
+
+    def result(self, request_id: int, pop: bool = False) -> RequestResult:
+        """The finished request's tokens + SLO metrics.  ``pop=True``
+        also releases the engine's record of the request — long-running
+        servers must pop (or call :meth:`discard`) or completed-request
+        state accumulates for the process lifetime."""
+        seq = self._all[request_id]
+        if not seq.finished:
+            raise RuntimeError(f"request {request_id} not finished")
+        gaps = [b - a for a, b in zip(seq.token_times, seq.token_times[1:])]
+        total = max(seq.t_finish - seq.t_submit, 1e-9)
+        r = RequestResult(
+            request_id=request_id,
+            prompt_ids=[int(t) for t in seq.prompt],
+            tokens=list(seq.out_tokens),
+            finish_reason=seq.finish_reason,
+            queue_wait_s=max(seq.t_admit - seq.t_submit, 0.0),
+            ttft_s=max(seq.t_first_token - seq.t_submit, 0.0),
+            total_s=total,
+            token_latencies_s=gaps,
+            tokens_per_sec=len(seq.out_tokens) / total,
+        )
+        if pop:
+            del self._all[request_id]
+        return r
+
+    def discard(self, request_id: int) -> None:
+        """Drop a finished request's record without building the
+        result (the pop=False counterpart for fire-and-forget calls)."""
+        seq = self._all[request_id]
+        if not seq.finished:
+            raise RuntimeError(f"request {request_id} not finished")
+        del self._all[request_id]
+
+    def stats(self) -> Dict[str, float]:
+        """Aggregate SLO view over every request completed since the
+        engine started (or the last :meth:`reset_stats`) — the
+        ``make serve-smoke`` / bench --serve payload.  Accumulated at
+        completion time, so popping/discarding results (the documented
+        long-running-server hygiene) never shrinks the aggregates."""
+        a = self._agg
+        if not a["requests"]:
+            return {"requests": 0}
+        return {
+            "requests": a["requests"],
+            "tokens": a["tokens"],
+            "tokens_per_sec": a["tokens"] / max(a["t1"] - a["t0"], 1e-9),
+            # host time spent blocked on token readback since engine
+            # construction / reset_stats — collapses toward transfer
+            # cost alone when decode_depth > 1 (the lagged ring reads
+            # completed values)
+            "host_blocked_ms": self.blocked.peek_ms(),
+            "ttft_s_p50": _percentile(a["ttft"], 50),
+            "ttft_s_p95": _percentile(a["ttft"], 95),
+            "queue_wait_s_p50": _percentile(a["waits"], 50),
+            "queue_wait_s_p95": _percentile(a["waits"], 95),
+            "per_token_s_p50": _percentile(a["gaps"], 50),
+            "per_token_s_p95": _percentile(a["gaps"], 95),
+        }
+
+    def reset_stats(self) -> None:
+        """Start a fresh stats() window and zero the blocked-time
+        meter — call after warmup so compile waits and warmup requests
+        never pollute the reported SLOs (bench.py --serve does)."""
+        self._agg = self._fresh_agg()
+        self.blocked.take_ms()
+
+    def close(self) -> None:
+        self.scheduler.drain()
+        self._drain_events()
+        if self._metrics is not None:
+            self._metrics.close()
+        if self._queue:
+            logger.warning(
+                f"ServeEngine closed with {len(self._queue)} queued "
+                f"requests unserved")

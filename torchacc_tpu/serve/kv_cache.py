@@ -1,0 +1,102 @@
+"""Paged KV cache: the preallocated block pool + host-side allocator.
+
+Memory layout (the vLLM PagedAttention idea expressed as JAX arrays):
+ONE pool per layer of shape ``[num_blocks, block_size, kv_heads,
+head_dim]`` for keys and the same for values, stacked over layers into
+``[L, NB, BS, KH, D]``.  A sequence's cache is a list of blocks named
+by its BLOCK TABLE; sequences of wildly different lengths share the
+pool with at most ``block_size - 1`` wasted slots each, and a finished
+sequence's blocks return to the free list as soon as every in-flight
+iteration that could still write through its table has resolved (at
+most ``decode_depth - 1`` iterations — scheduler._release_matured) —
+no ``[batch, max_len]`` padding anywhere.
+
+Block 0 is the NULL BLOCK: free decode slots (and masked-out prefill
+tail tokens) write their garbage k/v there, so the jitted step needs
+no write masking — the standard trick.  It is never handed out by the
+allocator.
+
+The allocator is deliberately host-side and synchronous: allocation
+decisions happen at admission time (serve/engine.py), outside the
+jitted hot path, exactly like the trainer's host/device split
+(train/trainer.py dispatch vs resolution).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+def blocks_needed(num_tokens: int, block_size: int) -> int:
+    """Blocks required to hold ``num_tokens`` cache slots."""
+    return -(-max(num_tokens, 0) // block_size)
+
+
+class BlockPool:
+    """Free-list allocator over pool blocks 1..num_blocks-1.
+
+    Invariants (tested in tests/test_serving.py):
+    - block 0 (the null block) is never allocated;
+    - a block is owned by at most one caller at a time (no aliasing);
+    - ``free`` of a block not currently allocated raises (double-free /
+      foreign-block detection);
+    - ``available + len(allocated) == num_blocks - 1`` always (no leak).
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (block 0 is reserved), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return len(self._allocated)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` blocks, or None when the pool lacks headroom (the
+        admission-control signal — never a partial grant)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        return blocks
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(
+                    f"free of block {b} which is not allocated (double "
+                    f"free, or a block this pool never handed out)")
+            self._allocated.remove(b)
+            self._free.append(b)
+
+
+def make_pools(model_cfg, serve_cfg, dtype=None):
+    """(k_pools, v_pools) of shape [L, NB, BS, KH, D] in the model's
+    compute dtype, kv heads sharded over 'tp' when a mesh is live (the
+    same activation-constraint seam the model layers use, so the TP
+    head composes — parallel/sharding.py)."""
+    from torchacc_tpu.parallel.sharding import activation_constraint
+
+    shape = (model_cfg.num_layers, serve_cfg.num_blocks,
+             serve_cfg.block_size, model_cfg.kv_heads,
+             model_cfg.head_size)
+    dt = dtype or model_cfg.dtype
+    axes = (None, None, None, "heads", None)
+    k = activation_constraint(jnp.zeros(shape, dt), axes)
+    v = activation_constraint(jnp.zeros(shape, dt), axes)
+    return k, v

@@ -1,0 +1,672 @@
+"""Continuous-batching scheduler over the paged KV cache.
+
+The design mirrors the PR-5 trainer split (train/trainer.py): a
+STATELESS JITTED device step over (params, pools, slot state) and a
+HOST-SIDE loop that owns every decision — admission into free slots,
+which sequence prefills this iteration, eviction of finished sequences,
+block free/reuse.  Three compiled programs cover any request mix:
+
+- ``decode_step``: one token for every slot in one batched program.
+  Sampling runs ON DEVICE with per-slot traced (temperature, top_k,
+  top_p), and the sampled tokens feed the next iteration's input as a
+  device array — the token feedback loop never touches the host.
+- ``prefill_chunk``: ``serve.prefill_chunk`` tokens of ONE sequence
+  (padded; the pad tail writes to the null block), interleaved with
+  decode so a long prompt never stalls in-flight decodes.
+- ``sample_first`` / ``set_slot``: sample the first token from the
+  final prefill chunk's logits and splice it into the decode carry —
+  tiny jitted ops, no readback.
+
+Host reads happen only at lag ``serve.decode_depth - 1`` through the
+in-flight ring (the PR-5 lagged-readback pattern): iteration i's
+sampled tokens are fetched while iteration i+k is dispatching, so the
+per-token host sync sits off the critical path.  Consequences the
+engine handles:
+
+- a sequence is noticed finished (eos / max_new) up to k iterations
+  late; the extra garbage tokens are dropped on the host;
+- its blocks are freed DEFERRED — only after every dispatched
+  iteration that could still write through the old block table has
+  resolved — so a freed block can never alias a live sequence's cache
+  (tested: test_block_free_never_aliases_live_blocks).
+
+Admission therefore reserves ``prompt + max_new + decode_depth``
+token slots of blocks up front: the overhang covers in-flight
+iterations that keep writing after the finish condition.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchacc_tpu.ops.paged_attention import paged_attention
+from torchacc_tpu.serve.kv_cache import BlockPool, blocks_needed, make_pools
+
+
+# every ModelConfig field the paged forward (_layer/_forward) has been
+# audited against — the rejection below is effectively an ALLOWLIST: a
+# field added to ModelConfig after this audit raises at engine
+# construction instead of being silently ignored by the re-implemented
+# layer forward (which would decode tokens that diverge from
+# generate() with no error).  When auditing a new field, either handle
+# it in _layer/_forward, add it to the denylist checks, or confirm it
+# cannot affect decode numerics — then add it here.
+_AUDITED_MODEL_FIELDS = frozenset({
+    "activation", "attention_impl", "attn_dropout", "attn_logit_softcap",
+    "cache_len", "context_parallel", "decode", "dtype", "embed_scale",
+    "head_bias", "head_dim", "hidden_size", "intermediate_size",
+    "layer_pattern", "logical_axis_rules", "logit_scale", "logit_softcap",
+    "max_seq_len", "mlp_bias", "moe_capacity_factor", "moe_dispatch",
+    "moe_renorm_topk", "norm", "norm_bias", "norm_eps", "norm_placement",
+    "num_experts", "num_experts_per_tok", "num_heads", "num_kv_heads",
+    "num_layers", "o_bias", "parallel_block",
+    "parallel_block_shared_norm", "param_dtype", "partial_rotary",
+    "pos_emb", "pp_num_micro", "pp_size", "pp_virtual", "qk_norm",
+    "qk_norm_proj", "qkv_bias", "query_scale", "remat", "remat_cls",
+    "remat_cnt", "remat_policy", "rope_interleaved", "rope_llama3",
+    "rope_local_theta", "rope_longrope", "rope_scale", "rope_theta",
+    "rope_yarn", "router_aux_weight", "sandwich_norms", "scan_layers",
+    "tie_embeddings", "tp_vocab_head", "vocab_size", "window",
+})
+
+
+def _check_supported(cfg) -> None:
+    """The v1 serving surface: standard dense pre-norm decoders (the
+    llama/qwen/gpt2/gemma-dense families).  Everything else raises a
+    typed error here instead of decoding garbage."""
+    import dataclasses
+    unknown = ({f.name for f in dataclasses.fields(cfg)}
+               - _AUDITED_MODEL_FIELDS)
+    if unknown:
+        raise NotImplementedError(
+            f"ModelConfig grew fields the serving forward has not been "
+            f"audited against: {sorted(unknown)}.  Audit their effect "
+            f"on PagedDecoder._layer/_forward (scheduler.py) and add "
+            f"them to _AUDITED_MODEL_FIELDS.")
+    bad = []
+    if cfg.num_experts > 0:
+        bad.append("MoE (num_experts > 0)")
+    if cfg.pp_size > 1:
+        bad.append("pipeline parallelism (pp_size > 1)")
+    if cfg.context_parallel:
+        bad.append("context parallelism")
+    if cfg.layer_pattern:
+        bad.append("layer_pattern (per-layer sliding windows)")
+    if cfg.parallel_block:
+        bad.append("parallel_block")
+    if cfg.sandwich_norms:
+        bad.append("sandwich_norms")
+    if cfg.norm_placement != "pre":
+        bad.append(f"norm_placement={cfg.norm_placement!r}")
+    if cfg.pos_emb == "alibi":
+        bad.append("pos_emb='alibi'")
+    if tuple(cfg.window) != (-1, -1):
+        bad.append(f"sliding window {cfg.window}")
+    if bad:
+        raise NotImplementedError(
+            "the serving engine (torchacc_tpu/serve) does not yet "
+            "support: " + ", ".join(bad) + ".  Use models.generate for "
+            "these models (batch-synchronous decode covers the full "
+            "model zoo).")
+
+
+class PagedDecoder:
+    """The jitted device steps: a raw-params transformer forward over
+    the paged pool (the established raw-params idiom of
+    models/generate.py `_zoo_embed` / `head_logits`, numerically
+    matched to the module's own apply)."""
+
+    def __init__(self, cfg, serve_cfg, attention_impl: Optional[str] = None):
+        _check_supported(cfg)
+        self.cfg = cfg
+        self.serve_cfg = serve_cfg
+        self.impl = attention_impl or cfg.attention_impl
+        self.block_size = serve_cfg.block_size
+        self.chunk = serve_cfg.prefill_chunk
+        self.max_slots = serve_cfg.max_slots
+        # pools are donated: every step consumes and returns them, so
+        # XLA updates the one preallocated buffer in place.  all_greedy
+        # is static: the all-greedy trace (the serving default) skips
+        # the two full-vocab sampling sorts entirely — argmax only —
+        # while the mixed trace keeps the one-program-per-request-mix
+        # property; both advance the slot PRNG keys identically, so
+        # flipping between variants cannot drift a sampled stream
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2),
+                               static_argnums=(9,))
+        # is_final is static: the non-final trace skips the vocab head
+        # entirely (its logits are discarded), the final trace keeps
+        # the full-chunk head so first-token numerics are unchanged
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,),
+                                static_argnums=(6,))
+        self._sample_first = jax.jit(self._sample_first_impl)
+        self._set_slot = jax.jit(self._set_slot_impl, donate_argnums=(0,))
+
+    # -- model forward ------------------------------------------------------
+
+    def _dense(self, x, kernel, bias=None):
+        cfg = self.cfg
+        y = jnp.einsum("bth,h...->bt...", x.astype(cfg.dtype),
+                       kernel.astype(cfg.dtype))
+        if bias is not None:
+            y = y + bias.astype(cfg.dtype)
+        return y
+
+    def _layer(self, p, x, positions, pools_l, tables, ctx_lens, blk, off):
+        """One decoder layer over the paged cache.  ``blk``/``off``
+        [S, T] name the pool slot every token writes its k/v to (the
+        null block for masked tokens); ``ctx_lens`` is the post-write
+        context length per slot."""
+        from torchacc_tpu.models.transformer import Norm, _rope
+
+        cfg = self.cfg
+        kp, vp = pools_l
+        s_, t_ = x.shape[:2]
+        h = Norm(cfg).apply({"params": p["ln1"]}, x)
+        attn = p["attn"]
+        q = self._dense(h, attn["q_proj"]["kernel"],
+                        attn["q_proj"].get("bias"))
+        k = self._dense(h, attn["k_proj"]["kernel"],
+                        attn["k_proj"].get("bias"))
+        v = self._dense(h, attn["v_proj"]["kernel"],
+                        attn["v_proj"].get("bias"))
+        if cfg.qk_norm:
+            if cfg.qk_norm_proj:
+                q = Norm(cfg).apply({"params": attn["q_norm"]},
+                                    q.reshape(s_, t_, -1)).reshape(q.shape)
+                k = Norm(cfg).apply({"params": attn["k_norm"]},
+                                    k.reshape(s_, t_, -1)).reshape(k.shape)
+            else:
+                q = Norm(cfg).apply({"params": attn["q_norm"]}, q)
+                k = Norm(cfg).apply({"params": attn["k_norm"]}, k)
+        if cfg.pos_emb == "rope":
+            rp = (positions.astype(jnp.float32) / cfg.rope_scale
+                  if cfg.rope_scale != 1.0 else positions)
+            q, k = _rope(q, k, rp, cfg)
+        # bank this chunk's (rotated) k / raw v into the pool, THEN
+        # attend over the updated pool — same write-before-read order
+        # as the module's dense-cache decode branch
+        flat_b, flat_o = blk.reshape(-1), off.reshape(-1)
+        kh, d = kp.shape[2], kp.shape[3]
+        kp = kp.at[flat_b, flat_o].set(
+            k.reshape(s_ * t_, kh, d).astype(kp.dtype))
+        vp = vp.at[flat_b, flat_o].set(
+            v.reshape(s_ * t_, kh, d).astype(vp.dtype))
+        out = paged_attention(
+            q, kp, vp, tables, ctx_lens, positions[:, 0],
+            scale=cfg.query_scale, window=cfg.window,
+            logit_softcap=cfg.attn_logit_softcap, impl=self.impl)
+        x = x + self._dense(
+            out.reshape(s_, t_, -1),
+            attn["o_proj"]["kernel"].reshape(-1, cfg.hidden_size),
+            attn["o_proj"].get("bias"))
+        h2 = Norm(cfg).apply({"params": p["ln2"]}, x)
+        mlp = p["mlp"]
+        import flax.linen as nn
+        if cfg.activation in ("swiglu", "geglu"):
+            gate = self._dense(h2, mlp["gate_proj"]["kernel"],
+                               mlp["gate_proj"].get("bias"))
+            up = self._dense(h2, mlp["up_proj"]["kernel"],
+                             mlp["up_proj"].get("bias"))
+            act = nn.silu if cfg.activation == "swiglu" else nn.gelu
+            ff = act(gate) * up
+        else:
+            up = self._dense(h2, mlp["up_proj"]["kernel"],
+                             mlp["up_proj"].get("bias"))
+            if cfg.activation == "relu2":
+                ff = jnp.square(nn.relu(up))
+            elif cfg.activation == "gelu_exact":
+                ff = nn.gelu(up, approximate=False)
+            else:
+                ff = nn.gelu(up)
+        x = x + self._dense(ff, mlp["down_proj"]["kernel"],
+                            mlp["down_proj"].get("bias"))
+        return x, (kp, vp)
+
+    def _forward(self, params, pools, ids, positions, tables, ctx_lens,
+                 blk, off):
+        """(pools', hidden [S, T, H]): embed -> layer scan over the
+        stacked params + per-layer pools.  The head projection is the
+        caller's: decode projects every slot's single row, prefill
+        projects ONLY the last valid row (the full-chunk head would be
+        a C x hidden x vocab matmul that is discarded for every row
+        but one)."""
+        from torchacc_tpu.models.generate import _zoo_embed
+
+        x = _zoo_embed(self.cfg, params, ids, positions)
+        k_pools, v_pools = pools
+
+        def body(carry, per):
+            p_l, kp, vp = per
+            y, (kp, vp) = self._layer(p_l["block"], carry, positions,
+                                      (kp, vp), tables, ctx_lens, blk, off)
+            return y, (kp, vp)
+
+        x, (k_pools, v_pools) = jax.lax.scan(
+            body, x, (params["layers"], k_pools, v_pools))
+        return (k_pools, v_pools), x
+
+    # -- sampling -----------------------------------------------------------
+
+    def _sample_slots(self, logits, keys, temp, top_k, top_p):
+        """Per-slot sampling with TRACED (temperature, top_k, top_p) —
+        one compiled program for any request mix (the static-arg
+        variant in models/generate._sample would recompile per
+        combination).  temperature <= 0 is exact greedy (argmax),
+        token-identical to generate()'s."""
+        v = logits.shape[-1]
+        greedy = jnp.argmax(logits, axis=-1)
+        l = logits / jnp.maximum(temp, 1e-6)[:, None]
+        # top-k: the k-th largest as cutoff, k <= 0 or >= vocab = off
+        sorted_l = jnp.sort(l, axis=-1)[:, ::-1]
+        kidx = jnp.clip(
+            jnp.where((top_k <= 0) | (top_k >= v), v, top_k) - 1, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_l, kidx[:, None], axis=-1)
+        l = jnp.where(l < kth, -jnp.inf, l)
+        # nucleus on the k-truncated logits (generate._sample order);
+        # the argmax is always kept so top_p <= 0 degrades to greedy
+        sorted2 = jnp.sort(l, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted2, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p[:, None]
+        keep = keep.at[:, 0].set(True)
+        pth = jnp.min(jnp.where(keep, sorted2, jnp.inf), axis=-1,
+                      keepdims=True)
+        # top_p >= 1 is OFF (generate._sample skips it statically) —
+        # without the guard, f32 cumsum rounding to >= 1.0 early can
+        # truncate tail tokens even at the default top_p=1.0
+        l = jnp.where((l < pth) & (top_p[:, None] < 1.0), -jnp.inf, l)
+        sampled = jax.vmap(jax.random.categorical)(keys, l)
+        return jnp.where(temp <= 0, greedy, sampled).astype(jnp.int32)
+
+    # -- jitted steps -------------------------------------------------------
+
+    def _decode_impl(self, params, pools, carry, tables, seq_lens, active,
+                     temp, top_k, top_p, all_greedy):
+        """One decode token for every slot.  ``seq_lens`` is the banked
+        length BEFORE this token; free slots (active=False) run on the
+        null block and their sampled tokens are ignored by the host."""
+        bs = self.block_size
+        tok = carry["tok"]
+        positions = seq_lens[:, None]
+        blk = jnp.where(
+            active,
+            jnp.take_along_axis(tables, (seq_lens // bs)[:, None],
+                                axis=1)[:, 0],
+            0)
+        off = jnp.where(active, seq_lens % bs, 0)
+        ctx = jnp.where(active, seq_lens + 1, 0)
+        pools, x = self._forward(params, pools, tok[:, None],
+                                 positions, tables, ctx,
+                                 blk[:, None], off[:, None])
+        from torchacc_tpu.models.transformer import head_logits
+        logits = head_logits(self.cfg, params, x)
+        split = jax.vmap(jax.random.split)(carry["key"])
+        if all_greedy:
+            toks = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        else:
+            toks = self._sample_slots(logits[:, 0], split[:, 1], temp,
+                                      top_k, top_p)
+        return pools, {"tok": toks, "key": split[:, 0]}, toks
+
+    def _prefill_impl(self, params, pools, table_row, t0, tokens, n_valid,
+                      is_final):
+        """One chunk of ONE sequence: bank k/v for tokens
+        [t0, t0 + n_valid) and return the last valid row's logits (the
+        first-token sampling input when this is the final chunk;
+        non-final chunks skip the C x hidden x vocab head matmul — its
+        output is 100% discarded — and return None).  The pad tail
+        writes to the null block and its positions clamp to the newest
+        real position (keeps learned-position table lookups in range
+        and longrope's max(positions) regime switch exact)."""
+        bs, c = self.block_size, self.chunk
+        i = jnp.arange(c, dtype=jnp.int32)
+        valid = i < n_valid
+        pos = t0 + i
+        last_pos = jnp.maximum(t0 + n_valid - 1, 0)
+        positions = jnp.where(valid, pos, last_pos)[None]          # [1, C]
+        blk = jnp.where(valid, table_row[pos // bs], 0)
+        off = jnp.where(valid, pos % bs, 0)
+        ctx = (t0 + n_valid)[None]
+        pools, x = self._forward(params, pools, tokens[None],
+                                 positions, table_row[None], ctx,
+                                 blk[None], off[None])
+        if not is_final:
+            return pools, None
+        from torchacc_tpu.models.transformer import head_logits
+        logits = head_logits(self.cfg, params, x)
+        last = jnp.take_along_axis(
+            logits[0], jnp.maximum(n_valid - 1, 0)[None, None],
+            axis=0)[0]                                             # [V]
+        return pools, last
+
+    def _sample_first_impl(self, logits, key, temp, top_k, top_p):
+        return self._sample_slots(logits[None], key[None], temp[None],
+                                  top_k[None], top_p[None])[0]
+
+    def _set_slot_impl(self, carry, slot, token, key):
+        return {"tok": carry["tok"].at[slot].set(token),
+                "key": carry["key"].at[slot].set(key)}
+
+
+@dataclasses.dataclass
+class Sequence:
+    """Host-side runtime state of one admitted request."""
+
+    sid: int
+    prompt: np.ndarray                       # int32 [P]
+    max_new: int
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+    # runtime
+    slot: int = -1
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    prefilled: int = 0
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: str = ""
+    key: Any = None                          # host-held PRNG key
+    # metrics timestamps (host wall clock; engine fills t_submit)
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
+    token_times: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-unresolved iteration in the readback ring."""
+
+    kind: str                                # 'decode' | 'first'
+    tokens: Any                              # device array
+    slots: List[Tuple[int, Sequence]] = dataclasses.field(
+        default_factory=list)                # decode snapshot
+    seq: Optional[Sequence] = None           # 'first' entries
+    iter_idx: int = -1                       # decode iteration index
+    t_dispatch: float = 0.0
+
+
+class Scheduler:
+    """Slot + block bookkeeping and the iteration loop.
+
+    One ``step()`` = (at most) one prefill chunk + one batched decode
+    step + ring resolution down to ``decode_depth - 1`` in flight.
+    """
+
+    def __init__(self, model_cfg, params, serve_cfg,
+                 attention_impl: Optional[str] = None, blocked=None):
+        self.cfg = model_cfg
+        self.serve_cfg = serve_cfg
+        self.params = params
+        self.blocked = blocked               # optional BlockedMeter
+        self.decoder = PagedDecoder(model_cfg, serve_cfg, attention_impl)
+        self.pool = BlockPool(serve_cfg.num_blocks)
+        self.k_pools, self.v_pools = make_pools(model_cfg, serve_cfg)
+        s = serve_cfg.max_slots
+        # table width bounds the LONGEST admissible sequence, not the
+        # pool: the attention cost per decode token scales with table
+        # width (the fallback gathers [S, MB*BS] per layer; the kernel
+        # runs MB grid steps per slot/head), so sizing it num_blocks-1
+        # would make growing the pool for more concurrency inflate
+        # every slot's per-token cost.  The model's position reach
+        # (max_seq_len) plus the in-flight overhang is the natural
+        # bound; submit() rejects anything needing more.
+        self.max_blocks_per_seq = min(
+            serve_cfg.num_blocks - 1,
+            blocks_needed(model_cfg.max_seq_len + serve_cfg.decode_depth,
+                          serve_cfg.block_size))
+        self.tables = np.zeros((s, self.max_blocks_per_seq), np.int32)
+        self.seq_lens = np.zeros((s,), np.int32)
+        self.active = np.zeros((s,), bool)
+        self.temp = np.zeros((s,), np.float32)
+        self.top_k = np.zeros((s,), np.int32)
+        self.top_p = np.ones((s,), np.float32)
+        self.slot_seq: List[Optional[Sequence]] = [None] * s
+        self.carry = {
+            "tok": jnp.zeros((s,), jnp.int32),
+            "key": jnp.asarray(
+                np.stack([np.asarray(jax.random.PRNGKey(i))
+                          for i in range(s)]), jnp.uint32),
+        }
+        self._ring: "collections.deque[_InFlight]" = collections.deque()
+        self._iter = 0            # decode iterations dispatched
+        self._resolved = 0        # decode iterations resolved
+        self._deferred: List[Tuple[int, List[int]]] = []
+        # newly finished sequences, drained by the engine each step —
+        # completion accounting stays O(finished this step), never a
+        # scan over every request the process has served
+        self.finished: List[Sequence] = []
+        # device copies of the membership-stable host arrays (tables,
+        # active, sampling params), re-uploaded only when admission /
+        # prefill-completion / eviction dirties them — seq_lens changes
+        # every decode iteration and is always uploaded fresh
+        self._dev_stable = None
+
+    # -- admission ----------------------------------------------------------
+
+    def blocks_for(self, seq: Sequence) -> int:
+        """Blocks reserved at admission: prompt + max_new + the
+        in-flight overhang (a finished slot keeps writing for up to
+        decode_depth iterations before the host notices)."""
+        return blocks_needed(
+            seq.prompt_len + seq.max_new + self.serve_cfg.decode_depth,
+            self.serve_cfg.block_size)
+
+    def free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slot_seq):
+            if s is None:
+                return i
+        return None
+
+    def can_admit(self, seq: Sequence) -> bool:
+        return (self.free_slot() is not None
+                and self.pool.can_alloc(self.blocks_for(seq)))
+
+    def admit(self, seq: Sequence) -> bool:
+        slot = self.free_slot()
+        if slot is None:
+            return False
+        blocks = self.pool.alloc(self.blocks_for(seq))
+        if blocks is None:
+            return False
+        seq.slot = slot
+        seq.blocks = blocks
+        seq.prefilled = 0
+        seq.key = jax.random.PRNGKey(seq.seed)
+        seq.t_admit = time.monotonic()
+        self.slot_seq[slot] = seq
+        self.tables[slot, :] = 0
+        self.tables[slot, :len(blocks)] = blocks
+        self.seq_lens[slot] = 0
+        self.active[slot] = False          # decode starts after prefill
+        self.temp[slot] = seq.temperature
+        self.top_k[slot] = seq.top_k
+        self.top_p[slot] = seq.top_p
+        self._dev_stable = None
+        return True
+
+    # -- the iteration ------------------------------------------------------
+
+    def _prefilling(self) -> Optional[Sequence]:
+        cands = [s for s in self.slot_seq
+                 if s is not None and not s.finished
+                 and s.prefilled < s.prompt_len]
+        return min(cands, key=lambda s: s.sid) if cands else None
+
+    def step(self) -> bool:
+        """One engine iteration.  Returns True when any device work was
+        dispatched (False = idle: nothing admitted, prefilling or
+        decoding)."""
+        did = False
+        seq = self._prefilling()
+        if seq is not None:
+            self._prefill_one(seq)
+            did = True
+        if self.active.any():
+            self._decode_once()
+            did = True
+        # lagged resolution: keep at most decode_depth - 1 in flight
+        while len(self._ring) >= self.serve_cfg.decode_depth:
+            self._resolve_one()
+        if not did:
+            # nothing in flight can mature on its own — resolve one
+            # entry so finishes/evictions make progress
+            if self._ring:
+                self._resolve_one()
+                did = True
+        self._release_matured()
+        return did
+
+    def _prefill_one(self, seq: Sequence) -> None:
+        c = self.serve_cfg.prefill_chunk
+        t0 = seq.prefilled
+        chunk = seq.prompt[t0:t0 + c]
+        n_valid = int(chunk.shape[0])
+        if n_valid < c:
+            chunk = np.pad(chunk, (0, c - n_valid))
+        pools = (self.k_pools, self.v_pools)
+        final = (t0 + n_valid) >= seq.prompt_len
+        pools, last_logits = self.decoder._prefill(
+            self.params, pools, jnp.asarray(self.tables[seq.slot]),
+            jnp.asarray(t0, jnp.int32), jnp.asarray(chunk, jnp.int32),
+            jnp.asarray(n_valid, jnp.int32), final)
+        self.k_pools, self.v_pools = pools
+        seq.prefilled += n_valid
+        self.seq_lens[seq.slot] = seq.prefilled
+        if seq.prefilled >= seq.prompt_len:
+            # final chunk: sample the first generated token on device
+            # and splice it into the decode carry — no readback; the
+            # host learns it through the ring like any other token
+            seq.key, sub = jax.random.split(seq.key)
+            tok = self.decoder._sample_first(
+                last_logits, sub,
+                jnp.asarray(seq.temperature, jnp.float32),
+                jnp.asarray(seq.top_k, jnp.int32),
+                jnp.asarray(seq.top_p, jnp.float32))
+            seq.key, slot_key = jax.random.split(seq.key)
+            self.carry = self.decoder._set_slot(
+                self.carry, jnp.asarray(seq.slot, jnp.int32), tok,
+                slot_key.astype(jnp.uint32))
+            self.active[seq.slot] = True
+            self._dev_stable = None
+            self._ring.append(_InFlight(
+                kind="first", tokens=tok, seq=seq,
+                t_dispatch=time.monotonic()))
+
+    def _dev_stable_arrays(self):
+        if self._dev_stable is None:
+            self._dev_stable = (
+                jnp.asarray(self.tables), jnp.asarray(self.active),
+                jnp.asarray(self.temp), jnp.asarray(self.top_k),
+                jnp.asarray(self.top_p))
+        return self._dev_stable
+
+    def _decode_once(self) -> None:
+        snapshot = [(i, s) for i, s in enumerate(self.slot_seq)
+                    if self.active[i] and s is not None]
+        tables, active, temp, top_k, top_p = self._dev_stable_arrays()
+        all_greedy = bool((self.temp[self.active] <= 0.0).all())
+        pools = (self.k_pools, self.v_pools)
+        pools, self.carry, toks = self.decoder._decode(
+            self.params, pools, self.carry,
+            tables, jnp.asarray(self.seq_lens),
+            active, temp, top_k, top_p, all_greedy)
+        self.k_pools, self.v_pools = pools
+        # host mirror: every active slot banked one more token
+        self.seq_lens[self.active] += 1
+        self._ring.append(_InFlight(
+            kind="decode", tokens=toks, slots=snapshot,
+            iter_idx=self._iter, t_dispatch=time.monotonic()))
+        self._iter += 1
+
+    # -- resolution / eviction ----------------------------------------------
+
+    def _record(self, seq: Sequence, token: int, now: float) -> None:
+        if seq.finished:
+            return                 # lagged garbage after finish
+        if not seq.out_tokens:
+            seq.t_first_token = now
+        seq.out_tokens.append(token)
+        seq.token_times.append(now)
+        if seq.eos_id is not None and token == seq.eos_id:
+            self._finish(seq, "eos", now)
+        elif len(seq.out_tokens) >= seq.max_new:
+            self._finish(seq, "length", now)
+
+    def _finish(self, seq: Sequence, reason: str, now: float) -> None:
+        seq.finished = True
+        seq.finish_reason = reason
+        seq.t_finish = now
+        self.finished.append(seq)
+        self._evict(seq)
+
+    def _evict(self, seq: Sequence) -> None:
+        slot = seq.slot
+        if slot < 0:
+            return
+        self.slot_seq[slot] = None
+        self.active[slot] = False
+        self.tables[slot, :] = 0
+        self.seq_lens[slot] = 0
+        seq.slot = -1
+        self._dev_stable = None
+        # DEFERRED free: iterations dispatched before this point may
+        # still write through the old table — release only once every
+        # decode iteration < self._iter has resolved
+        self._deferred.append((self._iter, seq.blocks))
+        seq.blocks = []
+        self._release_matured()
+
+    def _release_matured(self) -> None:
+        ring_empty = not any(e.kind == "decode" for e in self._ring)
+        keep = []
+        for after, blocks in self._deferred:
+            if self._resolved >= after or ring_empty:
+                self.pool.free(blocks)
+            else:
+                keep.append((after, blocks))
+        self._deferred = keep
+
+    def _resolve_one(self) -> None:
+        entry = self._ring.popleft()
+        if self.blocked is not None:         # the (only) blocking fetch
+            with self.blocked.blocked():
+                toks = np.asarray(entry.tokens)
+        else:
+            toks = np.asarray(entry.tokens)
+        now = time.monotonic()
+        if entry.kind == "first":
+            self._record(entry.seq, int(toks), now)
+        else:
+            for slot, seq in entry.slots:
+                self._record(seq, int(toks[slot]), now)
+            self._resolved = entry.iter_idx + 1
+        self._release_matured()
+
+    def drain(self) -> None:
+        """Resolve every in-flight iteration (engine shutdown / idle)."""
+        while self._ring:
+            self._resolve_one()
+        self._release_matured()
+
+    @property
+    def pending(self) -> int:
+        return len(self._ring)
+
+    def busy(self) -> bool:
+        return (any(s is not None for s in self.slot_seq)
+                or bool(self._ring))

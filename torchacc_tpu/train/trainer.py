@@ -14,8 +14,10 @@ resilience layer needs (the StepGuard verdict scalar, SDC digest
 matrices, the logged loss) is taken from a ring buffer of in-flight
 steps at lag ``k = dispatch_depth - 1``, so it reads an
 already-completed value instead of serialising dispatch behind
-execution.  ``dispatch_depth=1`` (default) resolves every step
-immediately — bitwise-identical behaviour to the unpipelined loop.
+execution.  ``dispatch_depth=2`` (the default since the PR-5 burn-in
+proved bitwise depth-invariance) hides one dispatch latency;
+``dispatch_depth=1`` resolves every step immediately —
+bitwise-identical behaviour to the unpipelined loop.
 See docs/performance.md for the guarantee-vs-latency table.
 """
 
