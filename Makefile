@@ -26,9 +26,13 @@ bench:
 # tier-1-adjacent regression gate: drive the REAL bench.py model path
 # (accelerate + trainer.step + metrics) for a few steps on CPU — fast
 # enough for every PR, catches hot-loop wiring breakage that unit tests
-# with tiny ad-hoc models can miss
+# with tiny ad-hoc models can miss.  Second leg: the same path with
+# int8 quantized matmuls (xla impl on CPU) so the quant plumbing is
+# gated per-PR too (docs/performance.md "Quantized matmuls")
 bench-smoke:
 	JAX_PLATFORMS=cpu python bench.py --fast --platform cpu --iters 2
+	JAX_PLATFORMS=cpu python bench.py --fast --platform cpu --iters 2 \
+		--quant int8 --no-decode --no-idle-probe
 
 # serving gate (docs/serving.md): drive the continuous-batching engine
 # on a mixed-length staggered workload on CPU; reports tokens/s + TTFT
@@ -48,7 +52,7 @@ chaos:
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
 			tests/test_watchdog.py tests/test_elastic.py \
 			tests/test_sdc.py tests/test_perf.py \
-			tests/test_serving.py -m "not slow" \
+			tests/test_serving.py tests/test_quant.py -m "not slow" \
 			-q || exit 1; \
 	done
 

@@ -252,6 +252,18 @@ def main() -> int:
                          "timed iterations")
     ap.add_argument("--no-decode", action="store_true",
                     help="skip the greedy-decode throughput row")
+    ap.add_argument("--quant", default="none",
+                    choices=("none", "int8", "fp8"),
+                    help="run the train-step bench with quantized "
+                         "forward matmuls (compute.quant; ops/"
+                         "quantized_matmul.py).  'auto' impl = fused "
+                         "Pallas kernel on TPU, XLA dot on CPU — the "
+                         "CPU leg is the numerics/plumbing gate, the "
+                         "TPU leg the MFU number")
+    ap.add_argument("--no-idle-probe", action="store_true",
+                    help="skip the profiled device_idle_ms window "
+                         "(a few extra steps traced with jax.profiler "
+                         "after the timed loop)")
     ap.add_argument("--dispatch-depth", type=int, default=2,
                     help="perf.dispatch_depth: train steps the host may "
                          "keep in flight (lagged readback; 1 = resolve "
@@ -352,6 +364,7 @@ def _bench(args, wd: Watchdog) -> int:
     # ~2.8 GB/step f32->bf16 param-cast traffic (docs/PERF.md)
     cfg.compute.bf16_compute_params = True
     cfg.perf.dispatch_depth = max(1, args.dispatch_depth)
+    cfg.compute.quant = args.quant
     if args.guards:
         cfg.resilience.nan_guard = True
         cfg.resilience.spike_guard = True
@@ -389,6 +402,37 @@ def _bench(args, wd: Watchdog) -> int:
         # as this dropping when --dispatch-depth > 1 under --guards
         host_blocked_ms = trainer.blocked.take_ms() / iters
         trainer.drain()  # resolve any still-in-flight verdicts
+
+    # profiled idle window (separate from the timed loop so tracing
+    # overhead never pollutes the MFU number): a few steps under
+    # jax.profiler, then gap-sum between device ops — overlap wins
+    # (dispatch pipelining, overlap_fsdp) become measurable instead of
+    # inferred from MFU alone
+    device_idle_ms = None
+    idle_detail = None
+    if not args.no_idle_probe:
+        import shutil
+        import tempfile
+        from torchacc_tpu.utils.profiling import device_idle_from_trace
+        idle_iters = min(3, max(1, iters))
+        tdir = tempfile.mkdtemp(prefix="bench_idle_")
+        try:
+            wd.stage("idle_probe", 120)
+            with jax.profiler.trace(tdir):
+                for _ in range(idle_iters):
+                    m = trainer.step(batch_data)
+                float(m["loss"])
+                trainer.drain()
+            idle_detail = device_idle_from_trace(tdir)
+            if idle_detail is not None:
+                device_idle_ms = round(
+                    idle_detail["device_idle_ms"] / idle_iters, 3)
+        except Exception as e:  # noqa: BLE001 — a detail row, never the
+            # headline capture
+            print(f"[bench] idle probe failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
 
     decode_tps = None
     if not args.no_decode:
@@ -460,6 +504,12 @@ def _bench(args, wd: Watchdog) -> int:
                 round(decode_tps, 1) if decode_tps else None),
             "dispatch_depth": max(1, args.dispatch_depth),
             "host_blocked_ms_per_step": round(host_blocked_ms, 3),
+            "quant": args.quant,
+            # per-step device idle in the profiled window (gap-sum
+            # between device ops; on CPU an XLA-thread proxy —
+            # device_idle_source 1.0 means a real device plane)
+            "device_idle_ms": device_idle_ms,
+            "device_idle_source": (idle_detail or {}).get("source"),
             "guards": bool(args.guards),
             "fast": bool(args.fast),
             "profile": args.profile,
@@ -469,7 +519,7 @@ def _bench(args, wd: Watchdog) -> int:
     # cache as last-known-good so a later transport outage can still surface
     # a verifiable number (full runs only: --fast shapes aren't the
     # headline, and --guards deliberately pays resilience overhead)
-    if not args.fast and not args.guards \
+    if not args.fast and not args.guards and args.quant == "none" \
             and (args.platform in (None, "tpu")):
         _write_last_good(result)
     _emit(result)

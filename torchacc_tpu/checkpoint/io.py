@@ -406,7 +406,8 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, *, force: bool = False,
              loader_state: Optional[Dict[str, Any]] = None,
-             guard_state: Optional[Dict[str, Any]] = None) -> bool:
+             guard_state: Optional[Dict[str, Any]] = None,
+             presnapshotted: bool = False) -> bool:
         """Save ``state`` under ``step``.  ``loader_state`` (a loader's
         ``state_dict()``, or a zero-arg callable returning one — invoked
         only on steps that actually write) is persisted as
@@ -415,7 +416,14 @@ class CheckpointManager:
         O(consumed) skip-replay.  ``guard_state`` (dict or zero-arg
         callable) rides the same way as ``guard_state.json`` — the
         StepGuard's EW statistics, restored by ``fit(resume='auto')``
-        so the spike guard does not re-warm."""
+        so the spike guard does not re-warm.
+
+        ``presnapshotted=True`` promises ``state`` is ALREADY a
+        donation-safe copy (``_snapshot``) that no step loop will donate
+        — the caller took it early so the device-side copy overlaps
+        other host work (the trainer enqueues it before draining
+        in-flight verdicts on save steps); save() then skips its own
+        copy."""
         # skip-check first so the donation-safe snapshot (copy) is only
         # paid on steps that actually write
         if not force:
@@ -441,7 +449,8 @@ class CheckpointManager:
         # a new one: after a hard crash (SIGKILL/OOM) at most the single
         # in-flight step is unmarked, not the whole run's worth
         self._commit_manifests()
-        state = _snapshot(state)
+        if not presnapshotted:
+            state = _snapshot(state)
 
         def _once():
             failpoint("checkpoint.save", step=step)

@@ -91,6 +91,28 @@ class ComputeConfig:
     # Requires dtype=bfloat16 + param_dtype=float32 (train/amp.py
     # bf16_param_shadow).
     bf16_compute_params: bool = False
+    # Quantized forward matmuls (ops/quantized_matmul.py, docs/
+    # performance.md "Quantized matmuls"): 'int8' | 'fp8' run the
+    # selected dense sites' forward matmul in the low-precision format
+    # with delayed per-tensor activation scaling (amax history carried
+    # in TrainState.quant, persisted through checkpoints) and
+    # just-in-time per-channel weight scales; the backward stays in the
+    # compute dtype (straight-through).  'none' (default) is
+    # bitwise-identical legacy semantics — no quant state exists.
+    quant: str = "none"              # 'none' | 'int8' | 'fp8'
+    # which dense sites quantize: 'attn' = q/k/v/o projections, 'mlp' =
+    # gate/up/down denses, 'head' = the vocab projection (materialised
+    # head only — the fused-CE head stays in the compute dtype)
+    quant_sites: Tuple[str, ...] = ("attn", "mlp")
+    # rolling amax window per site (Transformer Engine defaults to ~16;
+    # longer windows react slower to activation-range shifts but are
+    # robust to single-step outliers)
+    quant_amax_history_len: int = 16
+    # kernel choice for the quantized matmul, like attention_impl:
+    # 'auto' = fused Pallas kernel on TPU / XLA dot elsewhere
+    quant_impl: str = "auto"         # 'auto' | 'pallas' | 'xla'
+
+    _QUANT_SITES = ("attn", "mlp", "head")
 
     def validate(self) -> None:
         _check(self.dtype in ("bfloat16", "float16", "float32"),
@@ -110,6 +132,19 @@ class ComputeConfig:
                f"compute.attention_impl invalid: {self.attention_impl}")
         _check(self.matmul_precision in ("default", "high", "highest"),
                f"compute.matmul_precision invalid: {self.matmul_precision}")
+        _check(self.quant in ("none", "int8", "fp8"),
+               f"compute.quant must be none|int8|fp8, got {self.quant}")
+        _check(self.quant_impl in ("auto", "pallas", "xla"),
+               f"compute.quant_impl invalid: {self.quant_impl}")
+        _check(self.quant_amax_history_len >= 1,
+               "compute.quant_amax_history_len must be >= 1")
+        if self.quant != "none":
+            _check(len(self.quant_sites) >= 1,
+                   "compute.quant_sites must name at least one site")
+            for s in self.quant_sites:
+                _check(s in self._QUANT_SITES,
+                       f"compute.quant_sites entries must be in "
+                       f"{self._QUANT_SITES}, got {s!r}")
 
 
 @dataclass
@@ -352,6 +387,23 @@ class PerfConfig:
     # dispatch/trace time exceeds a step time.  Set 1 to restore
     # immediate per-step verdicts.
     dispatch_depth: int = 2
+    # FSDP comm/compute overlap (docs/performance.md "FSDP overlap"):
+    # decompose the FSDP boundary so the all-gather of layer i+1's
+    # params is ISSUED while layer i computes (and the mirror
+    # reduce-scatter in backward), instead of letting GSPMD serialise
+    # gather -> compute per layer ("Overlapping Communication with
+    # Dependent Computation via Decomposition", Wang et al.,
+    # ASPLOS'23).  Implemented as the unrolled layer loop with an
+    # explicit one-layer-ahead replication constraint
+    # (parallel/sharding.fsdp_gather_params): the forward is
+    # bitwise-identical to the non-overlapped unrolled path; backward
+    # weight-grad collectives sum in a different order (all-reduce vs
+    # reduce-scatter), so trajectories agree to reduction-order
+    # tolerance.  Opt-in; only meaningful with a live 'fsdp' mesh
+    # axis.  Forces the unrolled layer loop (scan_layers is ignored
+    # while overlapping); does not compose with pipeline parallelism
+    # or layer_pattern models.
+    overlap_fsdp: bool = False
 
     def validate(self) -> None:
         _check(self.dispatch_depth >= 1,
@@ -677,6 +729,16 @@ class Config:
         self.perf.validate()
         self.serve.validate()
         _check(self.grad_accum >= 1, "grad_accum must be >= 1")
+        # quantized matmuls thread delayed-scaling state through the
+        # non-pp forward paths only; the 1F1B/GPipe regions apply blocks
+        # through raw param trees that do not carry the quant collection
+        _check(self.compute.quant == "none" or self.dist.pp.size == 1,
+               "compute.quant does not compose with pipeline "
+               "parallelism (pp.size > 1) — the pipeline regions do "
+               "not thread the delayed-scaling state")
+        _check(not self.perf.overlap_fsdp or self.dist.pp.size == 1,
+               "perf.overlap_fsdp does not compose with pipeline "
+               "parallelism (the pp schedules own their layer loop)")
 
     # -- mesh ---------------------------------------------------------------
     def get_mesh(self, devices: Optional[Sequence[Any]] = None):

@@ -201,6 +201,15 @@ def generate(
             pass
         prompt_mask = m
     cfg = getattr(model, "cfg", None)
+    # quantized matmuls are a TRAIN-step feature (delayed-scaling state
+    # threads through the train step); decode runs in the compute dtype
+    # — strip quant so a quant-trained model generates unmodified (the
+    # param layout is identical either way)
+    if cfg is not None and getattr(cfg, "quant", "none") != "none":
+        from torchacc_tpu.models.transformer import TransformerLM
+        cfg = dataclasses.replace(cfg, quant="none")
+        if isinstance(model, TransformerLM):
+            model = TransformerLM(cfg)
     # window/ALiBi decode runs through the cache branch (q_offset aligns
     # the decode-row geometry).  pp decode runs the stage-ring cached
     # path (_generate_cached_pp — cache stays stage-local, one ring pass

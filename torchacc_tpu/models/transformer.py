@@ -153,6 +153,24 @@ class ModelConfig:
     # post-softmax attention dropout (reference flash_attn.py:418-423);
     # active only when the caller passes deterministic=False + a seed
     attn_dropout: float = 0.0
+    # quantized forward matmuls (ops/quantized_matmul.py): the selected
+    # dense sites run int8/fp8 with delayed per-tensor activation
+    # scaling (amax history in the 'quant' collection) + just-in-time
+    # per-channel weight scales; 'none' = bitwise legacy semantics.
+    # Composes with the scan, unrolled and overlap_fsdp layer paths;
+    # NOT with pp, layer_pattern, remat_cnt splits, or decode (the
+    # guards in __call__ raise; generate() strips quant — inference
+    # runs in the compute dtype).
+    quant: str = "none"                     # 'none' | 'int8' | 'fp8'
+    quant_sites: Tuple[str, ...] = ("attn", "mlp")
+    quant_amax_history_len: int = 16
+    quant_impl: str = "auto"                # 'auto' | 'pallas' | 'xla'
+    # FSDP comm/compute overlap (PerfConfig.overlap_fsdp): run the
+    # layers as the unrolled loop with the all-gather of layer i+1's
+    # params issued before layer i's compute consumes its own —
+    # decomposing the FSDP boundary so XLA can overlap the gather with
+    # the compute ladder (parallel/sharding.fsdp_gather_params)
+    overlap_fsdp: bool = False
     # context parallelism: attention runs in a shard_map region with the
     # sequence dim sharded over ('sp', 'spu') — see ops/context_parallel
     context_parallel: bool = False
@@ -436,6 +454,29 @@ def _layer_seed(dropout_seed, layer_idx):
     return (s + li * jnp.uint32(0x9E3779B9)).astype(jnp.int32)
 
 
+def quant_site_on(cfg: "ModelConfig", site: str) -> bool:
+    """Whether a dense ``site`` ('attn' | 'mlp' | 'head') runs the
+    quantized matmul.  Decode always runs the plain dense (generate()
+    strips quant anyway — inference is compute-dtype); the param
+    layouts are identical either way, so this only picks execution."""
+    return (cfg.quant != "none" and site in cfg.quant_sites
+            and not cfg.decode)
+
+
+def _quant_dense(cfg: "ModelConfig", name, features, axis, use_bias):
+    """The quantized drop-in for an ``nn.DenseGeneral``/``nn.Dense``
+    site: identical param names/shapes/init (same RNG stream, same
+    checkpoints), quantized forward, delayed-scaling amax history in
+    the 'quant' collection (ops/quantized_matmul.QuantDenseGeneral)."""
+    from torchacc_tpu.ops.quantized_matmul import QuantDenseGeneral
+    return QuantDenseGeneral(
+        features=features, axis=axis, use_bias=use_bias, name=name,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.initializers.normal(0.02),
+        quant=cfg.quant, quant_impl=cfg.quant_impl,
+        amax_history_len=cfg.quant_amax_history_len)
+
+
 class Attention(nn.Module):
     cfg: ModelConfig
 
@@ -443,10 +484,14 @@ class Attention(nn.Module):
     def __call__(self, x, positions, segment_ids=None, dropout_seed=None):
         cfg = self.cfg
         d = cfg.head_size
-        dense = lambda name, heads: nn.DenseGeneral(
-            features=(heads, d), use_bias=cfg.qkv_bias, name=name,
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            kernel_init=nn.initializers.normal(0.02))
+        if quant_site_on(cfg, "attn"):
+            dense = lambda name, heads: _quant_dense(
+                cfg, name, (heads, d), -1, cfg.qkv_bias)
+        else:
+            dense = lambda name, heads: nn.DenseGeneral(
+                features=(heads, d), use_bias=cfg.qkv_bias, name=name,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(0.02))
         from torchacc_tpu.parallel.sharding import (
             DEFAULT_RULES,
             activation_constraint,
@@ -604,11 +649,16 @@ class Attention(nn.Module):
                             dropout_seed=seed,
                             impl=cfg.attention_impl,
                             logit_softcap=cfg.attn_logit_softcap)
-        out = nn.DenseGeneral(
-            features=cfg.hidden_size, axis=(-2, -1),
-            use_bias=cfg.o_bias,
-            name="o_proj", dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            kernel_init=nn.initializers.normal(0.02))(out)
+        if quant_site_on(cfg, "attn"):
+            out = _quant_dense(cfg, "o_proj", cfg.hidden_size, (-2, -1),
+                               cfg.o_bias)(out)
+        else:
+            out = nn.DenseGeneral(
+                features=cfg.hidden_size, axis=(-2, -1),
+                use_bias=cfg.o_bias,
+                name="o_proj", dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(0.02))(out)
         return out
 
 
@@ -618,10 +668,14 @@ class Mlp(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = lambda name, feat: nn.Dense(
-            feat, use_bias=cfg.mlp_bias, name=name, dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            kernel_init=nn.initializers.normal(0.02))
+        if quant_site_on(cfg, "mlp"):
+            dense = lambda name, feat: _quant_dense(
+                cfg, name, feat, -1, cfg.mlp_bias)
+        else:
+            dense = lambda name, feat: nn.Dense(
+                feat, use_bias=cfg.mlp_bias, name=name, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(0.02))
         from torchacc_tpu.parallel.sharding import (
             DEFAULT_RULES,
             activation_constraint,
@@ -781,6 +835,21 @@ def _raw_block_fn(block_cfg):
     return fn
 
 
+def _raw_block_fn_quant(block_cfg):
+    """quant-threading variant of :func:`_raw_block_fn`:
+    ``fn(p, q, carry, s) -> (carry, aux, q_new)``.  The per-layer
+    delayed-scaling state goes in and the mutated history comes out, so
+    the unrolled / overlap_fsdp paths carry it explicitly (nn.scan's
+    ``variable_axes={'quant': 0}`` does the same job on the scan
+    path)."""
+    def fn(p, q, carry, s):
+        (new_carry, _), vs = ScanBlock(block_cfg).apply(
+            {"params": p, "quant": q}, carry, s,
+            mutable=["intermediates", "quant"])
+        return new_carry, _sown_aux_sum(vs), vs["quant"]
+    return fn
+
+
 class TransformerLM(nn.Module):
     """The LM.  ``__call__(input_ids, positions?, segment_ids?) -> logits``.
 
@@ -846,9 +915,53 @@ class TransformerLM(nn.Module):
         # writes), and decode compute is trivial either way.
         cache_live = cfg.decode or self.is_mutable_collection("cache")
         use_scan_apply = cfg.scan_layers or cache_live
+        quant_on = cfg.quant != "none"
+        if quant_on and not self.is_initializing():
+            # the quantized sites' delayed-scaling state threads through
+            # the scan / unrolled / overlap paths only; the pp regions
+            # and the decode cache path apply blocks via raw param trees
+            # that do not carry (or would silently drop) the 'quant'
+            # collection — keep those failures loud
+            if cfg.pp_size > 1:
+                raise NotImplementedError(
+                    "quant != 'none' does not compose with pipeline "
+                    "parallelism (config.validate rejects it too)")
+            if cfg.layer_pattern:
+                raise NotImplementedError(
+                    "quant != 'none' does not compose with "
+                    "layer_pattern models yet")
+            if cache_live:
+                raise NotImplementedError(
+                    "quant != 'none' decode must go through "
+                    "models.generate (it strips quant — inference runs "
+                    "in the compute dtype)")
+            if (split_n is not None and cfg.scan_layers
+                    and not cfg.overlap_fsdp):
+                # overlap_fsdp forces the unrolled loop below, which
+                # honors remat_cnt AND threads quant — only the
+                # split-SCAN path cannot
+                raise NotImplementedError(
+                    "quant != 'none' with memory.gc_cnt requires "
+                    "scan_layers=False (the split-scan path does not "
+                    "thread the delayed-scaling state)")
+        # FSDP overlap: force the unrolled loop with the in-fn param
+        # gather (see the branch below); quant threads through it.
+        # layer_pattern would silently skip the overlap branch — reject
+        # loudly instead of letting a user benchmark a no-op (pp is
+        # already rejected by Config.validate; decode skips silently by
+        # design: a single-token step has no ladder to overlap)
+        if (cfg.overlap_fsdp and cfg.layer_pattern
+                and not self.is_initializing()):
+            raise NotImplementedError(
+                "perf.overlap_fsdp does not compose with layer_pattern "
+                "models (the pattern's per-layer loop does not take "
+                "the overlap path) — disable one of the two")
+        overlap_active = (cfg.overlap_fsdp and not cache_live
+                          and cfg.pp_size <= 1 and not cfg.layer_pattern)
         scan_mod = nn.scan(
             block_cls,
-            variable_axes={"params": 0, "intermediates": 0, "cache": 0},
+            variable_axes={"params": 0, "intermediates": 0, "cache": 0,
+                           "quant": 0},
             split_rngs={"params": True},
             length=cfg.num_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
@@ -962,33 +1075,112 @@ class TransformerLM(nn.Module):
                              aux_total / cfg.pp_num_micro)
             else:
                 x = res
-        elif not use_scan_apply:
+        elif not use_scan_apply or overlap_active:
             # unrolled application from the stacked layout: static
             # per-layer slices keep each layer's policy-saved residuals
             # as SEPARATE buffers, so the step's autodiff carries no
             # [L, ...] DUS stacking (the scan-stacking tax — measured
             # ~7 MFU points on the v5e bench, docs/PERF.md).  Honors
             # remat_cnt: layers past split_n run without remat.
+            #
+            # overlap_fsdp rides this loop: each layer's block fn FIRST
+            # constrains its param slice to REPLICATED (an explicit
+            # all-gather under GSPMD — parallel/sharding.
+            # fsdp_gather_params).  The gather's only operand is the
+            # stacked param slice — data-independent of every other
+            # layer's compute — so XLA's scheduler is free to overlap
+            # layer i+1's all-gather with layer i's compute ladder (the
+            # ASPLOS'23 decomposition; XLA schedules by data flow, not
+            # program order).  The gather lives INSIDE the
+            # jax.checkpoint region: residuals stay the fsdp-SHARDED
+            # slices (remat re-gathers in backward — standard ZeRO-3
+            # memory behavior), never a per-layer replicated copy.  The
+            # backward mirror is each layer's weight cotangent
+            # resharding back into the fsdp-sharded stack independently
+            # of older layers' backward compute.
             from torchacc_tpu.utils.remat import remat_policy
             layer_params = self.variables["params"]["layers"]
             cfg_off = dataclasses.replace(cfg, remat=False)
 
-            apply_gc = _raw_block_fn(cfg)
-            apply_plain = _raw_block_fn(cfg_off)
-            if _block_remat(cfg):
-                apply_gc = jax.checkpoint(
-                    apply_gc, policy=remat_policy(cfg.remat_policy),
-                    prevent_cse=False)
+            # block-level quant state exists only when an in-block site
+            # ('attn'/'mlp') is quantized; a head-only quant_sites
+            # leaves the blocks plain (the head's own QuantDenseGeneral
+            # at the module tail threads through normal flax mutation)
+            quant_blocks = quant_on and (
+                quant_site_on(cfg, "attn") or quant_site_on(cfg, "mlp"))
+            raw_gc = (_raw_block_fn_quant(cfg) if quant_blocks
+                      else _raw_block_fn(cfg))
+            raw_plain = (_raw_block_fn_quant(cfg_off) if quant_blocks
+                         else _raw_block_fn(cfg_off))
+            if overlap_active:
+                from torchacc_tpu.parallel.sharding import (
+                    DEFAULT_RULES,
+                    fsdp_gather_params,
+                    fsdp_gather_specs,
+                )
+                # per-leaf target specs = each weight's layout minus
+                # its fsdp dim, so the gather unshard-s ONLY the ZeRO-3
+                # axis and megatron tp/ep dims stay sharded; falls back
+                # to fully-replicated for trees the axes rules don't
+                # know (custom modules)
+                try:
+                    g_specs = fsdp_gather_specs(
+                        jax.tree.map(lambda a: a[0], layer_params),
+                        cfg.logical_axis_rules or DEFAULT_RULES)
+                except ValueError as e:
+                    # fully-replicated fallback also un-shards tp/ep
+                    # dims — fine on fsdp/dp-only meshes, a per-layer
+                    # memory+collective cost under tensor parallelism;
+                    # say so instead of degrading silently
+                    from torchacc_tpu.utils.logger import logger
+                    logger.warning(
+                        "overlap_fsdp: param tree has no axes-rule "
+                        f"coverage ({e}); gathering layers to fully "
+                        "replicated — under tensor parallelism this "
+                        "also un-shards the megatron dims per layer")
+                    g_specs = None
 
+                def _gathered(fn):
+                    def wrapped(p, *rest):
+                        return fn(fsdp_gather_params(p, g_specs), *rest)
+                    return wrapped
+                raw_gc = _gathered(raw_gc)
+                raw_plain = _gathered(raw_plain)
+            if _block_remat(cfg):
+                raw_gc = jax.checkpoint(
+                    raw_gc, policy=remat_policy(cfg.remat_policy),
+                    prevent_cse=False)
+            layer_quant = None
+            if quant_blocks:
+                if "quant" not in self.variables \
+                        or "layers" not in self.variables["quant"]:
+                    raise ValueError(
+                        "quant != 'none' but no 'quant' collection was "
+                        "passed to apply() — thread TrainState.quant "
+                        "(the Trainer does this automatically)")
+                layer_quant = self.variables["quant"]["layers"]
+
+            slice_i = lambda tree, i: jax.tree.map(
+                lambda a, i=i: a[i], tree)
             carry = (x, positions, segment_ids)
             aux_total = jnp.zeros((), jnp.float32)
+            new_quant = []
             n_gc = cfg.num_layers if split_n is None else split_n
             for i in range(cfg.num_layers):
-                fn = apply_gc if (i < n_gc and cfg.remat) else apply_plain
-                p_i = jax.tree.map(lambda a, i=i: a[i], layer_params)
+                fn = raw_gc if (i < n_gc and cfg.remat) else raw_plain
+                p_i = slice_i(layer_params, i)
                 seed_i = None if seeds_xs is None else seeds_xs[i]
-                carry, aux = fn(p_i, carry, seed_i)
+                if quant_blocks:
+                    carry, aux, q_i = fn(p_i, slice_i(layer_quant, i),
+                                         carry, seed_i)
+                    new_quant.append(q_i)
+                else:
+                    carry, aux = fn(p_i, carry, seed_i)
                 aux_total = aux_total + aux
+            if quant_blocks and self.is_mutable_collection("quant"):
+                self.put_variable(
+                    "quant", "layers",
+                    jax.tree.map(lambda *a: jnp.stack(a), *new_quant))
             if cfg.num_experts > 0:
                 self.sow("intermediates", "moe_aux_loss", aux_total)
             x = carry[0]
@@ -1049,7 +1241,22 @@ class TransformerLM(nn.Module):
                 raise ValueError(
                     "head_bias does not compose with tie_embeddings "
                     "(the tied head has no bias parameter)")
+            if quant_site_on(cfg, "head"):
+                # the tied head projects through emb.attend — there is
+                # no lm_head dense to quantize; a silent no-op would
+                # let a user benchmark head quantization that never ran
+                raise ValueError(
+                    "quant_sites includes 'head' but tie_embeddings "
+                    "projects through the embedding table — drop "
+                    "'head' from quant_sites (the tied head stays in "
+                    "the compute dtype)")
             logits = emb.attend(x)
+        elif quant_site_on(cfg, "head"):
+            # the MATERIALISED head only: the trainer's fused-CE path
+            # computes the head inside the chunked loss and stays in
+            # the compute dtype (docs/performance.md)
+            logits = _quant_dense(cfg, "lm_head", cfg.vocab_size, -1,
+                                  cfg.head_bias)(x)
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=cfg.head_bias,
                               name="lm_head",

@@ -20,6 +20,11 @@ def interpret_mode() -> bool:
     return not on_tpu()
 
 
+def round_up(x: int, m: int) -> int:
+    """Smallest multiple of ``m`` >= ``x`` (kernel tile padding)."""
+    return ((x + m - 1) // m) * m
+
+
 # ---------------------------------------------------------------------------
 # Counter-based dropout hash (attention dropout)
 #

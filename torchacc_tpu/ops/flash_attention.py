@@ -38,6 +38,7 @@ from torchacc_tpu.ops._common import (
     NEG_INF,
     interpret_mode as _interpret,
     mix32,
+    round_up as _round_up,
 )
 
 _LANES = 128
@@ -63,10 +64,6 @@ def _keep_mask_2d(seed, b_idx, h_idx, q0, k0, block_q, block_k,
     bits = mix32(mix32(base ^ gq) ^ mix32(gk * jnp.uint32(_K_PRIME)))
     threshold = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
     return bits >= threshold
-
-
-def _round_up(x: int, m: int) -> int:
-    return ((x + m - 1) // m) * m
 
 
 def _block_sizes(sq: int, sk: int) -> Tuple[int, int]:

@@ -223,6 +223,109 @@ def micro_split_spec(data_axes: Sequence[str], mesh,
                          *([None] * max(ndim - 2, 0)))
 
 
+def fsdp_gather_params(tree: Any, specs: Any = None) -> Any:
+    """Constrain every array leaf of a (one layer's) param tree to its
+    UNSHARDED-over-fsdp layout — the decomposed FSDP boundary
+    (``perf.overlap_fsdp``).
+
+    Under GSPMD a with_sharding_constraint to ``P()`` on an
+    fsdp-sharded weight lowers to exactly the all-gather the consuming
+    matmul would otherwise trigger — but as a *standalone* op whose
+    only operand is the stacked param slice.  The overlap loop
+    (models/transformer.py) applies this at the top of each layer's
+    block fn — inside the remat region, so residuals stay the
+    fsdp-sharded slices and backward re-gathers (ZeRO-3 memory) —
+    and since the gather has no data dependence on any other layer's
+    compute, XLA's (latency-hiding) scheduler can overlap layer i+1's
+    gather with layer i's compute; the backward mirror is each layer's
+    weight cotangent resharding back into the fsdp-sharded stack
+    independently of older layers' backward compute.  The gathered
+    VALUES are bit-identical to
+    what the non-overlapped path consumes, so the FORWARD (and the
+    first step's loss) is bitwise-identical with overlap on/off
+    (tests/test_quant.py pins this); the backward's weight-grad
+    collective lowers as all-reduce instead of reduce-scatter, whose
+    different summation order perturbs gradients at the reduction-order
+    level (~1e-7 relative) — trajectories agree to that tolerance.
+
+    ``specs`` (optional, per-leaf PartitionSpecs matching ``tree`` —
+    :func:`fsdp_gather_specs` builds them from the param axes rules)
+    keeps NON-fsdp sharding in place: on a tensor-parallel mesh the
+    megatron 'tp' dims of each weight stay sharded and only the
+    fsdp/ZeRO-3 dim is gathered — without specs every leaf is
+    constrained fully replicated, which would also undo TP.
+
+    No-op without a live mesh (plain single-device apply) so model code
+    can call it unconditionally — same contract as
+    :func:`activation_constraint`.
+    """
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or not mesh.shape:
+            return tree
+    except Exception:
+        return tree
+
+    def one(a, spec=None):
+        if not hasattr(a, "ndim"):
+            return a
+        if spec is None:
+            spec = PartitionSpec(*([None] * a.ndim))
+        return jax.lax.with_sharding_constraint(
+            a, _known_divisible(spec, a, mesh))
+    if specs is None:
+        return jax.tree.map(one, tree)
+    return jax.tree.map(one, tree, specs)
+
+
+def fsdp_gather_specs(tree: Any, rules: LogicalRules,
+                      unshard: Tuple[str, ...] = ("fsdp",)) -> Any:
+    """Per-leaf PartitionSpecs for :func:`fsdp_gather_params`: each
+    param leaf's logical axes (models/axes.py path rules) mapped
+    through ``rules`` with the ``unshard`` mesh axes dropped — i.e.
+    "this weight's layout, minus its ZeRO-3 dim".  Constraining to
+    these gathers ONLY the fsdp shard; tp/ep dims keep their megatron
+    layout.  ``tree`` must be the per-layer (sliced) param tree so the
+    leaf ranks match the axes rules."""
+    from torchacc_tpu.models.axes import param_axes
+    axes_tree = param_axes(tree)
+
+    def one(leaf, axes):
+        if axes is None or not hasattr(leaf, "ndim"):
+            return None
+        spec = spec_for(axes, rules)
+        parts = []
+        for p in tuple(spec) + (None,) * (leaf.ndim - len(spec)):
+            if p is None:
+                parts.append(None)
+            elif isinstance(p, tuple):
+                kept = tuple(a for a in p if a not in unshard)
+                parts.append(kept or None)
+            else:
+                parts.append(None if p in unshard else p)
+        return PartitionSpec(*parts)
+    return jax.tree.map(one, tree, axes_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def _known_divisible(spec: PartitionSpec, x: jax.Array,
+                     mesh) -> PartitionSpec:
+    """Drop axes the live mesh doesn't know, then longest-divisible
+    prefix — the same cleanup :func:`activation_constraint` applies, so
+    a constraint can never ask GSPMD to pad."""
+    known = []
+    for tgt in tuple(spec) + (None,) * (x.ndim - len(spec)):
+        axes = tgt if isinstance(tgt, tuple) else ((tgt,) if tgt else ())
+        axes = tuple(a for a in axes if a in mesh.shape)
+        if not axes:
+            known.append(None)
+        elif isinstance(tgt, tuple):
+            known.append(axes)
+        else:
+            known.append(axes[0])
+    return _divisible(PartitionSpec(*known), x.shape, mesh)
+
+
 def activation_constraint(x: jax.Array,
                           logical_axes: Sequence[Optional[str]],
                           rules: LogicalRules = DEFAULT_RULES) -> jax.Array:

@@ -72,9 +72,35 @@ QUARANTINE_FILE = "sdc_quarantine.json"
 
 # -- traced digest fold -------------------------------------------------------
 
+def _subsample_strides(shape: Sequence[int], max_elems: int,
+                       sharded_dims: Sequence[bool]) -> Tuple[int, ...]:
+    """Per-dim strides keeping ``prod(ceil(d/s))`` at most ~max_elems.
+
+    Strides apply to UNSHARDED dims first: a strided slice along an
+    unsharded dim is a purely device-local operation on every shard,
+    while striding a sharded dim (the last resort, only when the
+    unsharded dims cannot absorb the whole bound) makes the partitioner
+    move data.  Index 0 of every strided dim is always kept ([::s]
+    starts at 0), so the global element (0, ..., 0) — the chaos flip
+    site — survives any stride combination."""
+    strides = [1] * len(shape)
+    order = sorted(range(len(shape)),
+                   key=lambda i: (bool(sharded_dims[i]), -shape[i]))
+    for i in order:
+        kept = 1
+        for d, s in zip(shape, strides):
+            kept *= -(-d // s)
+        if kept <= max_elems:
+            break
+        factor = -(-kept // max_elems)
+        strides[i] = min(shape[i], strides[i] * factor)
+    return tuple(strides)
+
+
 def _leaf_digest(x: jax.Array, hit: jax.Array,
                  xor_mask: jax.Array,
-                 max_elems: Optional[int] = None) -> jax.Array:
+                 max_elems: Optional[int] = None,
+                 spec: Any = None) -> jax.Array:
     """Fold one grad leaf to ``[3] uint32``: XOR fold + wraparound sum
     of the f32 bit patterns (order-independent -> exact under any
     reduction order / sharding) + the f32 sum's bit pattern (order-
@@ -83,12 +109,21 @@ def _leaf_digest(x: jax.Array, hit: jax.Array,
     is bitwise untouched.
 
     ``max_elems`` (resilience.sdc_digest_max_elems) bounds the fold's
-    read traffic on huge leaves: a leaf with more elements folds a
-    deterministic strided subsample of at most ``max_elems`` elements
-    spread across the whole leaf.  Element 0 — the chaos flip site — is
-    always in the subsample (the stride starts at 0), so the injection
-    seam keeps working; the subsampled fold is still exact and
-    order-independent over its (shape-determined) subset."""
+    read traffic on huge leaves with a deterministic PER-DIM strided
+    subsample of at most ~``max_elems`` elements.  The subsample is a
+    strided slice per dimension — never a flat reshape (whose global
+    linearisation forced GSPMD to GATHER a sharded leaf before
+    slicing) — so each device strides its own local shard and the fold
+    reduces shard-local partials; digesting a 10B-param fsdp/tp-sharded
+    leaf moves digest words, not tensor data.  ``spec`` (the leaf's
+    PartitionSpec, passed by the trainer from the param shardings)
+    steers strides onto UNSHARDED dims first so the slice itself is
+    movement-free too.  Element 0 — the chaos flip site — is always in
+    the subsample (every strided dim keeps index 0).  The subsampled
+    fold stays exact and order-independent over its (shape+stride-
+    determined) subset; bounded digests are not comparable to digests
+    taken under a different bound or to the pre-PR-7 flat-stride
+    subsample."""
     bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
     if bits.ndim == 0:
         bits = jnp.where(hit, bits ^ xor_mask, bits)
@@ -100,8 +135,11 @@ def _leaf_digest(x: jax.Array, hit: jax.Array,
         b0 = bits[idx]
         bits = bits.at[idx].set(jnp.where(hit, b0 ^ xor_mask, b0))
         if max_elems is not None and bits.size > max_elems:
-            stride = -(-bits.size // max_elems)  # ceil: <= max_elems kept
-            bits = bits.reshape(-1)[::stride]
+            parts = tuple(spec) if spec is not None else ()
+            sharded = [bool(parts[i]) if i < len(parts) else False
+                       for i in range(bits.ndim)]
+            strides = _subsample_strides(bits.shape, max_elems, sharded)
+            bits = bits[tuple(slice(None, None, s) for s in strides)]
         xor = jax.lax.reduce(bits, jnp.uint32(0), jax.lax.bitwise_xor,
                              tuple(range(bits.ndim)))
         usum = jnp.sum(bits, dtype=jnp.uint32)
@@ -113,7 +151,9 @@ def _leaf_digest(x: jax.Array, hit: jax.Array,
 
 def replica_digests(grads: Any, flip: Dict[str, jax.Array], *,
                     mesh, axis: str = "dp",
-                    max_elems: Optional[int] = None) -> jax.Array:
+                    max_elems: Optional[int] = None,
+                    leaf_specs: Optional[Sequence[Any]] = None
+                    ) -> jax.Array:
     """Traced: per-DP-replica digest matrix ``uint32 [dp, leaves, 3]``.
 
     Runs inside the jitted train step.  ``grads`` is the final gradient
@@ -125,9 +165,19 @@ def replica_digests(grads: Any, flip: Dict[str, jax.Array], *,
     = all), ``xor`` (uint32 mask).  The output is replicated so every
     process can fetch all rows.  ``max_elems`` bounds the per-leaf fold
     on check steps (see :func:`_leaf_digest`) — the 10B+-param digest
-    cost knob (resilience.sdc_digest_max_elems).
+    cost knob (resilience.sdc_digest_max_elems).  ``leaf_specs`` (one
+    PartitionSpec per leaf, in ``jax.tree.leaves`` order — the trainer
+    passes the param shardings) steers the bounded subsample's per-dim
+    strides onto unsharded dims so the slice is shard-local
+    (:func:`_subsample_strides`); ignored when ``max_elems`` is None.
     """
     leaves = jax.tree.leaves(grads)
+    specs = (list(leaf_specs) if leaf_specs is not None
+             else [None] * len(leaves))
+    if len(specs) != len(leaves):
+        raise ValueError(
+            f"leaf_specs has {len(specs)} entries for {len(leaves)} "
+            "grad leaves")
 
     def block(flip, *ls):
         r = jax.lax.axis_index(axis)
@@ -136,7 +186,8 @@ def replica_digests(grads: Any, flip: Dict[str, jax.Array], *,
         for i, x in enumerate(ls):
             hit = hit_r & ((flip["leaf"] < 0) | (flip["leaf"] == i))
             rows.append(_leaf_digest(x, hit, flip["xor"],
-                                     max_elems=max_elems))
+                                     max_elems=max_elems,
+                                     spec=specs[i]))
         return jnp.stack(rows)[None]  # [1, leaves, 3] per replica
 
     digs = jax.shard_map(

@@ -74,6 +74,15 @@ _AUDITED_MODEL_FIELDS = frozenset({
     "rope_local_theta", "rope_longrope", "rope_scale", "rope_theta",
     "rope_yarn", "router_aux_weight", "sandwich_norms", "scan_layers",
     "tie_embeddings", "tp_vocab_head", "vocab_size", "window",
+    # PR-7 audit: quant* select TRAIN-forward matmul execution only —
+    # the param layout is unchanged and inference runs in the compute
+    # dtype (generate() strips quant; PagedDecoder's hand-written
+    # layer never quantizes), so a quant-trained model serves exactly
+    # like its unquantized twin.  overlap_fsdp only reshapes the train
+    # layer loop (scan vs unrolled prefetch); PagedDecoder owns its
+    # own loop and never consults it.
+    "quant", "quant_sites", "quant_amax_history_len", "quant_impl",
+    "overlap_fsdp",
 })
 
 

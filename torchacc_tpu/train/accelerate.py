@@ -46,6 +46,11 @@ def apply_config_to_model(mc: ModelConfig, config: Config) -> ModelConfig:
         remat_cls=(tuple(config.memory.gc_cls)
                    if config.memory.gc_cls else None),
         remat_cnt=config.memory.gc_cnt,
+        quant=config.compute.quant,
+        quant_sites=tuple(config.compute.quant_sites),
+        quant_amax_history_len=config.compute.quant_amax_history_len,
+        quant_impl=config.compute.quant_impl,
+        overlap_fsdp=config.perf.overlap_fsdp,
         context_parallel=config.dist.sp.size > 1,
         pp_size=config.dist.pp.size,
         pp_num_micro=config.dist.pp.num_micro_batches,

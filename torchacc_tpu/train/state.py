@@ -24,6 +24,11 @@ class TrainState(flax.struct.PyTreeNode):
     opt_state: Any
     # fp16 dynamic loss-scale state (train/amp.py); None for fp32/bf16
     scaler: Any = None
+    # delayed-scaling amax histories of the quantized matmul sites (the
+    # model's 'quant' collection, ops/quantized_matmul.py); None when
+    # compute.quant == 'none' — the tree then flattens identically to a
+    # pre-quant TrainState, so old checkpoints stay restorable
+    quant: Any = None
 
 
 def _path_str(path) -> str:
@@ -58,8 +63,12 @@ def state_logical_axes(abstract_state: TrainState, params_axes: Any) -> TrainSta
 
     opt_axes = tree_map_with_path(match, abstract_state.opt_state)
     scaler_axes = jax.tree.map(lambda _: (), abstract_state.scaler)
+    # amax histories are tiny [H] (or scan-stacked [L, H]) f32 arrays —
+    # replicate them (None axes) everywhere
+    quant_axes = jax.tree.map(
+        lambda l: (None,) * getattr(l, "ndim", 0), abstract_state.quant)
     return TrainState(step=(), params=params_axes, opt_state=opt_axes,
-                      scaler=scaler_axes)
+                      scaler=scaler_axes, quant=quant_axes)
 
 
 def init_train_state(
@@ -73,11 +82,16 @@ def init_train_state(
     parameters materialise directly into their shards."""
     if sample_input is None:
         sample_input = jnp.zeros((1, 8), dtype=jnp.int32)
-    params = model.init(rng, sample_input)["params"]
+    variables = model.init(rng, sample_input)
+    params = variables["params"]
+    # quantized-matmul sites create their amax histories at init (the
+    # 'quant' collection); absent for quant='none' models — the state
+    # tree is then identical to the pre-quant layout
+    quant = variables.get("quant")
     opt_state = optimizer.init(params)
     scaler = None
     if use_scaler:
         from torchacc_tpu.train.amp import scaler_init
         scaler = scaler_init()
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
-                      opt_state=opt_state, scaler=scaler)
+                      opt_state=opt_state, scaler=scaler, quant=quant)

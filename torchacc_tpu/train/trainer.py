@@ -130,6 +130,12 @@ class Trainer:
                 batch["input_ids"], batch.get("segment_ids")))))
         self._aux_weight = getattr(getattr(model, "cfg", None),
                                    "router_aux_weight", 0.0)
+        # quantized matmuls (compute.quant via the model cfg): the
+        # delayed-scaling amax histories ride TrainState.quant through
+        # the jitted step — dispatched, donated, checkpointed and
+        # restored exactly like the AMP scaler state
+        self._quant_on = (getattr(getattr(model, "cfg", None),
+                                  "quant", "none") != "none")
         # fused linear+CE (ops/fused.py): default loss only, zoo model only
         from torchacc_tpu.models.transformer import TransformerLM
         self._use_fused_ce = (loss is None
@@ -139,6 +145,19 @@ class Trainer:
                               # head_bias models (phi-2) use the
                               # materialised-logits loss
                               and not model.cfg.head_bias)
+        if (self._quant_on
+                and "head" in getattr(model.cfg, "quant_sites", ())
+                and self._use_fused_ce):
+            # the fused-CE path computes the head inside the chunked
+            # loss and never reaches the lm_head module — a 'head'
+            # quant site would be silently inert (with a dead amax
+            # history riding every checkpoint).  Keep the failure loud.
+            raise TrainerStateError(
+                "compute.quant_sites includes 'head' but the fused "
+                "linear+CE loss path is active — the chunked head "
+                "stays in the compute dtype.  Set "
+                "compute.fused_kernels=False to quantize the "
+                "materialised head, or drop 'head' from quant_sites.")
         # step-level anomaly guards (resilience/guard.py): EW grad-norm
         # statistics threaded through the jitted step, host-side
         # consecutive-anomaly monitor
@@ -168,6 +187,13 @@ class Trainer:
         self.last_resolved: Optional[_InFlightStep] = None
         self._host_step: Optional[int] = None
         self.blocked = BlockedMeter()
+        # save-path wall time (snapshot enqueue + checkpoint hand-off
+        # on writing steps) metered separately so records attribute the
+        # save-step sync gap honestly (save_blocked_ms; the verdict
+        # drain between the two is NOT included — its blocking fetches
+        # land in host_blocked_ms, and it may legitimately run an eval
+        # pass that must not be booked as save cost)
+        self.save_blocked = BlockedMeter()
         self.state: Optional[TrainState] = None
         self.state_shardings = None
         self._abstract: Optional[TrainState] = None
@@ -222,6 +248,8 @@ class Trainer:
                                      st_axes.opt_state, self.rules, min_sz),
             scaler=tree_shardings(self.mesh, abstract.scaler,
                                   st_axes.scaler, self.rules),
+            quant=tree_shardings(self.mesh, abstract.quant,
+                                 st_axes.quant, self.rules),
         )
         self._abstract = abstract
         return init_fn, rng
@@ -252,14 +280,20 @@ class Trainer:
         params = jax.device_put(params, sh.params)
         use_scaler = self.config.compute.dtype == "float16"
 
+        abstract_quant = self._abstract.quant if self._abstract else None
+
         def mk(p):
             scaler = None
             if use_scaler:
                 from torchacc_tpu.train.amp import scaler_init
                 scaler = scaler_init()
+            # fresh amax histories (zeros = "no observation yet"; the
+            # first quantized step falls back to just-in-time scales)
+            quant = jax.tree.map(lambda l: jnp.zeros(l.shape, l.dtype),
+                                 abstract_quant)
             return TrainState(step=jnp.zeros((), jnp.int32), params=p,
                               opt_state=self.optimizer.init(p),
-                              scaler=scaler)
+                              scaler=scaler, quant=quant)
 
         with jax.sharding.set_mesh(self.mesh):
             # donate: params would otherwise be held twice on device
@@ -276,12 +310,17 @@ class Trainer:
         return (bool(getattr(mc, "attn_dropout", 0.0))
                 and not self.config.compute.deterministic)
 
-    def _forward_sum_count(self, params, batch, dropout_seed=None):
-        """(loss_sum, token_count) incl. sown auxiliary losses (MoE router
-        load-balance — models/moe.py) weighted per token.
+    def _forward_sum_count(self, params, batch, dropout_seed=None,
+                           quant=None):
+        """(loss_sum, token_count, new_quant) incl. sown auxiliary losses
+        (MoE router load-balance — models/moe.py) weighted per token.
 
         ``dropout_seed`` is passed only on train steps of zoo models with
-        attn_dropout configured — eval/inference stays deterministic."""
+        attn_dropout configured — eval/inference stays deterministic.
+        ``quant`` is the delayed-scaling state (TrainState.quant) when
+        quantized matmuls are on; the mutated histories come back as the
+        third element (None when quant is off — eval discards them, the
+        train step threads them into the next TrainState)."""
         pp = self.config.dist.pp
         if (pp.size > 1 and pp.schedule == "1f1b"
                 and hasattr(self.model, "cfg")):
@@ -294,7 +333,7 @@ class Trainer:
             from torchacc_tpu.models.transformer import (
                 pp_1f1b_forward_sum_count,
             )
-            return pp_1f1b_forward_sum_count(
+            l_sum, count = pp_1f1b_forward_sum_count(
                 self.model.cfg, params, batch["input_ids"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
@@ -303,7 +342,15 @@ class Trainer:
                               else None),
                 use_fused_ce=self._use_fused_ce,
                 custom_loss=(self.loss if self._custom_loss else None))
+            return l_sum, count, None
         extra = {}
+        variables = {"params": params}
+        mutable = ["intermediates"]
+        if quant is not None:
+            # quantized sites read the delayed scales and append this
+            # step's amax; eval callers discard the mutation
+            variables["quant"] = quant
+            mutable.append("quant")
         if dropout_seed is not None and self._attn_dropout_on:
             extra["dropout_seed"] = dropout_seed
         # labels are needed by the aux-weight block AND the fused-CE
@@ -343,11 +390,11 @@ class Trainer:
         if self._use_fused_ce:
             from torchacc_tpu.ops.fused import fused_linear_cross_entropy
             hidden, mutated = self.model.apply(
-                {"params": params}, batch["input_ids"],
+                variables, batch["input_ids"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
                 return_hidden=True,
-                mutable=["intermediates"], **extra)
+                mutable=mutable, **extra)
             if "lm_head" in params:
                 w_head = params["lm_head"]["kernel"]
             else:  # tied embeddings
@@ -361,10 +408,10 @@ class Trainer:
                 logit_softcap=self.model.cfg.logit_softcap)
         else:
             out = self.model.apply(
-                {"params": params}, batch["input_ids"],
+                variables, batch["input_ids"],
                 positions=batch.get("positions"),
                 segment_ids=batch.get("segment_ids"),
-                mutable=["intermediates"], **extra)
+                mutable=mutable, **extra)
             logits, mutated = out
             res = self.loss(logits, batch)
             if isinstance(res, tuple):
@@ -374,7 +421,8 @@ class Trainer:
         if self._aux_weight:
             from torchacc_tpu.models.transformer import _sown_aux_sum
             l_sum = l_sum + self._aux_weight * _sown_aux_sum(mutated) * count
-        return l_sum, count
+        return l_sum, count, (mutated.get("quant")
+                              if quant is not None else None)
 
     def _build_train_step(self, sample_batch):
         accum = self.config.grad_accum
@@ -389,6 +437,7 @@ class Trainer:
         res_cfg = self.config.resilience
         guard_on = self._guard_on
         sdc_on = self._sdc_on
+        quant_on = self._quant_on
 
         def train_step(state: TrainState, batch: Dict[str, jax.Array],
                        gstate=None, sdc_flip=None):
@@ -407,11 +456,11 @@ class Trainer:
             # fresh mask); eval/inference never passes one
             if dropout_on:
                 step_seed = state.step.astype(jnp.int32) * accum
-                fsc = lambda p, b, s=None: base_fsc(
+                fsc = lambda p, b, s=None, q=None: base_fsc(
                     p, b, dropout_seed=step_seed if s is None
-                    else step_seed + s)
+                    else step_seed + s, quant=q)
             else:
-                fsc = lambda p, b, s=None: base_fsc(p, b)
+                fsc = lambda p, b, s=None, q=None: base_fsc(p, b, quant=q)
             # fp16: scale the loss so small grads survive the fp16 range
             # (reference GradScaler core/amp.py; here fully in-jit)
             scale = (state.scaler["scale"] if use_scaler
@@ -422,11 +471,21 @@ class Trainer:
                     raise ValueError(
                         f"batch size {bsz} not divisible by grad_accum {accum}")
 
-                def scaled_sum(p, mb, mi):
-                    l, c = fsc(p, mb, mi)
-                    return l * scale, c
+                if quant_on:
+                    # the micro-steps chain the delayed-scaling state:
+                    # micro i quantizes with the history micro i-1 left
+                    # (same sequencing an unaccumulated loop would see)
+                    def scaled_sum_q(p, mb, mi, q):
+                        l, c, q2 = fsc(p, mb, mi, q)
+                        return l * scale, (c, q2)
+                    grad_sum_q = jax.value_and_grad(scaled_sum_q,
+                                                    has_aux=True)
+                else:
+                    def scaled_sum(p, mb, mi):
+                        l, c, _ = fsc(p, mb, mi)
+                        return l * scale, c
 
-                grad_sum = jax.value_and_grad(scaled_sum, has_aux=True)
+                    grad_sum = jax.value_and_grad(scaled_sum, has_aux=True)
 
                 # grad accumulators in compute.accum_dtype (bfloat16 halves
                 # the buffer memory; f32 default keeps exact summation)
@@ -436,6 +495,13 @@ class Trainer:
 
                 def micro(carry, xs):
                     mb, mi = xs
+                    if quant_on:
+                        g_acc, l_acc, c_acc, q = carry
+                        (l, (c, q2)), g = grad_sum_q(fwd_params, mb, mi, q)
+                        return (jax.tree.map(
+                                    lambda a, b: a + b.astype(acc_dt),
+                                    g_acc, g),
+                                l_acc + l, c_acc + c, q2), None
                     g_acc, l_acc, c_acc = carry
                     (l, c), g = grad_sum(fwd_params, mb, mi)
                     return (jax.tree.map(
@@ -450,19 +516,36 @@ class Trainer:
                 mbs = jax.tree.map(to_micro, batch)
                 zeros = jax.tree.map(
                     lambda p: jnp.zeros(p.shape, acc_dt), state.params)
-                (grads, loss_sum, count), _ = jax.lax.scan(
-                    micro, (zeros, jnp.zeros((), jnp.float32),
-                            jnp.zeros((), jnp.float32)),
-                    (mbs, jnp.arange(accum, dtype=jnp.int32)))
+                carry0 = (zeros, jnp.zeros((), jnp.float32),
+                          jnp.zeros((), jnp.float32))
+                if quant_on:
+                    carry0 = carry0 + (state.quant,)
+                    (grads, loss_sum, count, new_quant), _ = jax.lax.scan(
+                        micro, carry0,
+                        (mbs, jnp.arange(accum, dtype=jnp.int32)))
+                else:
+                    new_quant = None
+                    (grads, loss_sum, count), _ = jax.lax.scan(
+                        micro, carry0,
+                        (mbs, jnp.arange(accum, dtype=jnp.int32)))
                 denom = jnp.maximum(count, 1.0) * scale
                 grads = jax.tree.map(
                     lambda g: g.astype(jnp.float32) / denom, grads)
                 loss_val = loss_sum / denom
             else:
-                def scalar(p):
-                    l, c = fsc(p, batch)
-                    return (l / jnp.maximum(c, 1.0)) * scale
-                loss_s, grads = jax.value_and_grad(scalar)(fwd_params)
+                if quant_on:
+                    def scalar_q(p):
+                        l, c, q2 = fsc(p, batch, q=state.quant)
+                        return (l / jnp.maximum(c, 1.0)) * scale, q2
+                    (loss_s, new_quant), grads = jax.value_and_grad(
+                        scalar_q, has_aux=True)(fwd_params)
+                else:
+                    new_quant = None
+
+                    def scalar(p):
+                        l, c, _ = fsc(p, batch)
+                        return (l / jnp.maximum(c, 1.0)) * scale
+                    loss_s, grads = jax.value_and_grad(scalar)(fwd_params)
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 loss_val = loss_s / scale
 
@@ -473,9 +556,20 @@ class Trainer:
                 # OWN physical copy, so a flaky chip's bits diverge
                 # here and nowhere upstream can hide them
                 from torchacc_tpu.resilience.sdc import replica_digests
+                # param shardings steer the bounded subsample's strides
+                # onto unsharded dims (shard-local digesting — no GSPMD
+                # gather on huge fsdp/tp-sharded leaves); grads share
+                # the params' tree structure
+                leaf_specs = None
+                if (res_cfg.sdc_digest_max_elems is not None
+                        and self.state_shardings is not None):
+                    leaf_specs = [
+                        getattr(s, "spec", None) for s in
+                        jax.tree.leaves(self.state_shardings.params)]
                 sdc_digests = replica_digests(
                     grads, sdc_flip, mesh=self.mesh,
-                    max_elems=res_cfg.sdc_digest_max_elems)
+                    max_elems=res_cfg.sdc_digest_max_elems,
+                    leaf_specs=leaf_specs)
 
             from torchacc_tpu.train.amp import global_norm_f32
 
@@ -513,6 +607,11 @@ class Trainer:
                                          state.params)
                 new_opt = select_tree(keep, opt_candidate, state.opt_state)
                 new_scaler = scaler_update(state.scaler, finite)
+                if quant_on:
+                    # a skipped (overflow/anomalous) step must not poison
+                    # the amax history either — its activations may be
+                    # the very non-finite values being skipped
+                    new_quant = select_tree(keep, new_quant, state.quant)
             else:
                 updates, opt_candidate = optimizer.update(
                     grads, state.opt_state, state.params)
@@ -525,6 +624,9 @@ class Trainer:
                                              state.params)
                     new_opt = select_tree(ok, opt_candidate,
                                           state.opt_state)
+                    if quant_on:
+                        new_quant = select_tree(ok, new_quant,
+                                                state.quant)
 
             metrics = {
                 "loss": loss_val,
@@ -538,7 +640,9 @@ class Trainer:
             if sdc_on:
                 metrics["sdc_digests"] = sdc_digests
             new_state = TrainState(step=state.step + 1, params=new_params,
-                                   opt_state=new_opt, scaler=new_scaler)
+                                   opt_state=new_opt, scaler=new_scaler,
+                                   quant=(new_quant if quant_on
+                                          else state.quant))
             if offload_live:
                 # pin output shardings in-graph instead of via
                 # out_shardings (see the jit below)
@@ -966,6 +1070,7 @@ class Trainer:
         self._inflight.clear()
         self.last_resolved = None
         self.blocked.take_ms()
+        self.save_blocked.take_ms()
         resumed_loader_state = None
         start_step = 0
         if resume is not None:
@@ -1145,6 +1250,11 @@ class Trainer:
             # last record, and at what pipeline depth — the tentpole's
             # measurement seam (utils/metrics.BlockedMeter)
             rec["host_blocked_ms"] = round(self.blocked.take_ms(), 3)
+            # wall time the save path cost this interval (snapshot
+            # enqueue + checkpoint hand-off on writing steps; the
+            # verdict drain's fetches land in host_blocked_ms) — the
+            # save-step sync-gap triage signal
+            rec["save_blocked_ms"] = round(self.save_blocked.take_ms(), 3)
             rec["dispatch_depth"] = self._lag + 1
             # degradation counters ride the record so operators
             # see retries/skips/resumes in metrics.jsonl too
@@ -1201,18 +1311,42 @@ class Trainer:
                 if mgr is not None:
                     # verdict-before-durability: a checkpoint must never
                     # commit a step whose guard/SDC verdict is still in
-                    # flight — drain the ring first so the abort raises
-                    # BEFORE the save, exactly as the unpipelined loop
-                    # ordered it (no-op at dispatch_depth=1, and on
-                    # non-writing steps via the should_save probe)
-                    if self.pending and mgr.should_save(step_idx + 1):
-                        _drain_all()
-                    # label = completed-step count == state.step after
-                    # this step; the loader's durable state rides along
-                    # (callable: only materialised on steps that write)
-                    saved = mgr.save(step_idx + 1, self.state,
-                                     loader_state=loader_state_fn,
-                                     guard_state=guard_state_fn)
+                    # flight — the ring drains BEFORE anything becomes
+                    # durable, so the abort raises first, exactly as the
+                    # unpipelined loop ordered it.  Save-step sync-gap
+                    # half-step (ROADMAP #3/#4): the donation-safe
+                    # snapshot is ENQUEUED before the drain — it is a
+                    # device-side copy with no host fetch, so the copy
+                    # executes while the drain's verdict fetches wait
+                    # (and while the next step dispatches after save()
+                    # hands off to the async writer); only the verdict
+                    # ordering is serialised, not the copy.  Label =
+                    # completed-step count == state.step after this
+                    # step; loader state rides along (callable: only
+                    # materialised on steps that write).
+                    if mgr.should_save(step_idx + 1):
+                        from torchacc_tpu.checkpoint.io import _snapshot
+                        with self.save_blocked.blocked():
+                            snap = _snapshot(self.state)
+                        # the drain stays OUTSIDE the save meter: its
+                        # blocking fetches already land in
+                        # host_blocked_ms, and a drained entry may run
+                        # a whole eval pass (eval_every boundary) —
+                        # charging that to save_blocked_ms would
+                        # misattribute eval cost to the save path
+                        if self.pending:
+                            _drain_all()
+                        with self.save_blocked.blocked():
+                            saved = mgr.save(step_idx + 1, snap,
+                                             presnapshotted=True,
+                                             loader_state=loader_state_fn,
+                                             guard_state=guard_state_fn)
+                    else:
+                        # non-writing step: save() only commits pending
+                        # manifests of finished background writes
+                        saved = mgr.save(step_idx + 1, self.state,
+                                         loader_state=loader_state_fn,
+                                         guard_state=guard_state_fn)
                 # cross-host sync point: the emergency save triggers on
                 # EVERY host at this same boundary when ANY host saw the
                 # signal (exact local-flag check in single-process runs).
@@ -1389,7 +1523,9 @@ class Trainer:
             fsc = self._forward_sum_count
 
             def ev(state, batch):
-                l, c = fsc(state.params, batch)
+                # eval reads the trained delayed scales without mutating
+                # them (the returned histories are discarded)
+                l, c, _ = fsc(state.params, batch, quant=state.quant)
                 return l / jnp.maximum(c, 1.0)
             self._eval_step = jax.jit(
                 ev, in_shardings=(self.state_shardings,
