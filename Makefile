@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -41,6 +41,15 @@ bench-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve --fast --platform cpu
 
+# train->serve handoff gate (docs/serving.md "Live weight handoff"):
+# fit -> in-memory handoff -> serve -> fit -> handoff again on an
+# emulated 8-device fsdp/tp mesh; FAILS unless the served tokens are
+# identical to serving checkpoint-round-trip weights AND the second
+# handoff is a pure transfer-cache hit (no recompile)
+handoff-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench.py --handoff --fast --platform cpu
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -52,7 +61,8 @@ chaos:
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
 			tests/test_watchdog.py tests/test_elastic.py \
 			tests/test_sdc.py tests/test_perf.py \
-			tests/test_serving.py tests/test_quant.py -m "not slow" \
+			tests/test_serving.py tests/test_quant.py \
+			tests/test_handoff.py -m "not slow" \
 			-q || exit 1; \
 	done
 

@@ -274,6 +274,18 @@ def main() -> int:
                          "layer's hot-loop cost; read it off the "
                          "host_blocked_ms_per_step detail row at "
                          "--dispatch-depth 1 vs >1")
+    ap.add_argument("--handoff", action="store_true",
+                    help="benchmark the in-memory train->serve weight "
+                         "handoff (Trainer.serving_params -> "
+                         "ServeEngine.load_params, parallel/transfer.py)"
+                         ": time a fit->serve->fit round trip, report "
+                         "handoff_ms / transfer_compile_ms / "
+                         "transfer_cache_hits / bytes moved vs the "
+                         "checkpoint round-trip, and FAIL unless the "
+                         "served tokens are identical to serving the "
+                         "checkpoint-restored weights AND the second "
+                         "handoff is a pure cache hit (`make "
+                         "handoff-smoke` runs this on CPU as the gate)")
     ap.add_argument("--serve", action="store_true",
                     help="benchmark the continuous-batching serving "
                          "engine (torchacc_tpu/serve) on a mixed-length "
@@ -304,6 +316,11 @@ def _bench(args, wd: Watchdog) -> int:
     dev, n_chips = devs[0], len(devs)
     print(f"[bench] devices: {n_chips}x {getattr(dev, 'device_kind', dev)}",
           file=sys.stderr)
+
+    if args.handoff:
+        # same fresh-compile policy as the serve path (the serving
+        # decode loop is half of this leg)
+        return _bench_handoff(args, wd, devs)
 
     if args.serve:
         # NO persistent compile cache on the serve path: on jax 0.4.x
@@ -682,6 +699,194 @@ def _bench_serve(args, wd: Watchdog, devs) -> int:
             "max_new_tokens": max_new,
             "max_slots": max_slots,
             "prefill_chunk": chunk,
+            "n_chips": n_chips,
+            "fast": bool(args.fast),
+            "wall_s": round(time.monotonic() - _T0, 1),
+        },
+    }
+    _emit(result)
+    return 0
+
+
+def _bench_handoff(args, wd: Watchdog, devs) -> int:
+    """In-memory train→serve handoff benchmark (docs/serving.md "Live
+    weight handoff").
+
+    Drives a fit→serve→fit→serve round trip on one process: train a few
+    steps, hand ``state.params`` to a ServeEngine through the compiled
+    layout-transfer engine (parallel/transfer.py), serve greedy
+    requests, train again, hand off again.  The second handoff MUST be
+    a pure cache hit (``transfer_compiles`` unchanged) — a recompile
+    per handoff would put trace time back on the RL-loop critical path.
+    Correctness gate: the served tokens must be identical to serving
+    the SAME weights restored via a checkpoint round-trip (the old
+    road), whose wall time is also the ``vs_baseline`` denominator —
+    value/vs_baseline read as "handoff_ms" and "checkpoint round trip
+    is N× slower".
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, get_preset
+    from torchacc_tpu.parallel.transfer import (
+        cache_stats,
+        clear_cache,
+        transfer_plan,
+    )
+    from torchacc_tpu.serve import Request, ServeEngine
+    from torchacc_tpu.train import Trainer
+
+    n_chips = len(devs)
+    metric = "train_serve_handoff_ms"
+
+    def fail(error: str, stage: str) -> int:
+        _emit({"metric": metric, "value": 0.0, "unit": "ms",
+               "vs_baseline": 0.0, "error": error, "stage": stage,
+               "elapsed_s": round(time.monotonic() - _T0, 1)})
+        return 1
+
+    wd.stage("handoff_build_model", 120)
+    if args.fast:
+        mc = get_preset(
+            "llama-tiny", dtype=jnp.float32, hidden_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=4,
+            intermediate_size=512, vocab_size=512, max_seq_len=128)
+        seq, batch, fit_steps, max_new = 32, 4, 2, 8
+    else:
+        mc = get_preset(
+            "llama-tiny",
+            hidden_size=1024, num_layers=24, num_heads=8, num_kv_heads=8,
+            intermediate_size=4096, vocab_size=32000, max_seq_len=2048)
+        seq, batch, fit_steps, max_new = 512, 4, 5, 32
+    cfg = ta.Config()
+    # a real train layout when the device count allows: fsdp ZeRO shards
+    # + megatron tp — the serving layout gathers fsdp and keeps tp, so
+    # the transfer is a genuine multi-axis reshard, not a no-op copy
+    if n_chips >= 8:
+        cfg.dist.fsdp.size = 2
+        cfg.dist.tp.size = 2
+        cfg.dist.dp.size = n_chips // 4
+        batch = max(batch, cfg.dist.dp.size * cfg.dist.fsdp.size)
+    elif n_chips >= 2:
+        cfg.dist.fsdp.size = 2
+        cfg.dist.dp.size = n_chips // 2
+        batch = max(batch, n_chips)
+    cfg.serve.block_size = 16
+    cfg.serve.max_slots = 4
+    cfg.serve.prefill_chunk = 16
+    cfg.serve.num_blocks = 128
+    clear_cache()
+
+    model = TransformerLM(mc)
+    trainer = Trainer(model, cfg, optimizer=optax.adamw(1e-3))
+    trainer.init()
+    rng = np.random.default_rng(0)
+    batch_data = {"input_ids": jnp.asarray(
+        rng.integers(0, mc.vocab_size, size=(batch, seq)), jnp.int32)}
+    prompts = [rng.integers(1, mc.vocab_size, size=n).tolist()
+               for n in (4, 9, 17, 6)]
+    reqs = lambda: [Request(prompt_ids=p, max_new_tokens=max_new)  # noqa: E731
+                    for p in prompts]
+
+    wd.stage("handoff_fit_phase_1", args.compile_budget)
+    for _ in range(fit_steps):
+        m = trainer.step(batch_data)
+    float(m["loss"])
+
+    # handoff #1 (cold: pays the one-time layout-pair compile) + the
+    # serving-engine build.  Engine construction (pool allocation,
+    # decode program compiles on first generate) is deliberately
+    # outside the handoff timer — it happens once per process, not per
+    # phase; the per-phase cost is serving_params + load_params.
+    wd.stage("handoff_cold", args.compile_budget)
+    t0 = time.perf_counter()
+    params = trainer.serving_params()
+    jax.block_until_ready(params)
+    handoff_cold_ms = (time.perf_counter() - t0) * 1e3
+    stats_cold = cache_stats()
+    engine = ServeEngine(model, params, cfg, mesh=trainer.mesh)
+    engine.generate(reqs())  # warm the decode/prefill programs
+    for r in list(engine._all):
+        engine.discard(r)
+
+    wd.stage("handoff_fit_phase_2", args.compile_budget)
+    for _ in range(fit_steps):
+        m = trainer.step(batch_data)
+    float(m["loss"])
+
+    # handoff #2 (warm: MUST be a pure cache hit)
+    wd.stage("handoff_warm", 120)
+    t0 = time.perf_counter()
+    params2 = trainer.serving_params()
+    jax.block_until_ready(params2)
+    engine.load_params(params2)
+    handoff_ms = (time.perf_counter() - t0) * 1e3
+    stats_warm = cache_stats()
+    if stats_warm["compiles"] != stats_cold["compiles"]:
+        return fail(
+            f"second handoff recompiled the transfer program "
+            f"({stats_cold['compiles']} -> {stats_warm['compiles']} "
+            f"compiles) — the layout-pair cache missed", "cache")
+    res2 = [r.tokens for r in engine.generate(reqs())]
+
+    # checkpoint round-trip baseline: the pre-PR road from the SAME
+    # train state to serving weights (save -> host restore -> dtype
+    # cast -> device_put into the serving layout)
+    wd.stage("handoff_ckpt_baseline", args.compile_budget)
+    from torchacc_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    tdir = tempfile.mkdtemp(prefix="bench_handoff_")
+    try:
+        ck = os.path.join(tdir, "params")
+        dt = mc.dtype
+        t0 = time.perf_counter()
+        save_checkpoint(ck, trainer.state.params)
+        host = restore_checkpoint(ck)
+        host = jax.tree.map(
+            lambda x: np.asarray(x, dt)
+            if np.issubdtype(np.asarray(x).dtype, np.floating) else x, host)
+        ckpt_params = jax.device_put(host, trainer.serving_shardings())
+        jax.block_until_ready(ckpt_params)
+        ckpt_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        shutil.rmtree(tdir, ignore_errors=True)
+
+    wd.stage("handoff_verify", 120)
+    engine.load_params(ckpt_params)
+    res_ckpt = [r.tokens for r in engine.generate(reqs())]
+    if res2 != res_ckpt:
+        return fail("post-handoff greedy serving diverges from serving "
+                    "the checkpoint-round-trip weights", "verify")
+
+    wd.stage("report", 60)
+    plan = transfer_plan(trainer.state.params, trainer.serving_shardings(),
+                         dtype=mc.dtype)
+    moved = sum(r["bytes_moved"] for r in plan)
+    result = {
+        "metric": metric,
+        "value": round(handoff_ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(ckpt_ms / max(handoff_ms, 1e-6), 2),
+        "detail": {
+            "handoff_ms": round(handoff_ms, 2),
+            "handoff_cold_ms": round(handoff_cold_ms, 2),
+            "ckpt_roundtrip_ms": round(ckpt_ms, 2),
+            "transfer_compile_ms": round(stats_warm["compile_ms"], 2),
+            "transfer_compiles": stats_warm["compiles"],
+            "transfer_cache_hits": stats_warm["cache_hits"],
+            "bytes_moved_per_handoff": moved,
+            "leaves": len(plan),
+            "leaves_resharded": sum(1 for r in plan if r["bytes_moved"]),
+            "token_identical_to_ckpt_roundtrip": True,
+            "mesh": {k: int(v) for k, v in trainer.mesh.shape.items()
+                     if int(v) > 1},
+            "params_m": round(mc.num_params() / 1e6, 1),
+            "fit_steps_per_phase": fit_steps,
             "n_chips": n_chips,
             "fast": bool(args.fast),
             "wall_s": round(time.monotonic() - _T0, 1),

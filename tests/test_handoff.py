@@ -1,0 +1,416 @@
+"""Train→serve layout-transfer tests: the compiled spec-to-spec
+resharding engine (parallel/transfer.py), the checkpoint-free weight
+handoff seam (``Trainer.serving_params`` →
+``ServeEngine.from_train_state`` / ``load_params``), and the offline
+reshard path re-routed through the same engine.
+
+The acceptance contract these pin (ISSUE 8):
+
+- the in-memory handoff performs ZERO checkpoint I/O (orbax save is
+  monkeypatched to raise while the handoff runs);
+- post-handoff greedy serving is token-identical to serving the same
+  weights restored via a checkpoint round-trip, on an emulated
+  multi-device fsdp/tp→serving mesh;
+- the per-layout-pair transfer program compiles exactly once (the
+  second handoff is a pure cache hit);
+- same-layout transfer is bitwise identity; donation is not observable
+  in outputs; a quant-trained state hands off in the compute dtype.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+import torchacc_tpu as ta
+from torchacc_tpu.models import TransformerLM, get_preset
+from torchacc_tpu.parallel.transfer import (
+    cache_stats,
+    clear_cache,
+    format_plan,
+    serving_specs,
+    transfer,
+    transfer_plan,
+)
+from torchacc_tpu.serve import Request, ServeEngine
+from torchacc_tpu.train import Trainer, accelerate
+
+pytestmark = pytest.mark.handoff
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+VOCAB = 128
+
+
+@pytest.fixture(autouse=True)
+def _fresh_transfer_cache():
+    clear_cache()
+    yield
+    clear_cache()
+
+
+def _model():
+    return get_preset("llama-tiny", vocab_size=VOCAB, hidden_size=64,
+                      num_layers=2, num_heads=4, num_kv_heads=4,
+                      intermediate_size=128, max_seq_len=64)
+
+
+def _config(dp=2, fsdp=2, tp=2, **compute):
+    cfg = ta.Config()
+    cfg.dist.dp.size = dp
+    cfg.dist.fsdp.size = fsdp
+    cfg.dist.tp.size = tp
+    # f32 compute unless a test overrides: greedy token comparisons
+    # across layouts want full-precision determinism (accelerate maps
+    # compute.dtype onto the model cfg)
+    cfg.compute.dtype = "float32"
+    for k, v in compute.items():
+        setattr(cfg.compute, k, v)
+    cfg.serve.block_size = 8
+    cfg.serve.num_blocks = 64
+    cfg.serve.max_slots = 2
+    cfg.serve.prefill_chunk = 8
+    return cfg
+
+
+def _trainer(**compute):
+    cfg = _config(**compute)
+    tr, _ = accelerate(_model(), None, cfg,
+                       optimizer=optax.adamw(1e-3))
+    tr.init()
+    return tr
+
+
+def _batch(seed=None):
+    rng = np.random.default_rng(CHAOS_SEED if seed is None else seed)
+    return {"input_ids": jnp.asarray(
+        rng.integers(0, VOCAB, size=(4, 16)), jnp.int32)}
+
+
+def _prompts():
+    rng = np.random.default_rng(CHAOS_SEED + 7)
+    return [rng.integers(1, VOCAB, size=n).tolist() for n in (3, 9, 14)]
+
+
+def _serve(engine, max_new=8):
+    res = engine.generate([Request(prompt_ids=p, max_new_tokens=max_new)
+                           for p in _prompts()])
+    toks = [r.tokens for r in res]
+    for r in res:
+        engine.discard(r.request_id)
+    return toks
+
+
+def _leaves_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# -- the engine itself --------------------------------------------------------
+
+def test_same_layout_transfer_is_bitwise_identity(devices):
+    t = _trainer()
+    src = t.state.params
+    out = transfer(src, t.state_shardings.params)
+    assert _leaves_equal(src, out)
+    # layouts preserved leaf-for-leaf
+    for x, y in zip(jax.tree.leaves(src), jax.tree.leaves(out)):
+        assert x.sharding == y.sharding
+    s = cache_stats()
+    assert s["compiles"] == 1 and s["cache_hits"] == 0
+    # a same-layout pair moves nothing
+    plan = transfer_plan(src, t.state_shardings.params)
+    assert sum(r["bytes_moved"] for r in plan) == 0
+    out2 = transfer(src, t.state_shardings.params)
+    assert _leaves_equal(src, out2)
+    s = cache_stats()
+    assert s["compiles"] == 1 and s["cache_hits"] == 1
+
+
+def test_transfer_reshards_train_to_serving_layout(devices):
+    t = _trainer()
+    target = t.serving_shardings()
+    out = transfer(t.state.params, target)
+    assert _leaves_equal(t.state.params, out)
+    flat_out = dict(zip(
+        (r["path"] for r in transfer_plan(t.state.params, target)),
+        jax.tree.leaves(out)))
+    # the embedding was (vocab='tp', embed='fsdp'); serving keeps tp,
+    # gathers fsdp
+    emb = flat_out["embed_tokens/embedding"]
+    assert emb.sharding.spec == PartitionSpec("tp", None)
+    for leaf in jax.tree.leaves(out):
+        spec = leaf.sharding.spec
+        flat = [a for p in spec if p
+                for a in (p if isinstance(p, tuple) else (p,))]
+        assert "fsdp" not in flat and "dp" not in flat
+
+
+def test_transfer_dtype_cast_floating_only(devices):
+    mesh = ta.Config().get_mesh()
+    tree = {"w": jax.device_put(np.linspace(-1, 1, 32, dtype=np.float32),
+                                NamedSharding(mesh, PartitionSpec())),
+            "i": jax.device_put(np.arange(8, dtype=np.int32),
+                                NamedSharding(mesh, PartitionSpec()))}
+    tgt = {"w": NamedSharding(mesh, PartitionSpec()),
+           "i": NamedSharding(mesh, PartitionSpec())}
+    out = transfer(tree, tgt, dtype=jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["i"].dtype == jnp.int32
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]),
+        np.asarray(tree["w"]).astype(jnp.bfloat16))
+    # a different dtype is a different layout pair — its own program
+    assert cache_stats()["compiles"] == 1
+    transfer(tree, tgt)
+    assert cache_stats()["compiles"] == 2
+
+
+def test_donation_is_not_observable_in_outputs(devices):
+    t = _trainer()
+    target = t.serving_shardings()
+    src = t.state.params
+    keep = jax.tree.map(jnp.copy, src)
+    out_plain = transfer(keep, target)
+    out_donated = transfer(src, target, donate=True)
+    assert _leaves_equal(out_plain, out_donated)
+    for x, y in zip(jax.tree.leaves(out_plain),
+                    jax.tree.leaves(out_donated)):
+        assert x.sharding == y.sharding
+
+
+def test_transfer_accepts_host_numpy_tree(devices):
+    # the offline checkpoint path: host-restored numpy leaves ride the
+    # same engine (host→mesh is just another source layout)
+    mesh = ta.Config().get_mesh()
+    tree = {"a": np.arange(16, dtype=np.float32).reshape(2, 8)}
+    tgt = {"a": jax.ShapeDtypeStruct(
+        (2, 8), jnp.bfloat16,
+        sharding=NamedSharding(mesh, PartitionSpec(None, "fsdp")))}
+    out = transfer(tree, tgt)
+    assert out["a"].dtype == jnp.bfloat16
+    assert out["a"].sharding.spec == PartitionSpec(None, "fsdp")
+    np.testing.assert_array_equal(
+        np.asarray(out["a"]), tree["a"].astype(jnp.bfloat16))
+
+
+def test_serving_specs_units():
+    rules = ta.parallel.make_rules()
+    specs = serving_specs({"e": ("vocab", "embed"),
+                           "m": ("embed", "mlp"),
+                           "n": ("norm",),
+                           "s": ()}, rules)
+    assert specs["e"] == PartitionSpec("tp", None)
+    assert specs["m"] == PartitionSpec(None, "tp")
+    assert specs["n"] == PartitionSpec(None)
+    assert specs["s"] == PartitionSpec()
+
+
+def test_transfer_plan_and_format(devices):
+    t = _trainer()
+    rows = transfer_plan(t.state.params, t.serving_shardings(),
+                         dtype=jnp.bfloat16)
+    assert all(r["dst_dtype"] == "bfloat16" for r in rows)
+    moved = [r for r in rows if r["bytes_moved"]]
+    assert moved, "fsdp->serving must move bytes"
+    text = format_plan(rows, max_rows=2)
+    assert "layout-pair plan" in text and "->" in text
+    assert f"{len(rows)} leaves" in text
+
+
+# -- the Trainer seam ---------------------------------------------------------
+
+def test_serving_params_strips_state_and_drains(devices):
+    t = _trainer()
+    for _ in range(2):
+        t.step(_batch())
+    assert t.pending >= 1  # dispatch_depth 2 keeps one step in flight
+    p = t.serving_params()
+    assert t.pending == 0  # verdicts resolved before the handoff
+    # only the param tree crosses: same structure, values equal
+    assert (jax.tree.structure(p)
+            == jax.tree.structure(t.state.params))
+    assert _leaves_equal(t.state.params, p)
+    assert t.state.opt_state is not None  # training state untouched
+
+
+def test_serving_params_donate_is_terminal(devices):
+    t = _trainer()
+    t.step(_batch())
+    ref = t.serving_params()          # non-donated copy for comparison
+    p = t.serving_params(donate=True)
+    assert t.state is None
+    assert _leaves_equal(ref, p)
+
+
+def test_quant_trained_state_hands_off_in_compute_dtype(devices):
+    # bf16 compute / f32 param masters: the handoff must land the bf16
+    # serving copy (the cast rides the same compiled program)
+    t = _trainer(dtype="bfloat16", quant="int8")
+    t.step(_batch())
+    t.drain()
+    assert t.state.quant is not None  # amax histories exist in training
+    assert jax.tree.leaves(t.state.params)[0].dtype == jnp.float32
+    p = t.serving_params()
+    # params only — the quant collection never crosses the handoff —
+    # and floating leaves land in the model's compute dtype
+    assert (jax.tree.structure(p)
+            == jax.tree.structure(t.state.params))
+    cfg_dtype = t.model.cfg.dtype
+    for leaf in jax.tree.leaves(p):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == cfg_dtype
+
+
+# -- the full handoff: acceptance contract ------------------------------------
+
+def test_handoff_token_identity_and_zero_checkpoint_io(
+        devices, tmp_path, monkeypatch):
+    import orbax.checkpoint as ocp
+
+    t = _trainer()
+    for _ in range(3):
+        t.step(_batch())
+
+    def _no_io(*a, **k):
+        raise AssertionError(
+            "checkpoint I/O attempted during the in-memory handoff")
+
+    with monkeypatch.context() as mp:
+        # zero checkpoint I/O: any orbax write (or framework save) on
+        # the handoff path is a hard failure
+        mp.setattr(ocp.StandardCheckpointer, "save", _no_io)
+        mp.setattr(ocp.Checkpointer, "save", _no_io, raising=False)
+        import torchacc_tpu.checkpoint.io as cio
+        mp.setattr(cio, "save_checkpoint", _no_io)
+        engine = ServeEngine.from_train_state(t, t.config)
+        toks_handoff = _serve(engine)
+    assert toks_handoff and all(len(x) == 8 for x in toks_handoff)
+
+    # the old road: checkpoint round-trip of the SAME weights into the
+    # same serving layout, served by the same engine
+    from torchacc_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    ck = str(tmp_path / "params")
+    save_checkpoint(ck, t.state.params)
+    host = restore_checkpoint(ck)
+    host = jax.tree.map(
+        lambda x: np.asarray(x, t.model.cfg.dtype)
+        if np.issubdtype(np.asarray(x).dtype, np.floating) else x, host)
+    ckpt_params = jax.device_put(host, t.serving_shardings())
+    engine.load_params(ckpt_params)
+    toks_ckpt = _serve(engine)
+    assert toks_handoff == toks_ckpt
+
+
+def test_second_handoff_is_pure_cache_hit(devices):
+    t = _trainer()
+    t.step(_batch())
+    engine = ServeEngine.from_train_state(t, t.config)
+    s1 = cache_stats()
+    assert s1["compiles"] == 1
+    before = np.asarray(jax.tree.leaves(engine.scheduler.params)[0])
+    pool = engine.scheduler.pool
+    toks1 = _serve(engine)
+    for _ in range(3):
+        t.step(_batch())
+    engine.load_params(t.serving_params())
+    s2 = cache_stats()
+    assert s2["compiles"] == 1, "second handoff must not recompile"
+    assert s2["cache_hits"] >= s1["cache_hits"] + 1
+    # the swap took: weights actually changed, pools were NOT rebuilt
+    after = np.asarray(jax.tree.leaves(engine.scheduler.params)[0])
+    assert not np.array_equal(before, after)
+    assert engine.scheduler.pool is pool
+    toks2 = _serve(engine)
+    assert toks1 != toks2 or True  # tokens may coincide on tiny models
+
+
+def test_load_params_refuses_mid_decode_swap(devices):
+    t = _trainer()
+    t.step(_batch())
+    engine = ServeEngine.from_train_state(t, t.config)
+    engine.submit(Request(prompt_ids=_prompts()[0], max_new_tokens=16))
+    engine.step()                      # prefill/decode in flight
+    with pytest.raises(RuntimeError, match="occupy"):
+        engine.load_params(t.serving_params())
+    engine.run()                       # finish the request
+    engine.load_params(t.serving_params())   # idle: accepted
+
+
+# -- the offline special case: reshard through the same engine ----------------
+
+def test_reshard_checkpoint_parity_with_direct_restore(devices, tmp_path):
+    from torchacc_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from torchacc_tpu.checkpoint.reshard import reshard_checkpoint
+
+    t = _trainer()
+    t.step(_batch())
+    t.drain()
+    src = str(tmp_path / "src")
+    save_checkpoint(src, t.state.params)
+
+    # target: the serving layout (a genuine cross-layout reshard)
+    abstract = jax.tree.map(
+        lambda l, sh: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh),
+        jax.tree.map(lambda x: x, t.state.params), t.serving_shardings())
+
+    # old offline path: orbax restores directly under target shardings
+    old = restore_checkpoint(src, abstract)
+    # new path: host restore + the compiled transfer, re-saved
+    dst = str(tmp_path / "dst")
+    reshard_checkpoint(src, dst, abstract)
+    new = restore_checkpoint(dst, abstract)
+    assert _leaves_equal(old, new)   # bitwise parity
+    for x, y in zip(jax.tree.leaves(old), jax.tree.leaves(new)):
+        assert x.sharding == y.sharding
+
+
+def test_reshard_checkpoint_still_migrates_legacy_layout(devices, tmp_path):
+    # the engine re-route must not lose restore_checkpoint's migration
+    # shim: a pre-unification per-layer (layers_{i}) checkpoint
+    # restacks on the way through the reshard
+    from torchacc_tpu.checkpoint import restore_checkpoint, save_checkpoint
+    from torchacc_tpu.checkpoint.reshard import reshard_checkpoint
+
+    mesh = ta.Config().get_mesh()
+    legacy = {"params": {
+        "embed": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "layers_0": {"w": np.full((4,), 1.0, np.float32)},
+        "layers_1": {"w": np.full((4,), 2.0, np.float32)},
+    }}
+    src = str(tmp_path / "legacy")
+    save_checkpoint(src, legacy)
+    abstract = {"params": {
+        "embed": jax.ShapeDtypeStruct(
+            (2, 3), jnp.float32,
+            sharding=NamedSharding(mesh, PartitionSpec())),
+        "layers": {"w": jax.ShapeDtypeStruct(
+            (2, 4), jnp.float32,
+            sharding=NamedSharding(mesh, PartitionSpec("fsdp", None)))},
+    }}
+    dst = str(tmp_path / "stacked")
+    reshard_checkpoint(src, dst, abstract)
+    out = restore_checkpoint(dst)
+    np.testing.assert_array_equal(
+        np.asarray(out["params"]["layers"]["w"]),
+        np.stack([np.full((4,), 1.0), np.full((4,), 2.0)]))
+
+
+def test_reshard_cli_dry_run_prints_layout_plan(devices, tmp_path, capsys):
+    from torchacc_tpu.checkpoint import save_checkpoint
+    from torchacc_tpu.checkpoint.cli import main as cli_main
+
+    t = _trainer()
+    src = str(tmp_path / "src")
+    save_checkpoint(src, t.state.params)
+    rc = cli_main(["--ckpt_dir", src, "--save_dir", str(tmp_path / "d"),
+                   "--reshard_num", "2", "--dry-run"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "layout-pair plan" in out
+    assert "host -> " in out          # offline source layout is host
+    assert "MB moved" in out

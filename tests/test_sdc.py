@@ -249,6 +249,38 @@ def test_flip_bits_dp2_tie_arbitrated_by_recompute(devices):
     assert counters.get("replica_divergences") == 1
 
 
+def test_optimizer_digest_clean_run_never_flags(devices):
+    t = _trainer(sdc_check_interval_steps=1, sdc_digest_optimizer=True)
+    t.fit(_batches(4), max_steps=4, log_every=0)
+    assert counters.get("sdc_checks") == 4
+    assert counters.get("sdc_mismatches") == 0
+    # the digest matrix carries both regions, named apart
+    paths = t._sdc_monitor.leaf_paths
+    n = len(paths)
+    assert n % 2 == 0
+    assert all(p.startswith("grads/") for p in paths[:n // 2])
+    assert all(p.startswith("params/") for p in paths[n // 2:])
+
+
+def test_optimizer_digest_surfaces_post_apply_corruption_same_step(devices):
+    """The carried-over PR-4 gap: corruption in the optimizer apply used
+    to surface one step late (through the NEXT step's gradients).  With
+    sdc_digest_optimizer the post-apply param rows ride the digest
+    matrix, so a flip targeted at a params/ leaf is flagged at exactly
+    the step it happens — with the report naming the params region."""
+    k = 1 + CHAOS_SEED % 3
+    host = 2 + CHAOS_SEED % 3
+    t = _trainer(sdc_check_interval_steps=1, sdc_digest_optimizer=True)
+    with pytest.raises(SDCError) as ei:
+        with ChaosPlan(seed=CHAOS_SEED).flip_bits(
+                host=host, at=k, leaf="params/"):
+            t.fit(_batches(6), max_steps=6, log_every=0)
+    e = ei.value
+    assert e.hosts == [host]
+    assert e.step == k                 # the step it happens, not k + 1
+    assert e.report and "params/" in e.report[0]
+
+
 def test_recompute_spot_check_catches_dp1_flakiness(devices):
     k = 1 + CHAOS_SEED % 2
     t = _trainer(ndev=1, sdc_recompute_interval_steps=1)

@@ -296,6 +296,15 @@ def main(argv=None) -> int:
             print("  changes vs source:"
                   + ("".join(f"\n    {d}" for d in diff) if diff
                      else " none"))
+        # the layout-pair plan the transfer engine would compile: per-
+        # leaf src→dst spec diff + bytes moved (the offline source
+        # layout is the host-restored tree, so src reads 'host')
+        from torchacc_tpu.parallel.transfer import format_plan, transfer_plan
+
+        src_abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype), meta)
+        print(format_plan(transfer_plan(src_abstract, abstract),
+                          max_rows=64))
         return 0
     reshard_checkpoint(args.ckpt_dir, args.save_dir, abstract)
     return 0

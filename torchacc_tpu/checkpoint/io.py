@@ -176,16 +176,26 @@ def restore_checkpoint(
         # unavailable on this orbax — decide from the host restore, the
         # one case that still pays full host RAM)
         host = ckptr.restore(path)
-        converted, changed = _restack_legacy_layers(host)
+        converted, changed = _migrate_legacy_layers(host, path)
         if not changed:
             _raise_schema_error_if_explains(path, abstract_state,
                                             restore_err)
             raise
+        return _reshard_into(converted, abstract_state)
+
+
+def _migrate_legacy_layers(tree: Any, where: str) -> tuple[Any, bool]:
+    """Restack a legacy per-layer (``layers_{i}``) host tree to the
+    canonical stacked layout, warning when it fires — the ONE place the
+    migration policy/wording lives (restore_checkpoint's shim and the
+    offline reshard both call it).  Returns ``(tree, changed)``."""
+    converted, changed = _restack_legacy_layers(tree)
+    if changed:
         logger.warning(
-            f"checkpoint at {path} uses the legacy unrolled per-layer "
+            f"checkpoint at {where} uses the legacy unrolled per-layer "
             "param layout (layers_0..layers_N); restacking to the "
             "canonical stacked layout.  Re-save to migrate permanently.")
-        return _reshard_into(converted, abstract_state)
+    return converted, changed
 
 
 def _raise_schema_error_if_explains(path: str, abstract_state: Any,
@@ -237,11 +247,15 @@ def _checkpoint_has_legacy_layers(ckptr, path: str) -> Optional[bool]:
 
 def _reshard_into(host_tree: Any, abstract_state: Any) -> Any:
     """Map a host-restored nested-dict tree onto ``abstract_state``
-    (possibly a TrainState/optax pytree of ShapeDtypeStructs), casting
-    dtype, validating shape, and device_put-ing to each leaf's target
-    sharding.  Orbax represents pytree tuples as lists while flax's
-    state-dict form indexes them as {'0': ...} dicts — normalise to the
-    flax form, map leaf-wise, then rebuild the original structure."""
+    (possibly a TrainState/optax pytree of ShapeDtypeStructs), then
+    place the whole tree through the layout-transfer engine
+    (parallel/transfer.py): ONE compiled host→target program per layout
+    pair — dtype casts and target shardings included — instead of the
+    old per-leaf ``jax.device_put`` loop that serialised one
+    host-mediated transfer per weight.  Orbax represents pytree tuples
+    as lists while flax's state-dict form indexes them as {'0': ...}
+    dicts — normalise to the flax form, map leaf-wise, then rebuild the
+    original structure."""
     from flax import serialization
 
     def normalise(node):
@@ -252,16 +266,14 @@ def _reshard_into(host_tree: Any, abstract_state: Any) -> Any:
         return node
 
     def _put(x, a):
+        # shape validated host-side for the better error; dtype cast and
+        # placement belong to the compiled transfer below
         x = np.asarray(x)
         if hasattr(a, "shape") and tuple(x.shape) != tuple(a.shape):
             raise ValueError(
                 f"legacy-checkpoint migration: restacked leaf has shape "
                 f"{tuple(x.shape)} but the target expects {tuple(a.shape)}")
-        if hasattr(a, "dtype") and x.dtype != a.dtype:
-            x = x.astype(a.dtype)
-        sharding = getattr(a, "sharding", None)
-        return jax.device_put(x, sharding) if sharding is not None \
-            else jax.numpy.asarray(x)
+        return x
 
     def map_like(conv, abs_, path=""):
         # walk by the abstract structure: empty containers and None
@@ -302,7 +314,17 @@ def _reshard_into(host_tree: Any, abstract_state: Any) -> Any:
 
     abstract_sd = normalise(serialization.to_state_dict(abstract_state))
     out_sd = map_like(normalise(host_tree), abstract_sd)
-    return serialization.from_state_dict(abstract_state, out_sd)
+    host_state = serialization.from_state_dict(abstract_state, out_sd)
+    from torchacc_tpu.parallel.transfer import transfer
+    # the numpy leaves are NOT replicated onto the mesh: GSPMD
+    # propagates the identity program's out_shardings back to its
+    # unannotated inputs, so each device materialises exactly its
+    # target shard of each host leaf (measured: per-device argument
+    # bytes == shard bytes).  Multi-process restores never reach this
+    # host-tree path (the elastic fallback is single-host-gated, and
+    # per-leaf device_put to non-addressable shardings was equally
+    # unsupported before the engine re-route).
+    return transfer(host_state, abstract_state)
 
 
 def _restack_legacy_layers(tree: Any) -> tuple[Any, bool]:

@@ -98,7 +98,42 @@ def reshard_checkpoint(
     dst: str,
     abstract_state: Any,
 ) -> None:
-    """Re-save ``src`` laid out per ``abstract_state``'s shardings."""
-    state = restore_checkpoint(src, abstract_state)
+    """Re-save ``src`` laid out per ``abstract_state``'s shardings.
+
+    Routed through the layout-transfer engine (parallel/transfer.py):
+    restore the source host-side, run ONE compiled spec-to-spec
+    transfer into the target layout (dtype casts included), save.  The
+    offline special case of the engine that powers the in-memory
+    train→serve handoff (``Trainer.serving_params``); bitwise parity
+    with the old restore-under-target-shardings path is test-pinned
+    (tests/test_handoff.py).  Legacy per-layer (``layers_{i}``)
+    checkpoints are restacked on the way through, same as
+    ``restore_checkpoint``'s migration shim.
+
+    This is the OFFLINE single-host tool: the source stages through
+    host RAM (on the CPU reshard box the old orbax
+    restore-under-target-shardings held the same bytes in host-backed
+    CPU device buffers, so the footprint is unchanged there).
+    Pod-side restores onto live accelerators stream through
+    ``CheckpointManager.restore`` / ``restore_checkpoint`` and never
+    enter this path."""
+    from torchacc_tpu.checkpoint.io import (
+        _migrate_legacy_layers,
+        _raise_schema_error_if_explains,
+        _reshard_into,
+    )
+
+    state = restore_checkpoint(src)
+    state, _ = _migrate_legacy_layers(state, src)
+    try:
+        state = _reshard_into(state, abstract_state)
+    except ValueError as e:
+        # genuine tree drift: surface the typed per-leaf diff when the
+        # schema sidecar can explain it (the same courtesy
+        # restore_checkpoint extends), else the structural error
+        import os
+        _raise_schema_error_if_explains(os.path.abspath(src),
+                                        abstract_state, e)
+        raise
     save_checkpoint(dst, state)
     logger.info(f"resharded {src} -> {dst}")

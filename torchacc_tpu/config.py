@@ -586,6 +586,16 @@ class ResilienceConfig:
     # a leaf is sampled.  Digests taken under different bounds are not
     # comparable to each other.
     sdc_digest_max_elems: Optional[int] = None
+    # also fold the POST-APPLY param leaves into the per-replica digest
+    # matrix (rows double: grads/<leaf> then params/<leaf>): corruption
+    # in the optimizer apply then surfaces on the very step it happens,
+    # instead of one step late through the next step's gradients — the
+    # carried-over PR-4 gap.  Costs a second digest fold (over the
+    # params) on every step the digest program runs;
+    # sdc_digest_max_elems bounds both folds the same way.  Digest
+    # matrices taken with this on are not comparable to ones taken with
+    # it off (different row count).
+    sdc_digest_optimizer: bool = False
 
     def validate(self) -> None:
         _check(self.spike_zscore > 0,

@@ -16,6 +16,15 @@ from torchacc_tpu.parallel.sharding import (
     spec_for,
     tree_shardings,
 )
+from torchacc_tpu.parallel.transfer import (
+    cache_stats,
+    clear_cache,
+    format_plan,
+    serving_shardings,
+    serving_specs,
+    transfer,
+    transfer_plan,
+)
 
 __all__ = [
     "initialize_distributed",
@@ -31,4 +40,11 @@ __all__ = [
     "make_rules",
     "spec_for",
     "tree_shardings",
+    "cache_stats",
+    "clear_cache",
+    "format_plan",
+    "serving_shardings",
+    "serving_specs",
+    "transfer",
+    "transfer_plan",
 ]

@@ -15,9 +15,15 @@ inference).  Serving here is native:
   per-token host sync stays off the critical path.
 - :mod:`torchacc_tpu.serve.engine` — the request front-end: queue,
   admission control against KV-pool headroom, per-request SLO metrics
-  (TTFT, per-token latency, queue wait) riding utils/metrics.
+  (TTFT, per-token latency, queue wait) riding utils/metrics.  Also
+  the live-weights seam of the checkpoint-free train→serve handoff:
+  ``ServeEngine.from_train_state(trainer)`` /
+  ``engine.load_params(trainer.serving_params())`` swap weights in
+  place through the compiled layout-transfer engine
+  (parallel/transfer.py) — no pool reallocation, no checkpoint I/O.
 
-See docs/serving.md for architecture + tuning.
+See docs/serving.md for architecture + tuning (and the "Live weight
+handoff" section for the fit↔serve loop).
 """
 
 from torchacc_tpu.serve.engine import Request, RequestResult, ServeEngine

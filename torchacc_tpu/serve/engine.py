@@ -29,7 +29,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Dict, List, Optional, Sequence as Seq
+from typing import Any, Dict, List, Optional, Sequence as Seq
 
 import numpy as np
 
@@ -118,6 +118,56 @@ class ServeEngine:
         if self.mesh is None:
             return contextlib.nullcontext()
         return jax.sharding.set_mesh(self.mesh)
+
+    # -- live weights (train -> serve handoff) ------------------------------
+
+    @classmethod
+    def from_train_state(cls, trainer, config: Optional[Config] = None, *,
+                         dtype: Any = "auto", donate: bool = False,
+                         metrics_dir: Optional[str] = None) -> "ServeEngine":
+        """Engine over a live ``Trainer``'s weights — the in-memory
+        train→serve handoff (docs/serving.md "Live weight handoff").
+
+        ``trainer.serving_params()`` reshards ``state.params`` from the
+        train layout (fsdp/tp) into the decode layout through the
+        compiled layout-transfer engine (parallel/transfer.py) — no
+        checkpoint I/O anywhere on this path; the transfer program
+        compiles once per layout pair, so alternating fit()/serve
+        phases pay collective time only after the first handoff.
+        ``donate=True`` is the terminal handoff (the trainer's state is
+        relinquished — see ``Trainer.serving_params``)."""
+        config = config or trainer.config
+        # validate BEFORE the handoff: a donating handoff relinquishes
+        # the training state, and a bad ServeConfig must fail while the
+        # state is still intact — not after the buffers are gone
+        config.serve.validate()
+        params = trainer.serving_params(dtype=dtype, donate=donate)
+        return cls(trainer.model, params, config,
+                   mesh=trainer.mesh, metrics_dir=metrics_dir)
+
+    def load_params(self, params) -> None:
+        """Swap the live weights in place — NO pool reallocation, no
+        scheduler rebuild: the paged KV pools, block tables, decode
+        carry and every compiled program survive (the params operand is
+        traced by shape/dtype, which the handoff preserves).  The
+        fit→serve→fit loop hands each new phase's weights here.
+
+        Requires an idle engine (queued-but-unadmitted requests are
+        fine): a weight swap under sequences mid-decode would splice
+        two models' logits into one stream, so occupied decode slots
+        raise instead.  In-flight ring entries are resolved first —
+        they were computed under the old weights and their tokens are
+        still valid."""
+        self.scheduler.drain()
+        self._drain_events()
+        if self.scheduler.busy():
+            # the ring is drained, so busy == sequences occupy slots
+            busy = [s.sid for s in self.scheduler.slot_seq if s is not None]
+            raise RuntimeError(
+                f"cannot swap weights while sequences {busy} occupy "
+                f"decode slots — run() the engine to completion (or let "
+                f"them finish) first")
+        self.scheduler.params = params
 
     # -- submission ---------------------------------------------------------
 

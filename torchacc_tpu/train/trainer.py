@@ -549,28 +549,6 @@ class Trainer:
                 grads = jax.tree.map(lambda g: g / scale, grads)
                 loss_val = loss_s / scale
 
-            sdc_digests = None
-            if sdc_on:
-                # per-DP-replica digest of the final grads (post-psum,
-                # logically replicated over dp): each replica folds its
-                # OWN physical copy, so a flaky chip's bits diverge
-                # here and nowhere upstream can hide them
-                from torchacc_tpu.resilience.sdc import replica_digests
-                # param shardings steer the bounded subsample's strides
-                # onto unsharded dims (shard-local digesting — no GSPMD
-                # gather on huge fsdp/tp-sharded leaves); grads share
-                # the params' tree structure
-                leaf_specs = None
-                if (res_cfg.sdc_digest_max_elems is not None
-                        and self.state_shardings is not None):
-                    leaf_specs = [
-                        getattr(s, "spec", None) for s in
-                        jax.tree.leaves(self.state_shardings.params)]
-                sdc_digests = replica_digests(
-                    grads, sdc_flip, mesh=self.mesh,
-                    max_elems=res_cfg.sdc_digest_max_elems,
-                    leaf_specs=leaf_specs)
-
             from torchacc_tpu.train.amp import global_norm_f32
 
             # f32-accumulated: bf16 grad trees (shadow mode) would
@@ -627,6 +605,43 @@ class Trainer:
                     if quant_on:
                         new_quant = select_tree(ok, new_quant,
                                                 state.quant)
+
+            sdc_digests = None
+            if sdc_on:
+                # per-DP-replica digest of the final grads (post-psum,
+                # logically replicated over dp): each replica folds its
+                # OWN physical copy, so a flaky chip's bits diverge
+                # here and nowhere upstream can hide them.  With
+                # sdc_digest_optimizer the POST-APPLY params ride the
+                # same matrix (rows: grads/<leaf> then params/<leaf> —
+                # _ensure_sdc_monitor mirrors the order), so corruption
+                # in the optimizer apply itself surfaces on the step it
+                # happens instead of one step late through the next
+                # step's gradients.  Digesting here — after the apply —
+                # changes nothing for the grads rows (the fold is a
+                # pure function of the grads).
+                from torchacc_tpu.resilience.sdc import replica_digests
+                digest_tree = grads
+                # param shardings steer the bounded subsample's strides
+                # onto unsharded dims (shard-local digesting — no GSPMD
+                # gather on huge fsdp/tp-sharded leaves); grads share
+                # the params' tree structure
+                leaf_specs = None
+                if (res_cfg.sdc_digest_max_elems is not None
+                        and self.state_shardings is not None):
+                    leaf_specs = [
+                        getattr(s, "spec", None) for s in
+                        jax.tree.leaves(self.state_shardings.params)]
+                if res_cfg.sdc_digest_optimizer:
+                    # dict keys sort 'grads' < 'params' — flatten order
+                    # is grads leaves then params leaves
+                    digest_tree = {"grads": grads, "params": new_params}
+                    if leaf_specs is not None:
+                        leaf_specs = leaf_specs + leaf_specs
+                sdc_digests = replica_digests(
+                    digest_tree, sdc_flip, mesh=self.mesh,
+                    max_elems=res_cfg.sdc_digest_max_elems,
+                    leaf_specs=leaf_specs)
 
             metrics = {
                 "loss": loss_val,
@@ -721,9 +736,16 @@ class Trainer:
         if self._sdc_monitor is None:
             if self._abstract is None:
                 self.resolve_shardings()
+            paths = leaf_paths_of(self._abstract.params)
+            if self.config.resilience.sdc_digest_optimizer:
+                # the digest matrix carries grads rows then post-apply
+                # param rows (the {'grads':..., 'params':...} flatten
+                # order in the jitted step) — name them apart so a
+                # divergence report says WHICH side went bad
+                paths = ([f"grads/{p}" for p in paths]
+                         + [f"params/{p}" for p in paths])
             self._sdc_monitor = SDCMonitor(
-                self.config.resilience, self.mesh,
-                leaf_paths_of(self._abstract.params),
+                self.config.resilience, self.mesh, paths,
                 run_dir=self._sdc_run_dir)
         # fit() learns the run dir after the monitor may exist
         self._sdc_monitor.run_dir = self._sdc_run_dir
@@ -960,6 +982,69 @@ class Trainer:
         self.state = self._adopt_restored(
             restore_checkpoint(path, self.abstract_state()))
         return self.state
+
+    # -- train -> serve handoff ---------------------------------------------
+    def serving_shardings(self, mesh: Optional[Mesh] = None) -> Any:
+        """NamedSharding tree of the SERVING layout for ``state.params``:
+        data axes (fsdp ZeRO shards) gathered, megatron 'tp' dims kept
+        (parallel/transfer.serving_specs — decode reads every weight
+        every token, so a fsdp-sharded serving layout would pay a full
+        param all-gather per generated token)."""
+        from torchacc_tpu.parallel.transfer import serving_shardings
+        if self._abstract is None:
+            self.resolve_shardings()
+        abstract = self._abstract.params
+        axes = (resolve_param_axes(abstract) if self._axes_rules is None
+                else resolve_param_axes(abstract, self._axes_rules))
+        return serving_shardings(abstract, axes, self.rules,
+                                 mesh if mesh is not None else self.mesh)
+
+    def serving_params(self, *, dtype: Any = "auto", donate: bool = False,
+                       mesh: Optional[Mesh] = None) -> Any:
+        """``state.params`` resharded into the serving layout — the
+        in-memory train→serve handoff seam (docs/serving.md "Live
+        weight handoff").
+
+        Strips everything serving never reads (opt_state, the AMP
+        scaler, the quant amax histories — only the param tree crosses)
+        and runs ONE compiled spec-to-spec transfer
+        (parallel/transfer.py) from the train layout (fsdp/tp) into the
+        decode layout (:meth:`serving_shardings`): compiled once per
+        layout pair, every later handoff costs collective time only —
+        no checkpoint I/O anywhere on this path.
+
+        ``dtype='auto'`` casts floating leaves to the model's compute
+        dtype inside the same program (a quant/AMP-trained f32 master
+        state serves compute-dtype, mirroring ``generate()``'s quant
+        strip); pass None to keep the stored dtypes, or an explicit
+        dtype.  ``donate=True`` is the TERMINAL handoff: the train copy
+        is offered to XLA and ``self.state`` is cleared — the trainer
+        needs ``init()``/``restore()`` before training again (outputs
+        are bitwise identical with donation on or off).
+
+        In-flight verdicts resolve first (:meth:`drain`): a serving
+        phase must never start on weights whose guard/SDC verdict is
+        still pending — the same verdict-before-durability rule
+        checkpoint writes follow."""
+        if self.state is None:
+            raise TrainerStateError(
+                "nothing to hand off — call init() (or restore) first")
+        self.drain()
+        from torchacc_tpu.parallel.transfer import transfer
+        dt = dtype
+        if dtype == "auto":
+            dt = getattr(getattr(self.model, "cfg", None), "dtype", None)
+        target = self.serving_shardings(mesh)
+        with jax.sharding.set_mesh(mesh if mesh is not None else self.mesh):
+            params = transfer(self.state.params, target,
+                              donate=donate, dtype=dt)
+        if donate:
+            # the donated buffers are gone; keeping a TrainState around
+            # them would turn the next step() into a deleted-buffer
+            # crash far from the cause
+            self.state = None
+            self._host_step = None
+        return params
 
     # -- high-level loop ----------------------------------------------------
     def fit(

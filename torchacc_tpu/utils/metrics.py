@@ -81,8 +81,10 @@ class Counters:
     ``loader_fallbacks``, ``preemptions``, ``emergency_saves``,
     ``watchdog_stalls``, the elastic-resume trio
     ``resume_replayed_batches`` / ``bad_batches_skipped`` /
-    ``elastic_reshards``, and the SDC-defense trio ``sdc_checks`` /
-    ``replica_divergences`` / ``sdc_mismatches``) and the Trainer
+    ``elastic_reshards``, the SDC-defense trio ``sdc_checks`` /
+    ``replica_divergences`` / ``sdc_mismatches``, and the
+    layout-transfer pair ``transfer_compiles`` /
+    ``transfer_cache_hits`` — parallel/transfer.py) and the Trainer
     surfaces the non-zero ones in
     every step log line AND every metrics.jsonl step record — an
     operator sees a run degrading without grepping worker logs.
