@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -50,6 +50,16 @@ handoff-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python bench.py --handoff --fast --platform cpu
 
+# tiered-checkpointing gate (docs/resilience.md "Tiered
+# checkpointing"): the same fit loop with blocking orbax saves vs
+# tiered in-gap snapshots on 8 emulated CPU devices; FAILS unless the
+# save-step stall (save_blocked_ms per save, dispatch_depth 2) drops
+# >= 10x AND resume from every tier (host RAM, local disk, mirror) is
+# bitwise identical to the blocking path
+ckpt-smoke:
+	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		python bench.py --checkpoint --fast --platform cpu
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -62,7 +72,8 @@ chaos:
 			tests/test_watchdog.py tests/test_elastic.py \
 			tests/test_sdc.py tests/test_perf.py \
 			tests/test_serving.py tests/test_quant.py \
-			tests/test_handoff.py -m "not slow" \
+			tests/test_handoff.py tests/test_tiered.py \
+			-m "not slow" \
 			-q || exit 1; \
 	done
 

@@ -286,6 +286,17 @@ def main() -> int:
                          "checkpoint-restored weights AND the second "
                          "handoff is a pure cache hit (`make "
                          "handoff-smoke` runs this on CPU as the gate)")
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="benchmark the tiered zero-stall checkpoint "
+                         "pipeline (checkpoint/tiered.py): drive the "
+                         "SAME fit loop with blocking orbax saves vs "
+                         "tiered in-gap snapshots at two cadences, "
+                         "report save_blocked_ms per save step, and "
+                         "FAIL unless the tiered stall is >= 10x lower "
+                         "AND resume from every tier (host RAM, local "
+                         "disk, mirror) is bitwise identical to the "
+                         "blocking path (`make ckpt-smoke` runs this "
+                         "on CPU as the gate)")
     ap.add_argument("--serve", action="store_true",
                     help="benchmark the continuous-batching serving "
                          "engine (torchacc_tpu/serve) on a mixed-length "
@@ -338,6 +349,11 @@ def _bench(args, wd: Watchdog) -> int:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    if args.checkpoint:
+        # train-path leg: shares the persistent compile cache (the
+        # serve-path cache hazard is decode-loop-specific)
+        return _bench_checkpoint(args, wd, devs)
 
     wd.stage("build_model", 120)
     import optax
@@ -706,6 +722,177 @@ def _bench_serve(args, wd: Watchdog, devs) -> int:
     }
     _emit(result)
     return 0
+
+
+def _bench_checkpoint(args, wd: Watchdog, devs) -> int:
+    """Tiered zero-stall checkpointing benchmark + gate
+    (docs/resilience.md "Tiered checkpointing").
+
+    Drives the SAME fit loop four ways — blocking orbax saves vs tiered
+    in-gap snapshots, at two checkpoint cadences — and reports the
+    save-step stall (``save_blocked_ms`` summed over the run / number
+    of saves).  FAILS unless (a) the tiered stall at the main cadence
+    is >= 10x below the blocking path's, and (b) resume from every tier
+    — the trainer's host-RAM tier-0 snapshot, the tier-1 local dir, and
+    the tier-2 mirror — is bitwise identical to restoring the blocking
+    run's checkpoint of the same step.  ``make ckpt-smoke`` runs this
+    on 8 emulated CPU devices as the per-PR gate.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, get_preset
+    from torchacc_tpu.train import Trainer
+    from torchacc_tpu.utils.metrics import counters
+
+    n_chips = len(devs)
+    metric = "ckpt_save_stall_ms"
+
+    def fail(error: str, stage: str) -> int:
+        _emit({"metric": metric, "value": 0.0, "unit": "ms",
+               "vs_baseline": 0.0, "error": error, "stage": stage,
+               "elapsed_s": round(time.monotonic() - _T0, 1)})
+        return 1
+
+    wd.stage("ckpt_build_model", 120)
+    if args.fast:
+        mc = get_preset(
+            "llama-tiny", dtype=jnp.float32, hidden_size=256,
+            num_layers=2, num_heads=4, num_kv_heads=4,
+            intermediate_size=1024, vocab_size=8192, max_seq_len=256)
+        seq, batch, steps = 128, 8, 9
+    else:
+        mc = get_preset(
+            "llama-tiny", hidden_size=1024, num_layers=8, num_heads=8,
+            num_kv_heads=8, intermediate_size=4096, vocab_size=32000,
+            max_seq_len=2048)
+        seq, batch, steps = 512, 8, 13
+    cadences = (2, 4)
+    rng = np.random.default_rng(0)
+    batches = [{"input_ids": rng.integers(
+        0, mc.vocab_size, size=(batch, seq)).astype(np.int32)}
+        for _ in range(steps)]
+
+    base = tempfile.mkdtemp(prefix="bench_ckpt_")
+    trainers = {}
+
+    def run(tag: str, tiered: bool, every: int, mirror=None):
+        counters.reset()
+        cfg = ta.Config(
+            resilience=ta.ResilienceConfig(
+                tiered_checkpointing=tiered, tiered_mirror_dir=mirror),
+            perf=ta.PerfConfig(dispatch_depth=args.dispatch_depth))
+        cfg.dist.dp.size = n_chips
+        tr = Trainer(TransformerLM(mc), cfg, optimizer=optax.adamw(1e-3))
+        t0 = time.perf_counter()
+        hist = tr.fit(list(batches), max_steps=steps, log_every=1,
+                      checkpoint_dir=os.path.join(base, tag),
+                      checkpoint_every=every)
+        wall = time.perf_counter() - t0
+        n_saves = sum(1 for s in range(1, steps + 1) if s % every == 0)
+        stall = sum(r.get("save_blocked_ms", 0.0) for r in hist)
+        trainers[tag] = tr
+        return {"save_stall_ms_per_save": round(stall / max(n_saves, 1), 3),
+                "save_stall_ms_total": round(stall, 2),
+                "n_saves": n_saves,
+                "steps_per_sec": round(steps / wall, 3),
+                "tiered_saves": counters.get("tiered_saves"),
+                "wall_s": round(wall, 2)}
+
+    try:
+        rows = {}
+        mirror_dir = os.path.join(base, "mirror")
+        for every in cadences:
+            wd.stage(f"ckpt_blocking_c{every}", args.compile_budget)
+            rows[f"blocking_c{every}"] = run(
+                f"blocking_c{every}", False, every)
+            wd.stage(f"ckpt_tiered_c{every}", args.compile_budget)
+            rows[f"tiered_c{every}"] = run(
+                f"tiered_c{every}", True, every,
+                mirror=mirror_dir if every == cadences[0] else None)
+
+        main = cadences[0]
+        blocking = rows[f"blocking_c{main}"]["save_stall_ms_per_save"]
+        tiered = rows[f"tiered_c{main}"]["save_stall_ms_per_save"]
+        speedup = blocking / max(tiered, 1e-6)
+
+        # bitwise gate: every tier of the tiered run must restore the
+        # exact bits the blocking run committed for the same step
+        wd.stage("ckpt_verify_bitwise", args.compile_budget)
+        from torchacc_tpu.checkpoint import CheckpointManager
+        ref_tr = trainers[f"blocking_c{main}"]
+        abstract = ref_tr.abstract_state()
+        last = max(s for s in range(1, steps + 1) if s % main == 0)
+
+        def leaves_of(state):
+            return [np.asarray(x) for x in jax.device_get(
+                jax.tree.leaves(state))]
+
+        m_ref = CheckpointManager(os.path.join(base, f"blocking_c{main}"))
+        ref_state, ref_step = m_ref.restore_latest_valid(abstract)
+        if ref_step != last:
+            return fail(f"blocking run retained step {ref_step}, "
+                        f"expected {last}", "verify")
+        ref = leaves_of(ref_state)
+
+        checks = {}
+        m_t1 = CheckpointManager(os.path.join(base, f"tiered_c{main}"))
+        s_t1, step_t1 = m_t1.restore_latest_valid(abstract)
+        checks["tier1"] = (step_t1 == last and all(
+            np.array_equal(a, b) for a, b in zip(ref, leaves_of(s_t1))))
+        m_t2 = CheckpointManager(mirror_dir)
+        s_t2, step_t2 = m_t2.restore_latest_valid(abstract)
+        checks["tier2_mirror"] = (step_t2 == last and all(
+            np.array_equal(a, b) for a, b in zip(ref, leaves_of(s_t2))))
+        ram_mgr = trainers[f"tiered_c{main}"]._tiered_cache[1]
+        s_ram, step_ram = ram_mgr.restore_latest_valid(abstract)
+        checks["tier0_ram"] = (step_ram == last and all(
+            np.array_equal(a, b) for a, b in zip(ref, leaves_of(s_ram))))
+        bad = [k for k, ok in checks.items() if not ok]
+        if bad:
+            return fail(f"resume not bitwise identical to the blocking "
+                        f"path from tier(s) {bad}", "verify")
+        if speedup < 10.0:
+            return fail(
+                f"tiered save stall {tiered:.3f} ms/save is only "
+                f"{speedup:.1f}x below the blocking path "
+                f"({blocking:.3f} ms/save); the gate requires >= 10x",
+                "stall")
+
+        wd.stage("report", 60)
+        result = {
+            "metric": metric,
+            "value": tiered,
+            "unit": "ms",
+            "vs_baseline": round(speedup, 2),
+            "detail": {
+                "cadence_sweep": rows,
+                "main_cadence": main,
+                "blocking_stall_ms_per_save": blocking,
+                "tiered_stall_ms_per_save": tiered,
+                "ram_restores": counters.get("ram_restores"),
+                "bitwise": {k: True for k in checks},
+                "params_m": round(mc.num_params() / 1e6, 1),
+                "steps": steps,
+                "dispatch_depth": args.dispatch_depth,
+                "n_chips": n_chips,
+                "fast": bool(args.fast),
+                "wall_s": round(time.monotonic() - _T0, 1),
+            },
+        }
+        _emit(result)
+        return 0
+    finally:
+        for tr in trainers.values():
+            if tr._tiered_cache is not None:
+                tr._tiered_cache[1].shutdown()
+        shutil.rmtree(base, ignore_errors=True)
 
 
 def _bench_handoff(args, wd: Watchdog, devs) -> int:

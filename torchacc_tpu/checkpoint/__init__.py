@@ -13,9 +13,11 @@ from torchacc_tpu.checkpoint.schema import (
     state_schema,
     tree_digest,
 )
+from torchacc_tpu.checkpoint.tiered import TieredCheckpointManager
 
 __all__ = [
     "CheckpointManager",
+    "TieredCheckpointManager",
     "save_checkpoint",
     "restore_checkpoint",
     "consolidate_checkpoint",

@@ -97,6 +97,32 @@ def _print_schema(label: str, schema, *, leaves: bool, out=None):
                   file=out)
 
 
+def _print_tiers(d: str, steps, mirror: str) -> None:
+    """Per-tier state of a tiered checkpoint dir (docs/resilience.md
+    "Tiered checkpointing"): which steps are durable locally (tier 1)
+    vs mirrored (tier 2), plus the writer's advisory trickle progress
+    (``_TIERED`` — submitted / verdict watermark / RAM snapshots)."""
+    from torchacc_tpu.checkpoint.tiered import (
+        TieredCheckpointManager,
+        read_tiered_status,
+    )
+
+    # the ONE notion of "commit-marked step" the restore path uses
+    mirrored = set(TieredCheckpointManager._fs_valid_steps(mirror))
+    print("tiers:")
+    for step in sorted(set(steps) | mirrored):
+        t1 = "committed" if step in set(steps) else "missing"
+        t2 = ("committed" if step in mirrored else "missing") \
+            if mirror else "-"
+        print(f"  step {step}: tier1={t1} tier2={t2}")
+    status = read_tiered_status(d)
+    if status is not None:
+        print(f"  trickle: submitted={status.get('submitted')} "
+              f"verdicts_through={status.get('verdicts_through')} "
+              f"durable={status.get('durable')} "
+              f"tier0_ram={status.get('tier0_steps')}")
+
+
 def _cmd_inspect(args) -> int:
     from torchacc_tpu.checkpoint.io import MANIFEST
 
@@ -121,6 +147,7 @@ def _cmd_inspect(args) -> int:
                 continue
             schema = manifest.get("schema") or {"tree": manifest.get("tree")}
             _print_schema(f"step {step}", schema, leaves=args.leaves)
+        _print_tiers(d, steps, args.mirror)
         return 0
     schema = _load_schema(d)
     if schema is None:
@@ -216,6 +243,11 @@ def main(argv=None) -> int:
         p.add_argument("ckpt_dir", help="checkpoint (or manager) directory")
         p.add_argument("--leaves", action="store_true",
                        help="also list per-leaf shapes/dtypes")
+        p.add_argument("--mirror", default=None,
+                       help="tier-2 mirror directory: the per-step tier "
+                            "table shows which steps are durable "
+                            "locally vs mirrored (tiered checkpointing, "
+                            "docs/resilience.md)")
         return _cmd_inspect(p.parse_args(argv[1:]))
 
     p = argparse.ArgumentParser(

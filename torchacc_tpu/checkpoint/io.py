@@ -409,9 +409,17 @@ class CheckpointManager:
         # steps saved through this manager whose manifests are still
         # pending (orbax save is async; the marker must be written last)
         self._pending: Dict[int, Dict[str, Any]] = {}
+        # create the root dir OURSELVES (local op): orbax's create=True
+        # runs a cross-process barrier inside __init__, which wedges a
+        # pod whenever manager construction is not perfectly symmetric
+        # across processes (e.g. one restarted host rebuilding its
+        # manager while healthy peers reuse theirs — the tiered
+        # peer-restore path, checkpoint/tiered.py)
+        os.makedirs(self._dir, exist_ok=True)
         self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
+            create=False,
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=self._options)
 
@@ -512,6 +520,23 @@ class CheckpointManager:
                 "guard_state": guard_state,
             }
         return saved
+
+    def delete_step(self, step: int) -> None:
+        """Remove an existing step (its dir, marker, and the orbax
+        manager's bookkeeping).  Used by the tiered trickle when a
+        re-executed timeline reaches a label that already exists on
+        disk: orbax refuses to save over an existing step
+        (StepAlreadyExistsError, even with force), and the stale copy
+        belongs to a discarded timeline.  Multi-host, orbax's delete is
+        primary-gated and barriered — call only at points every process
+        reaches together."""
+        self._pending.pop(step, None)
+        try:
+            self._mgr.delete(step)
+        except Exception as e:  # noqa: BLE001 - best-effort: the save
+            # that follows surfaces the real failure if the dir remains
+            logger.warning(f"could not delete checkpoint step {step} "
+                           f"under {self._dir}: {e!r}")
 
     def _commit_manifests(self) -> None:
         """Wait for in-flight orbax writes, then mark the completed steps.

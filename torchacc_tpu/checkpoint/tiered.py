@@ -1,0 +1,880 @@
+"""Tiered zero-stall checkpointing: in-gap snapshots, async durability
+trickle, and RAM/peer restore.
+
+PR 5 desynchronised the hot loop but left one documented stall: a
+checkpoint step drained the dispatch ring (to honour
+verdict-before-durability) and then blocked on the orbax hand-off.  This
+module splits "snapshot" from "durable" so neither lands on step time:
+
+- **tier 0 — host RAM.**  The trainer takes the donation-safe device
+  snapshot (``checkpoint/io._snapshot``) inside the step gap, hands it
+  to :meth:`TieredCheckpointManager.submit`, and keeps stepping.  A
+  background writer fetches the snapshot to host numpy (the only thread
+  that ever blocks on it) and retains the newest ``tier0_keep``
+  verdicted snapshots as restore candidates.
+- **tier 1 — local disk.**  Once the step's lagged guard/SDC verdict
+  has resolved (the trainer advances a watermark from
+  ``resolve_oldest``; the writer's commit *waits* on it), the writer
+  saves through the ordinary :class:`~torchacc_tpu.checkpoint.io.
+  CheckpointManager` — the SAME commit-marker/digest/manifest protocol,
+  loader/guard sidecars included — so everything downstream (resume
+  consensus, ``inspect``, replay) reads tiered steps exactly like
+  blocking ones.  Verdict-before-durability is preserved *without*
+  draining the ring on the hot path: an aborted step's gate simply
+  never opens and its snapshot is discarded, never committed.
+- **tier 2 — mirror.**  Committed tier-1 step dirs are copied to an
+  optional mirror directory (object-store mount, second filesystem):
+  payload first, commit marker last, so a torn mirror copy is as
+  invisible as a torn save.
+
+Restore picks the **newest valid tier, pod-wide**: verdicted tier-0
+snapshots (max over hosts) beat durable steps (min over hosts, the
+conservative consensus choice) at equal-or-newer step — the same bits,
+without touching storage.  A single restarted host rejoins from a
+healthy peer's tier-0 snapshot over the PR-2 coordination layer
+(:func:`~torchacc_tpu.resilience.coordination.broadcast_from_host`),
+completing the quarantine → elastic-shrink → hot-rejoin loop.
+
+Chaos seams: ``tiered.tier0`` / ``tiered.tier1`` / ``tiered.tier2``
+failpoints fire inside the trickle, so a "crash between snapshot and
+durability" is deterministically injectable (tests/test_tiered.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from torchacc_tpu.checkpoint.io import (
+    GUARD_STATE,
+    LOADER_STATE,
+    MANIFEST,
+    CheckpointManager,
+)
+from torchacc_tpu.checkpoint.schema import tree_digest
+from torchacc_tpu.errors import (
+    CheckpointCorruptionError,
+    CheckpointError,
+    CheckpointNotFoundError,
+)
+from torchacc_tpu.resilience import coordination as coord
+from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import counters
+
+#: Advisory trickle-progress file in the tier-1 directory (primary-
+#: written, atomic): the ``inspect`` CLI shows per-tier state from it.
+TIERED_STATUS = "_TIERED"
+
+_STOP = object()
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One submitted save riding the trickle."""
+
+    step: int
+    snap: Any                      # device snapshot (donation-safe copy)
+    gate: int                      # newest dispatched step at submit time
+    loader_state: Optional[Dict[str, Any]] = None
+    guard_state: Any = None        # device tree / callable / dict
+    host: Any = None               # tier-0 numpy tree (writer-filled)
+    verdicted: bool = False
+    durable: bool = False
+    mirrored: bool = False
+    cancelled: bool = False
+    failed: Optional[str] = None
+
+
+class TieredCheckpointManager:
+    """Drop-in ``CheckpointManager`` surface whose saves are tiered.
+
+    The trainer talks to it exactly like the blocking manager
+    (``should_save`` / ``restore_latest_valid`` / ``read_loader_state``
+    / ``wait_until_finished`` / ``close``) plus three tiered verbs:
+
+    - :meth:`submit` — hand off a device snapshot from the step gap;
+    - :meth:`notify_verdicts_through` — the trainer's lagged-readback
+      ring advances the verdict watermark here as steps resolve;
+    - :meth:`restore_latest_valid` — newest valid tier pod-wide
+      (RAM/peer → tier 1 → tier 2).
+
+    The instance outlives ``fit`` (the trainer caches it per
+    checkpoint-dir) so tier-0 snapshots survive an in-process
+    supervisor's catch-and-refit — that is what makes restore-from-RAM
+    land in milliseconds.  :meth:`close` flushes and stops the writer
+    but keeps the tier-0 store and the tier-1 manager; :meth:`shutdown`
+    disposes of everything.
+    """
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1,
+                 mirror_dir: Optional[str] = None,
+                 tier0_keep: int = 2,
+                 retry_policy=None,
+                 coord_timeout_s: Optional[float] = None,
+                 elastic_resume: bool = False):
+        self._dir = os.path.abspath(directory)
+        self._every = max(int(save_interval_steps), 1)
+        self._mirror_dir = (os.path.abspath(mirror_dir)
+                            if mirror_dir else None)
+        self._tier0_keep = max(int(tier0_keep), 1)
+        self._coord_timeout = coord_timeout_s
+        # ONE home for the commit-marker/digest/manifest protocol: the
+        # trickle writes through the ordinary manager (force=True; the
+        # interval gate lives here, where writer lag cannot skew it).
+        # Constructed LAZILY: the RAM/peer restore path must stay
+        # entirely orbax-free so a restarted host can rejoin healthy
+        # peers whose managers already exist (consensus probing below
+        # reads manifests straight off the filesystem instead).
+        self._inner: Optional[CheckpointManager] = None
+        self._inner_kwargs = dict(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            retry_policy=retry_policy, coord_timeout_s=coord_timeout_s,
+            elastic_resume=elastic_resume)
+        self._mirror_inner: Optional[CheckpointManager] = None
+        self._mirror_kwargs = dict(retry_policy=retry_policy,
+                                   coord_timeout_s=coord_timeout_s,
+                                   elastic_resume=elastic_resume)
+        # writer machinery: entries flow FIFO through a queue; _cond
+        # guards _entries/_watermark and wakes gate-waiters
+        self._queue: "queue.Queue[Any]" = queue.Queue()
+        self._cond = threading.Condition()
+        self._io_lock = threading.RLock()
+        self._entries: Dict[int, _Entry] = {}
+        self._watermark = -1        # verdicts resolved through this step
+        self._last_submitted = -1
+        self._thread: Optional[threading.Thread] = None
+        # multi-process: the tier-1 orbax write carries cross-process
+        # barriers that this orbax implements as DEVICE collectives —
+        # issuing them from a background thread while the main thread
+        # trains interleaves two collective streams differently per
+        # process and deadlocks the pod.  So on a pod the main thread
+        # pumps the tier-1 write at deterministic step boundaries
+        # (watermark-gated, identical on every host); the writer thread
+        # keeps the collective-free work (tier-0 host fetch, tier-2
+        # file mirroring).  Single-process keeps the fully-async path.
+        self._defer_t1_to_main = coord.process_count() > 1
+
+    # -- save side (hot path) ------------------------------------------------
+    def should_save(self, step: int) -> bool:
+        """Interval gate, independent of writer lag: the orbax probe
+        compares against its *last written* step, which trails the
+        trickle — judging cadence from it would re-save every step until
+        the writer caught up."""
+        return step > self._last_submitted and step % self._every == 0
+
+    def set_interval(self, save_interval_steps: int) -> None:
+        """Adopt a new cadence (a later fit call on the same store)."""
+        self._every = max(int(save_interval_steps), 1)
+
+    def submit(self, step: int, snap: Any, *, verdict_gate: int,
+               loader_state: Optional[Dict[str, Any]] = None,
+               guard_state: Any = None) -> bool:
+        """Enqueue ``snap`` (a donation-safe DEVICE snapshot the caller
+        already took — the hot path's only cost) for the trickle and
+        return immediately.
+
+        ``verdict_gate`` is the newest dispatched step index at submit
+        time: tier 1 commits only after
+        :meth:`notify_verdicts_through` has covered it, so a checkpoint
+        can never durably commit a step whose guard/SDC verdict is
+        still in flight — the PR-5 ordering, minus the drain.
+        ``loader_state`` must be materialised by the caller (the loader
+        advances as the loop continues); ``guard_state`` may be a
+        device tree (snapshot) the writer fetches off the hot path."""
+        with self._cond:
+            if step <= self._last_submitted:
+                return False  # re-executed step after a rewind; rare
+            e = _Entry(step=step, snap=snap, gate=verdict_gate,
+                       loader_state=loader_state, guard_state=guard_state)
+            self._entries[step] = e
+            self._last_submitted = step
+        self._ensure_writer()
+        self._queue.put(e)
+        counters.inc("tiered_saves")
+        return True
+
+    def notify_verdicts_through(self, step: int) -> None:
+        """The trainer resolved step ``step``'s guard/SDC verdicts
+        cleanly; gates at or below it may open."""
+        with self._cond:
+            if step > self._watermark:
+                self._watermark = step
+                self._cond.notify_all()
+
+    # -- writer --------------------------------------------------------------
+    def _ensure_writer(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._thread = threading.Thread(
+            target=self._writer_loop, daemon=True,
+            name="tiered-ckpt-writer")
+        self._thread.start()
+
+    def _writer_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                return
+            try:
+                self._process(item)
+            except Exception as e:  # noqa: BLE001 - a failed trickle
+                # step is a lost *durability* step, never a dead run:
+                # older durable steps stay restorable and newer saves
+                # keep flowing.  The device snapshot is released (a
+                # repeated fetch+write double-failure must not pin one
+                # model-state of device memory per attempt); the host
+                # copy, when fetched, stays as a RAM restore candidate.
+                item.failed = repr(e)
+                item.snap = None
+                counters.inc("tiered_write_failures")
+                logger.warning(
+                    f"tiered checkpoint: trickle of step {item.step} "
+                    f"failed ({e!r}); the step is not durable "
+                    "(tier-0 RAM copy kept when fetched)")
+            finally:
+                with self._cond:
+                    self._cond.notify_all()
+
+    def _process(self, e: _Entry) -> None:
+        # tier 0: device -> host RAM, the only blocking fetch anywhere
+        # in the save path — and it runs on THIS thread
+        failpoint("tiered.tier0", step=e.step)
+        host = None
+        try:
+            import jax
+            host = jax.device_get(e.snap)
+        except Exception as err:  # noqa: BLE001 - multi-host shards not
+            # fully addressable here: no RAM tier for this step; tier 1
+            # writes straight from the device snapshot via orbax's own
+            # sharded-array path
+            logger.debug(f"tiered checkpoint: tier-0 host fetch of step "
+                         f"{e.step} unavailable ({err!r})")
+        if callable(e.guard_state):
+            try:
+                e.guard_state = e.guard_state()
+            except Exception as err:  # noqa: BLE001 - advisory, like the
+                # blocking path: a failed export costs a guard re-warm,
+                # never the checkpoint
+                logger.warning(f"tiered checkpoint: guard-state export "
+                               f"failed for step {e.step} ({err!r})")
+                e.guard_state = None
+        if e.guard_state is not None:
+            # the StepGuard statistics arrive as live device scalars
+            # (never donated again — the post-save step runs the
+            # non-donating program): fetch + JSON-able HERE, off the
+            # hot path (f32 -> f64 -> JSON decimal round-trips
+            # bit-exactly, io.py docstring)
+            try:
+                import jax
+                gs = jax.device_get(e.guard_state)
+                e.guard_state = {k: np.asarray(v).item()
+                                 for k, v in gs.items()}
+            except Exception as err:  # noqa: BLE001
+                logger.warning(f"tiered checkpoint: guard-state fetch "
+                               f"failed for step {e.step} ({err!r})")
+                e.guard_state = None
+        with self._cond:
+            e.host = host
+        # verdict gate: tier 1 must not commit a step whose lagged
+        # guard/SDC verdict is still pending.  An abort never advances
+        # the watermark past the flagged step, so this entry is later
+        # cancelled (close/rewind) instead of committed.
+        with self._cond:
+            while self._watermark < e.gate and not e.cancelled:
+                self._cond.wait(0.05)
+            if e.cancelled:
+                self._entries.pop(e.step, None)
+                e.snap = None
+                return
+            e.verdicted = True
+        self._write_status()
+        if self._defer_t1_to_main:
+            # the main thread owns the orbax write (class docstring);
+            # wait here for it so the mirror copy below sees committed
+            # files.  The wait resolves: pump() runs at every step
+            # boundary and close() pumps before cancelling.
+            with self._cond:
+                while not (e.durable or e.cancelled
+                           or e.failed is not None):
+                    self._cond.wait(0.05)
+                was_durable = e.durable
+                e.snap = None
+                if not was_durable:
+                    return
+        else:
+            e.snap = None if host is not None else e.snap
+            # tier 1 from the host tree fetched above (the device
+            # snapshot was released; a failed fetch keeps it as src)
+            self._write_tier1(e, host if host is not None else e.snap)
+            e.snap = None
+        # tier 2: mirror the committed step dir, marker last — pure
+        # file I/O, safe on this thread in every topology.  Isolated
+        # failure domain: a dead mirror disk must neither mark the
+        # (locally durable!) step failed nor pollute the
+        # tiered_write_failures counter supervisors watch.
+        if self._mirror_dir is not None and coord.process_index() == 0:
+            try:
+                failpoint("tiered.tier2", step=e.step)
+                self._mirror_step(e.step)
+                with self._cond:
+                    e.mirrored = True
+                counters.inc("mirror_writes")
+                self._write_status()
+            except Exception as err:  # noqa: BLE001
+                counters.inc("mirror_write_failures")
+                logger.warning(
+                    f"tiered checkpoint: tier-2 mirror of step "
+                    f"{e.step} failed ({err!r}); the step IS durable "
+                    "locally — only the mirror copy is missing")
+        self._trim_tier0()
+
+    def _write_tier1(self, e: _Entry, src: Any) -> None:
+        """The ONE tier-1 commit sequence — writer thread
+        (single-process) and :meth:`pump` (pods) both go through here:
+        replace a discarded timeline's same-label step, save under the
+        commit-marker protocol (sidecars included), mark durable."""
+        failpoint("tiered.tier1", step=e.step)
+        if src is None:
+            raise CheckpointError(
+                f"tiered checkpoint step {e.step}: no writable source "
+                "(snapshot released before the tier-1 write)")
+        with self._io_lock:
+            inner = self._inner_mgr()
+            if os.path.isdir(os.path.join(self._dir, str(e.step))):
+                # same label exists from a discarded timeline (a
+                # rewind/fresh run re-reached it): replace — orbax
+                # refuses to save over an existing step
+                inner.delete_step(e.step)
+            inner.save(e.step, src, force=True, presnapshotted=True,
+                       loader_state=e.loader_state,
+                       guard_state=e.guard_state)
+            inner.wait_until_finished()  # commits the manifest
+        with self._cond:
+            e.durable = True
+            self._cond.notify_all()
+        self._write_status()
+
+    def pump(self) -> None:
+        """Multi-process only (single-process: no-op): run the tier-1
+        orbax write for every verdict-cleared entry, on the CALLING
+        (main) thread.  Called by the trainer at each step boundary —
+        the pump decision depends only on the verdict watermark, which
+        advances at identical loop points on every host, so the
+        collective-bearing orbax save is entered in lockstep pod-wide,
+        sequenced with (never concurrent to) training collectives."""
+        if not self._defer_t1_to_main:
+            return
+        while True:
+            with self._cond:
+                ready = sorted(
+                    s for s, e in self._entries.items()
+                    if e.gate <= self._watermark and not e.durable
+                    and not e.cancelled and e.failed is None)
+                if not ready:
+                    return
+                e = self._entries[ready[0]]
+                e.verdicted = True
+            try:
+                # pump boundaries are deterministic pod-wide, so the
+                # barriered delete/save inside _write_tier1 pair
+                self._write_tier1(e, e.snap)
+            except Exception as err:  # noqa: BLE001 - same contract as
+                # the writer thread: a failed trickle step is a lost
+                # durability step, never a dead run
+                with self._cond:
+                    e.failed = repr(err)
+                    self._cond.notify_all()
+                counters.inc("tiered_write_failures")
+                logger.warning(
+                    f"tiered checkpoint: tier-1 write of step {e.step} "
+                    f"failed ({err!r}); the step is not durable")
+
+    def _mirror_step(self, step: int) -> None:
+        """Copy the committed step dir into the mirror: payload into a
+        temp dir, atomic rename, THEN the commit marker — a crash
+        anywhere leaves either nothing or an unmarked (invisible) copy,
+        never a marked torn one."""
+        src = os.path.join(self._dir, str(step))
+        dst = os.path.join(self._mirror_dir, str(step))
+        if os.path.exists(os.path.join(dst, MANIFEST)):
+            # already mirrored — but only if it is the SAME save: a
+            # fresh run (resume=None) on a used dir re-reaches old
+            # labels with different bits, and tier 1 replaced its copy
+            # (delete_step) while a skip here would leave the mirror
+            # serving the discarded timeline.  The manifest carries the
+            # write time, so byte-equality identifies the same save.
+            try:
+                with open(os.path.join(src, MANIFEST), "rb") as a, \
+                        open(os.path.join(dst, MANIFEST), "rb") as b:
+                    if a.read() == b.read():
+                        return
+            except OSError:
+                pass  # unreadable marker: re-mirror below
+        os.makedirs(self._mirror_dir, exist_ok=True)
+        tmp = dst + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(src, tmp,
+                        ignore=shutil.ignore_patterns(MANIFEST))
+        shutil.rmtree(dst, ignore_errors=True)
+        os.replace(tmp, dst)
+        mtmp = os.path.join(dst, MANIFEST + ".tmp")
+        shutil.copy2(os.path.join(src, MANIFEST), mtmp)
+        os.replace(mtmp, os.path.join(dst, MANIFEST))
+
+    def _trim_tier0(self) -> None:
+        """Free all but the newest ``tier0_keep`` verdicted host
+        snapshots; drop fully-drained (durable + freed) entries."""
+        with self._cond:
+            verdicted = sorted(s for s, e in self._entries.items()
+                               if e.verdicted)
+            stale = (verdicted[:-self._tier0_keep]
+                     if len(verdicted) > self._tier0_keep else [])
+            for s in stale:
+                e = self._entries[s]
+                e.host = None
+                if e.durable:
+                    self._entries.pop(s, None)
+
+    def _write_status(self) -> None:
+        """Advisory trickle-progress file (``inspect`` reads it)."""
+        if coord.process_index() != 0:
+            return
+        with self._cond:
+            status = {
+                "submitted": self._last_submitted,
+                "verdicts_through": self._watermark,
+                "durable": max((s for s, e in self._entries.items()
+                                if e.durable), default=-1),
+                "tier0_steps": sorted(
+                    s for s, e in self._entries.items()
+                    if e.verdicted and e.host is not None),
+                "mirror_dir": self._mirror_dir,
+                "time": time.time(),
+            }
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            # per-thread temp name: the writer thread and the main
+            # thread (pump) may both publish concurrently, and a shared
+            # temp file would let their writes interleave into a
+            # mangled publish.  os.replace itself is atomic either way.
+            tmp = os.path.join(
+                self._dir,
+                f"{TIERED_STATUS}.tmp{threading.get_ident()}")
+            with open(tmp, "w") as f:
+                json.dump(status, f)
+            os.replace(tmp, os.path.join(self._dir, TIERED_STATUS))
+        except OSError:
+            pass
+
+    # -- lifecycle -----------------------------------------------------------
+    def wait_until_finished(self) -> None:
+        """Block until every entry whose verdict gate is already open
+        has trickled to durability (or failed).  Entries still awaiting
+        a verdict are deliberately NOT waited on — on an abort exit
+        their gates never open and :meth:`close` discards them.  Must
+        run on the main thread (multi-process pumps the orbax write
+        here)."""
+        self.pump()
+        with self._cond:
+            def pending():
+                return [e for e in self._entries.values()
+                        if e.gate <= self._watermark and not e.cancelled
+                        and not e.durable and e.failed is None]
+            deadline = time.monotonic() + 600.0
+            while pending():
+                if not self._cond.wait(0.1) \
+                        and time.monotonic() > deadline:
+                    raise CheckpointError(
+                        "tiered checkpoint: trickle did not finish "
+                        f"within 600s (steps "
+                        f"{[e.step for e in pending()]})")
+
+    def is_durable(self, step: int) -> bool:
+        """Whether ``step`` has a committed tier-1 checkpoint (the
+        emergency-save path verifies this after the grace-window flush:
+        a failed trickle must surface as an error there, exactly like a
+        failed blocking save — not as a 'durable' log line)."""
+        with self._cond:
+            e = self._entries.get(step)
+            if e is not None and e.durable:
+                return True
+        return os.path.exists(os.path.join(self._dir, str(step),
+                                           MANIFEST))
+
+    def close(self) -> None:
+        """Flush verdicted entries, discard unverdicted ones (their
+        verdicts will never arrive — the fit that owned them exited),
+        and stop the writer.  The tier-0 store and the tier-1 manager
+        survive: a later ``fit`` on the same trainer reuses both, which
+        is what makes in-process restore-from-RAM possible."""
+        self.pump()  # multi-process: flush gate-open writes first
+        with self._cond:
+            for e in self._entries.values():
+                if not e.verdicted and self._watermark < e.gate:
+                    e.cancelled = True
+            self._cond.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._queue.put(_STOP)
+            self._thread.join(timeout=600.0)
+            if self._thread.is_alive():
+                logger.warning("tiered checkpoint: writer did not stop "
+                               "within 600s")
+        self._thread = None
+        with self._cond:
+            for s in [s for s, e in self._entries.items() if e.cancelled]:
+                self._entries.pop(s, None)
+
+    def shutdown(self) -> None:
+        """Dispose of everything (tier-0 store included)."""
+        self.close()
+        with self._cond:
+            self._entries.clear()
+        with self._io_lock:
+            if self._inner is not None:
+                self._inner.close()
+                self._inner = None
+            if self._mirror_inner is not None:
+                self._mirror_inner.close()
+                self._mirror_inner = None
+
+    # -- restore side --------------------------------------------------------
+    def _inner_mgr(self) -> CheckpointManager:
+        with self._io_lock:
+            if self._inner is None:
+                self._inner = CheckpointManager(self._dir,
+                                                **self._inner_kwargs)
+            return self._inner
+
+    def _mirror_mgr(self) -> Optional[CheckpointManager]:
+        if self._mirror_dir is None:
+            return None
+        with self._io_lock:
+            if self._mirror_inner is None:
+                self._mirror_inner = CheckpointManager(
+                    self._mirror_dir, **self._mirror_kwargs)
+            return self._mirror_inner
+
+    @staticmethod
+    def _fs_valid_steps(directory: Optional[str]) -> List[int]:
+        """Commit-marked steps, straight off the filesystem — no orbax
+        manager, no collectives (the RAM/peer restore path must work
+        on a process whose manager does not exist yet)."""
+        if not directory:
+            return []
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return []
+        return sorted(
+            int(n) for n in names
+            if n.isdigit() and os.path.exists(
+                os.path.join(directory, n, MANIFEST)))
+
+    @staticmethod
+    def _newest_validated_fs(directory: Optional[str],
+                             abstract_state: Any) -> int:
+        """Newest marked step whose manifest digest matches the target
+        state — the same judgement ``CheckpointManager.validate_step``
+        makes, from files only."""
+        want = tree_digest(abstract_state)
+        best = -1
+        for s in TieredCheckpointManager._fs_valid_steps(directory):
+            try:
+                with open(os.path.join(directory, str(s), MANIFEST)) as f:
+                    got = (json.load(f) or {}).get("tree", {})
+            except (OSError, ValueError):
+                continue
+            if (got.get("leaves") == want["leaves"]
+                    and got.get("digest") == want["digest"]):
+                best = max(best, s)
+        return best
+
+    def _ram_steps(self) -> List[int]:
+        with self._cond:
+            return sorted(s for s, e in self._entries.items()
+                          if e.verdicted and e.host is not None)
+
+    def restore_latest_valid(self, abstract_state: Any):
+        """Newest valid tier, pod-wide.  Verdicted tier-0 snapshots
+        (max over hosts — any single healthy host can donate) win over
+        durable steps (min over hosts — the conservative consensus
+        choice, as in the blocking manager) at equal-or-newer step:
+        same bits, no storage read.  Ties between durable tiers go to
+        the newer step; tier choice is made from consensus values so
+        every host deterministically picks the same tier.  Returns
+        ``(state, step)`` like the blocking manager."""
+        t = self._coord_timeout
+        ram_local = max(self._ram_steps(), default=-1)
+        best_ram = coord.max_over_hosts(ram_local, timeout_s=t,
+                                        name="tiered-ram-step")
+        t1 = coord.min_over_hosts(
+            self._newest_validated_fs(self._dir, abstract_state),
+            timeout_s=t, name="tiered-t1-step")
+        t2 = coord.min_over_hosts(
+            self._newest_validated_fs(self._mirror_dir, abstract_state),
+            timeout_s=t, name="tiered-t2-step") \
+            if self._mirror_dir is not None else -1
+        if best_ram >= 0 and best_ram >= max(t1, t2):
+            try:
+                state = self._restore_from_ram(abstract_state, best_ram,
+                                               ram_local)
+                self._rewind(best_ram)
+                return state, best_ram
+            except Exception as e:  # noqa: BLE001
+                if coord.process_count() > 1:
+                    # a divergent per-host fallback would wedge the pod
+                    # in mismatched collectives — fail together, the
+                    # restarted job's durable consensus recovers
+                    raise
+                logger.warning(
+                    f"tiered checkpoint: RAM restore of step {best_ram} "
+                    f"failed ({e!r}); falling back to durable tiers")
+        if t2 > t1:
+            try:
+                with self._io_lock:
+                    state = self._mirror_mgr().restore(abstract_state,
+                                                       step=t2)
+                counters.inc("mirror_restores")
+                self._rewind(t2)
+                return state, t2
+            except (CheckpointError,) as e:
+                if coord.process_count() > 1:
+                    raise
+                logger.warning(
+                    f"tiered checkpoint: mirror restore of step {t2} "
+                    f"failed ({e!r}); falling back to tier 1")
+        with self._io_lock:
+            try:
+                state, step = self._inner_mgr().restore_latest_valid(
+                    abstract_state)
+            except (CheckpointNotFoundError,
+                    CheckpointCorruptionError):
+                m = self._mirror_mgr()
+                if m is None or coord.process_count() > 1:
+                    raise
+                # local history burned but the mirror survives: the
+                # long-horizon tier is exactly for this
+                state, step = m.restore_latest_valid(abstract_state)
+                counters.inc("mirror_restores")
+        self._rewind(step)
+        return state, step
+
+    def _restore_from_ram(self, abstract_state: Any, best_ram: int,
+                          ram_local: int):
+        """Place a verdicted tier-0 snapshot into the target shardings
+        through the compiled layout-transfer engine; multi-host, the
+        donor's snapshot is broadcast to the pod first (peer restore)."""
+        me = coord.process_index()
+        nprocs = coord.process_count()
+        if nprocs == 1:
+            with self._cond:
+                entry = self._entries.get(best_ram)
+            host = entry.host if entry is not None else None
+            if host is None:
+                raise CheckpointError(
+                    f"tiered checkpoint: tier-0 snapshot of step "
+                    f"{best_ram} is gone")
+            ok = tree_digest(host) == tree_digest(abstract_state)
+        else:
+            # donor = smallest process index holding the step; peers
+            # vote the donated structure matches the target before the
+            # state-sized broadcast runs
+            big = 1 << 30
+            donor = coord.min_over_hosts(
+                me if ram_local == best_ram else big,
+                timeout_s=self._coord_timeout, name="tiered-peer-donor")
+            if donor >= big:
+                raise CheckpointError(
+                    "tiered checkpoint: RAM step vanished before the "
+                    "peer restore (donor lost)")
+            is_src = me == donor
+            if is_src:
+                with self._cond:
+                    entry = self._entries.get(best_ram)
+                payload = entry.host if entry is not None else None
+                my_ok = (payload is not None
+                         and tree_digest(payload)
+                         == tree_digest(abstract_state))
+            else:
+                import jax
+                payload = jax.tree.map(
+                    lambda a: (None if a is None
+                               else np.zeros(a.shape, a.dtype)),
+                    abstract_state, is_leaf=lambda x: x is None)
+                my_ok = True
+            ok = coord.all_agree(bool(my_ok),
+                                 timeout_s=self._coord_timeout,
+                                 name="tiered-peer-vote")
+            if not ok:
+                raise CheckpointError(
+                    "tiered checkpoint: peer tier-0 snapshot does not "
+                    "match the target state structure")
+            host = coord.broadcast_from_host(
+                payload, is_source=is_src,
+                timeout_s=self._coord_timeout, name="tiered-peer-restore")
+            if not is_src:
+                counters.inc("peer_restores")
+        if nprocs == 1 and not ok:
+            raise CheckpointError(
+                f"tiered checkpoint: tier-0 snapshot of step {best_ram} "
+                "does not match the target state structure")
+        # exact placement, no compute and no compile: each process
+        # builds its addressable shards straight from the host copy
+        # (works identically single- and multi-process — unlike a
+        # compiled host->mesh transfer, which multi-process jit rejects
+        # for numpy operands).  Bitwise by construction.
+        import jax
+
+        def place(x, a):
+            if a is None:
+                return None
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                tuple(a.shape), a.sharding, lambda idx: arr[idx])
+        state = jax.tree.map(place, host, abstract_state,
+                             is_leaf=lambda v: v is None)
+        counters.inc("ram_restores")
+        logger.info(
+            f"tiered checkpoint: restored step {best_ram} from "
+            + ("host RAM" if nprocs == 1 or ram_local == best_ram
+               else "a peer's host RAM") + " (no storage read)")
+        return state
+
+    def begin_run(self, start_step: int) -> None:
+        """A new fit starting at ``start_step`` is a new timeline from
+        there: called by the trainer after resume resolution so a fresh
+        (``resume=None``) run on a previously-used directory saves
+        normally instead of being skipped by a stale submission cursor,
+        and so stale-timeline RAM snapshots can never resurface."""
+        self._rewind(start_step)
+
+    def _rewind(self, step: int) -> None:
+        """A restore to (or fresh run from) ``step`` discards the
+        younger timeline: RAM snapshots beyond it must never resurface,
+        the interval gate must allow re-saving re-executed steps, and
+        the verdict watermark rewinds to ``step - 1`` — checkpoint
+        label ``step`` contains step *indices* ``< step``, all
+        verdicted at save time, while index ``step`` itself is about to
+        be (re-)executed and must earn a fresh verdict before any save
+        gated on it commits."""
+        with self._cond:
+            for s in [s for s in self._entries if s > step]:
+                self._entries[s].cancelled = True
+                self._entries.pop(s, None)
+            self._watermark = min(self._watermark, step - 1)
+            self._last_submitted = min(self._last_submitted, step)
+            self._cond.notify_all()
+
+    def restore(self, abstract_state: Any, step: Optional[int] = None):
+        """Explicit-step restore: tier 1, falling back to the mirror
+        when the step only survives there."""
+        with self._io_lock:
+            try:
+                return self._inner_mgr().restore(abstract_state,
+                                                 step=step)
+            except CheckpointError:
+                m = self._mirror_mgr()
+                if m is None or step is None \
+                        or not os.path.exists(os.path.join(
+                            self._mirror_dir, str(step), MANIFEST)):
+                    raise
+                logger.warning(
+                    f"tiered checkpoint: step {step} unreadable in tier "
+                    "1; restoring the mirror copy")
+                out = m.restore(abstract_state, step=step)
+                counters.inc("mirror_restores")
+                return out
+
+    # -- introspection (CheckpointManager surface + tiers) -------------------
+    def valid_steps(self) -> List[int]:
+        with self._io_lock:
+            return self._inner_mgr().valid_steps()
+
+    def latest_step(self) -> Optional[int]:
+        with self._io_lock:
+            return self._inner_mgr().latest_step()
+
+    def validate_step(self, step: int,
+                      abstract_state: Optional[Any] = None) -> bool:
+        with self._io_lock:
+            return self._inner_mgr().validate_step(step, abstract_state)
+
+    def read_loader_state(self, step: int) -> Optional[Dict[str, Any]]:
+        """RAM entry first (a restore-from-RAM resumes the loader from
+        the snapshot's own sidecar), then tier 1, then the mirror."""
+        with self._cond:
+            e = self._entries.get(step)
+            if e is not None and e.verdicted \
+                    and e.loader_state is not None:
+                return e.loader_state
+        out = self._read_tier_json(step, LOADER_STATE)
+        if out is None:
+            out = self._read_mirror_json(step, LOADER_STATE)
+        return out
+
+    def read_guard_state(self, step: int) -> Optional[Dict[str, Any]]:
+        with self._cond:
+            e = self._entries.get(step)
+            if e is not None and e.verdicted \
+                    and isinstance(e.guard_state, dict):
+                return e.guard_state
+        out = self._read_tier_json(step, GUARD_STATE)
+        if out is None:
+            out = self._read_mirror_json(step, GUARD_STATE)
+        return out
+
+    def _read_tier_json(self, step: int,
+                        fname: str) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self._dir, str(step), fname)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _read_mirror_json(self, step: int,
+                          fname: str) -> Optional[Dict[str, Any]]:
+        if self._mirror_dir is None:
+            return None
+        try:
+            with open(os.path.join(self._mirror_dir, str(step),
+                                   fname)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def tier_status(self) -> Dict[str, Any]:
+        """Per-tier view for tests/tools: RAM steps, durable steps,
+        mirrored steps, watermark."""
+        with self._cond:
+            ram = self._ram_steps()
+            wm = self._watermark
+        durable = self._fs_valid_steps(self._dir)
+        mirrored: List[int] = []
+        if self._mirror_dir is not None and os.path.isdir(self._mirror_dir):
+            mirrored = sorted(
+                int(n) for n in os.listdir(self._mirror_dir)
+                if n.isdigit() and os.path.exists(
+                    os.path.join(self._mirror_dir, n, MANIFEST)))
+        return {"ram": ram, "durable": durable, "mirrored": mirrored,
+                "verdicts_through": wm}
+
+
+def read_tiered_status(directory: str) -> Optional[Dict[str, Any]]:
+    """The advisory ``_TIERED`` trickle-progress file (None when the
+    directory was never written by a tiered manager)."""
+    try:
+        with open(os.path.join(directory, TIERED_STATUS)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

@@ -596,6 +596,33 @@ class ResilienceConfig:
     # matrices taken with this on are not comparable to ones taken with
     # it off (different row count).
     sdc_digest_optimizer: bool = False
+    # tiered zero-stall checkpointing (checkpoint/tiered.py,
+    # docs/resilience.md "Tiered checkpointing"): interval saves take a
+    # donation-safe device snapshot inside the step gap and return
+    # immediately; a background writer fetches it to host RAM (tier 0),
+    # then — once the step's lagged guard/SDC verdict has resolved —
+    # trickles it to local disk (tier 1, the same commit-marker/digest/
+    # manifest protocol as blocking saves) and optionally to a mirror
+    # directory (tier 2).  save_blocked_ms drops to the snapshot cost,
+    # the verdict drain disappears from the save path, and checkpoint
+    # cadence can tighten to per-minute.  Off (default): interval saves
+    # drain in-flight verdicts and hand off to orbax synchronously,
+    # exactly the pre-tiered behaviour.
+    tiered_checkpointing: bool = False
+    # tier-2 mirror directory (object store mount / second filesystem):
+    # committed tier-1 steps are copied here by the trickle, payload
+    # first and the commit marker last, so a torn mirror copy is as
+    # invisible as a torn save.  None = no tier 2.
+    tiered_mirror_dir: Optional[str] = None
+    # newest verdicted tier-0 host-RAM snapshots retained per process
+    # (restore-from-RAM / peer-restore candidates).  Each costs one
+    # state-sized host allocation; older snapshots are freed as newer
+    # ones pass their verdict gate.
+    tiered_tier0_keep: int = 2
+    # enforce, not warn: make fit() raise a typed QuarantinedHostError
+    # when the restarted pod still contains a host recorded in
+    # <run_dir>/sdc_quarantine.json (off: the PR-4 loud warning only)
+    refuse_quarantined: bool = False
 
     def validate(self) -> None:
         _check(self.spike_zscore > 0,
@@ -643,6 +670,8 @@ class ResilienceConfig:
         if self.sdc_digest_max_elems is not None:
             _check(self.sdc_digest_max_elems >= 1,
                    "resilience.sdc_digest_max_elems must be >= 1")
+        _check(self.tiered_tier0_keep >= 1,
+               "resilience.tiered_tier0_keep must be >= 1")
 
     def retry_policy(self, max_retries: int) -> Any:
         """The shared RetryPolicy view of the delay/deadline knobs."""

@@ -153,6 +153,21 @@ class SDCError(TorchAccTPUError):
         self.report = list(report or [])
 
 
+class QuarantinedHostError(TorchAccTPUError):
+    """The restarted pod still contains a host recorded in
+    ``sdc_quarantine.json`` and ``resilience.refuse_quarantined`` is on.
+    A quarantined chip re-entering the pod silently re-arms the exact
+    failure mode the quarantine exists to end; the enforcing error
+    carries the offending host id(s) so the supervisor can reschedule
+    excluding them (elastic resume handles the smaller world)."""
+
+    def __init__(self, message: str, *, hosts: Optional[list] = None,
+                 quarantine_file: Optional[str] = None):
+        super().__init__(message)
+        self.hosts = list(hosts or [])
+        self.quarantine_file = quarantine_file
+
+
 class AnomalyError(TorchAccTPUError):
     """Too many consecutive anomalous steps — the run is diverging, not
     glitching.  Carries a diagnosis so the operator sees *what* tripped

@@ -128,6 +128,33 @@ def broadcast_from_primary(value: Any, *, timeout_s: Optional[float] = None,
     return out.item() if np.ndim(value) == 0 and out.ndim == 0 else out
 
 
+def broadcast_from_host(tree: Any, *, is_source: bool,
+                        timeout_s: Optional[float] = None,
+                        name: str = "broadcast-host") -> Any:
+    """One host's pytree on every host — ``broadcast_from_primary``
+    generalised to an arbitrary donor (the peer-RAM restore seam:
+    a restarted host receives a healthy peer's tier-0 snapshot without
+    touching storage, checkpoint/tiered.py).
+
+    Exactly ONE host must pass ``is_source=True``; every host must pass
+    a tree with the identical structure and per-leaf shapes/dtypes (the
+    non-source trees' values are ignored — zeros of the right shape are
+    the conventional filler).  Values come back as host numpy arrays.
+    Single-process: returns ``tree`` unchanged — no collective, no
+    timeout armed."""
+    if process_count() == 1:
+        return tree
+    import jax
+    from jax.experimental import multihost_utils
+
+    return _bounded(
+        lambda: jax.tree.map(
+            np.asarray,
+            multihost_utils.broadcast_one_to_all(tree,
+                                                 is_source=bool(is_source))),
+        timeout_s=timeout_s, name=name)
+
+
 def min_over_hosts(value: int, *, timeout_s: Optional[float] = None,
                    name: str = "min-over-hosts") -> int:
     """Smallest of the hosts' integers (e.g. the conservative resume

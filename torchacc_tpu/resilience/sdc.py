@@ -62,6 +62,9 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from torchacc_tpu.errors import SDCError
+from torchacc_tpu.resilience.coordination import (
+    process_count as _process_count,
+)
 from torchacc_tpu.utils.logger import logger
 
 #: digest components per leaf (all compared as uint32 bit patterns)
@@ -290,6 +293,12 @@ def record_quarantine(run_dir: str, hosts: Sequence[int], *, step: int,
     for h in hosts:
         data["hosts"][str(int(h))] = {
             "step": int(step), "kind": kind, "time": time.time(),
+            # pod size at quarantine time: host ids are process
+            # indices, which RENUMBER after an elastic shrink — the
+            # refuse_quarantined enforcement only fires while the world
+            # is still at least this big (a smaller world means the
+            # exclusion-and-shrink already happened)
+            "world": _process_count(),
             "report": list(report)[:8]}
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

@@ -196,10 +196,27 @@ class Trainer:
         self.save_blocked = BlockedMeter()
         self.state: Optional[TrainState] = None
         self.state_shardings = None
+        # tiered zero-stall checkpointing (checkpoint/tiered.py): the
+        # manager is cached per checkpoint-dir so tier-0 host-RAM
+        # snapshots survive an in-process supervisor's catch-and-refit
+        # (restore-from-RAM); _tiered_active is set only while a fit
+        # with tiered saves is running — resolve_oldest advances its
+        # verdict watermark there
+        self._tiered_cache: Optional[Tuple[Any, Any]] = None
+        self._tiered_active = None
         self._abstract: Optional[TrainState] = None
         self.batch_sharding = NamedSharding(self.mesh, batch_spec(config))
         self._train_step = None
         self._train_step_structure = None
+        # zero-copy tiered snapshots: a tiered save hands the LIVE state
+        # to the background writer instead of paying a state-sized
+        # device copy on the hot path; the one step dispatched after it
+        # runs a NON-DONATING variant of the same compiled step so the
+        # handed-off buffers survive (same transient 2x-state memory
+        # the copy would have cost, zero memcpy, bitwise-identical
+        # math).  Compiled lazily on the first post-save step.
+        self._train_step_nodonate = None
+        self._no_donate_once = False
         self._metrics_sharding = NamedSharding(self.mesh, PartitionSpec())
 
     def _batch_shardings(self, batch) -> Dict[str, Any]:
@@ -424,7 +441,7 @@ class Trainer:
         return l_sum, count, (mutated.get("quant")
                               if quant is not None else None)
 
-    def _build_train_step(self, sample_batch):
+    def _build_train_step(self, sample_batch, donate: bool = True):
         accum = self.config.grad_accum
         optimizer = self.optimizer
         use_scaler = self.config.compute.dtype == "float16"
@@ -711,7 +728,8 @@ class Trainer:
             fn,
             in_shardings=tuple(in_sh),
             out_shardings=(None if offload_live else tuple(out_sh)),
-            donate_argnums=(0, 2) if guard_on else (0,),
+            donate_argnums=(() if not donate
+                            else (0, 2) if guard_on else (0,)),
         )
 
     def _ensure_compiled(self, batch: Dict[str, jax.Array]) -> None:
@@ -722,6 +740,7 @@ class Trainer:
         if self._train_step is None or structure != self._train_step_structure:
             self._train_step = self._build_train_step(batch)
             self._train_step_structure = structure
+            self._train_step_nodonate = None
 
     def _ensure_guard(self) -> None:
         from torchacc_tpu.resilience.guard import GuardMonitor, guard_init
@@ -839,8 +858,19 @@ class Trainer:
             args.append(self._guard_state)
         if self._sdc_on:
             args.append(flip)
+        fn = self._train_step
+        if self._no_donate_once:
+            # the previous boundary handed the live state to the tiered
+            # checkpoint writer: this ONE dispatch must not donate it
+            # (the writer still reads those buffers).  Same computation,
+            # aliasing stripped — values bitwise identical.
+            self._no_donate_once = False
+            if self._train_step_nodonate is None:
+                self._train_step_nodonate = self._build_train_step(
+                    batch, donate=False)
+            fn = self._train_step_nodonate
         with jax.sharding.set_mesh(self.mesh):
-            out = self._train_step(*args)
+            out = fn(*args)
         if self._guard_on:
             self.state, self._guard_state, metrics = out
         else:
@@ -853,10 +883,14 @@ class Trainer:
         self._host_step = si + 1
         rerun = None
         if sdc_snap is not None:
-            fn = self._train_step
-            # shallow-copy the batch dict too (same hazard as the
-            # metrics copy below): a caller reusing one dict per step
-            # must not change what a lagged arbiter re-executes
+            # capture the executable ACTUALLY dispatched (which may be
+            # the non-donating tiered-save variant): the recompute
+            # arbiter's bitwise-by-construction guarantee holds only
+            # for the same executable, and aliasing differences could
+            # in principle change instruction scheduling.  Also
+            # shallow-copy the batch dict (same hazard as the metrics
+            # copy below): a caller reusing one dict per step must not
+            # change what a lagged arbiter re-executes.
             rerun = (lambda snap=sdc_snap, b=dict(batch), s=si, f=fn:
                      self._sdc_rerun(snap, b, s, fn=f))
         ids = batch.get("input_ids") if hasattr(batch, "get") else None
@@ -917,6 +951,12 @@ class Trainer:
         # count, never in-flight + resolved
         e.digests = None
         e.rerun = None
+        # tiered checkpointing: this step's guard/SDC verdicts are in —
+        # background trickle commits gated at or below it may proceed.
+        # An abort raises above, so the watermark never passes a
+        # flagged step and its snapshot is discarded, never committed.
+        if self._tiered_active is not None:
+            self._tiered_active.notify_verdicts_through(e.step)
         return e
 
     def drain(self) -> List[_InFlightStep]:
@@ -982,6 +1022,37 @@ class Trainer:
         self.state = self._adopt_restored(
             restore_checkpoint(path, self.abstract_state()))
         return self.state
+
+    def _tiered_manager(self, checkpoint_dir: str, checkpoint_every: int,
+                        res_cfg):
+        """The trainer-cached TieredCheckpointManager for this
+        checkpoint dir: reused across fit() calls (same key) so tier-0
+        host-RAM snapshots survive an in-process supervisor's
+        catch-and-refit — restore-from-RAM needs them alive."""
+        import os as _os
+
+        from torchacc_tpu.checkpoint.tiered import TieredCheckpointManager
+        # the save interval is a property of the fit CALL, not of the
+        # store — deliberately not part of the key, so a resume with a
+        # different cadence reuses the manager (and its tier-0 RAM
+        # snapshots) instead of discarding them
+        key = (_os.path.abspath(checkpoint_dir),
+               res_cfg.tiered_mirror_dir, res_cfg.tiered_tier0_keep)
+        if self._tiered_cache is not None and self._tiered_cache[0] == key:
+            mgr = self._tiered_cache[1]
+            mgr.set_interval(checkpoint_every)
+            return mgr
+        if self._tiered_cache is not None:
+            self._tiered_cache[1].shutdown()
+        mgr = TieredCheckpointManager(
+            checkpoint_dir, save_interval_steps=checkpoint_every,
+            mirror_dir=res_cfg.tiered_mirror_dir,
+            tier0_keep=res_cfg.tiered_tier0_keep,
+            retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries),
+            coord_timeout_s=res_cfg.coord_timeout_s,
+            elastic_resume=res_cfg.elastic_resume)
+        self._tiered_cache = (key, mgr)
+        return mgr
 
     # -- train -> serve handoff ---------------------------------------------
     def serving_shardings(self, mesh: Optional[Mesh] = None) -> Any:
@@ -1102,22 +1173,70 @@ class Trainer:
         from torchacc_tpu.utils.metrics import counters, open_metrics
         res_cfg = self.config.resilience
         mgr = None
+        tiered = None
         if checkpoint_dir is not None:
-            from torchacc_tpu.checkpoint import CheckpointManager
-            mgr = CheckpointManager(
-                checkpoint_dir, save_interval_steps=checkpoint_every,
-                retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries),
-                coord_timeout_s=res_cfg.coord_timeout_s,
-                elastic_resume=res_cfg.elastic_resume)
+            if res_cfg.tiered_checkpointing:
+                # zero-stall tiered saves (checkpoint/tiered.py): the
+                # hot path only snapshots + enqueues; durability
+                # trickles in the background, gated on the lagged
+                # verdicts — docs/resilience.md "Tiered checkpointing"
+                tiered = self._tiered_manager(checkpoint_dir,
+                                              checkpoint_every, res_cfg)
+                mgr = tiered
+            else:
+                from torchacc_tpu.checkpoint import CheckpointManager
+                mgr = CheckpointManager(
+                    checkpoint_dir, save_interval_steps=checkpoint_every,
+                    retry_policy=res_cfg.retry_policy(res_cfg.ckpt_retries),
+                    coord_timeout_s=res_cfg.coord_timeout_s,
+                    elastic_resume=res_cfg.elastic_resume)
         # SDC quarantine records land in the run dir; a restarted pod
         # that still contains a quarantined host gets warned loudly
         self._sdc_run_dir = checkpoint_dir or metrics_dir
         if self._sdc_run_dir:
-            from torchacc_tpu.resilience.coordination import process_index
+            from torchacc_tpu.resilience.coordination import (
+                process_count,
+                process_index,
+            )
             from torchacc_tpu.resilience.sdc import read_quarantined_hosts
             q = read_quarantined_hosts(self._sdc_run_dir)
             if q:
                 me = process_index()
+                # a quarantined id counts as "still in the pod" only if
+                # it is a valid index here AND the world has not shrunk
+                # below its quarantine-time size: host ids are process
+                # indices, which renumber after an elastic shrink — a
+                # smaller world means the documented remediation
+                # (restart excluding the host) already happened, and
+                # refusing on the renumbered id would brick the run.
+                # Records without a world (pre-PR-9 files) stay
+                # conservative: they refuse until cleared.
+                def _still_present(h) -> bool:
+                    if h >= process_count():
+                        return False
+                    world = (q.get(h) or {}).get("world")
+                    return world is None or process_count() >= int(world)
+                present = sorted(h for h in q if _still_present(h))
+                if present and res_cfg.refuse_quarantined:
+                    # enforce, not warn: a quarantined chip re-entering
+                    # the pod silently re-arms the exact failure the
+                    # quarantine ended.  Deterministic pod-wide (shared
+                    # quarantine file, same world size) so every
+                    # process raises together.
+                    import os as _os
+
+                    from torchacc_tpu.errors import QuarantinedHostError
+                    raise QuarantinedHostError(
+                        f"refusing to train: host(s) {present} of this "
+                        f"{process_count()}-process pod are quarantined "
+                        f"for silent data corruption in "
+                        f"{self._sdc_run_dir}/sdc_quarantine.json — "
+                        "restart excluding them (elastic_resume handles "
+                        "the smaller world), or clear the quarantine "
+                        "file deliberately",
+                        hosts=present,
+                        quarantine_file=_os.path.join(
+                            self._sdc_run_dir, "sdc_quarantine.json"))
                 logger.warning(
                     f"run dir {self._sdc_run_dir} quarantines host(s) "
                     f"{sorted(q)} for silent data corruption "
@@ -1156,6 +1275,12 @@ class Trainer:
         self.last_resolved = None
         self.blocked.take_ms()
         self.save_blocked.take_ms()
+        # a stale no-donate flag (fit exited right after a tiered save)
+        # would only waste one donation — but keep entries clean
+        self._no_donate_once = False
+        # tiered saves listen to this fit's verdict stream from here on
+        # (resolve_oldest advances the trickle's commit watermark)
+        self._tiered_active = tiered
         resumed_loader_state = None
         start_step = 0
         if resume is not None:
@@ -1204,6 +1329,13 @@ class Trainer:
                     + ("restoring durable loader state"
                        if resumed_loader_state is not None else
                        f"skipping {start_step} consumed batches"))
+        if tiered is not None:
+            # this fit is a new timeline from start_step: reset the
+            # cached manager's submission cursor / verdict watermark and
+            # discard RAM snapshots beyond it — a fresh (resume=None)
+            # run on a previously-used dir must save normally, and a
+            # discarded timeline's snapshots must never resurface
+            tiered.begin_run(start_step)
         preempt_on = mgr is not None and res_cfg.emergency_checkpoint
         if preempt_on:
             from torchacc_tpu.resilience.coordination import (
@@ -1393,7 +1525,49 @@ class Trainer:
                     # consistent and resume='auto' recovers cleanly
                     wd.disarm()
                 saved = False
-                if mgr is not None:
+                if tiered is not None:
+                    # zero-stall tiered save (checkpoint/tiered.py):
+                    # the hot path hands the LIVE state to the trickle
+                    # and marks the next dispatch non-donating so those
+                    # buffers survive — no device copy, no verdict
+                    # drain, no orbax wait.  Verdict-before-durability
+                    # moves into the trickle: tier 1 commits once
+                    # resolve_oldest has advanced the watermark past
+                    # every step this snapshot contains (verdict_gate =
+                    # the newest dispatched step), so an abort discards
+                    # the snapshot instead of committing it.  Loader
+                    # state is materialised here (it advances with the
+                    # loop); the guard statistics ride as live device
+                    # scalars the writer fetches off the hot path.
+                    if tiered.should_save(step_idx + 1):
+                        with self.save_blocked.blocked():
+                            ls = None
+                            if loader_state_fn is not None:
+                                try:
+                                    ls = loader_state_fn()
+                                except Exception as e:  # noqa: BLE001
+                                    logger.warning(
+                                        f"loader state_dict() failed for "
+                                        f"step {step_idx + 1} ({e!r}); "
+                                        "resume will fall back to "
+                                        "skip-replay")
+                            gs = (self._guard_state if self._guard_on
+                                  else None)
+                            saved = tiered.submit(
+                                step_idx + 1, self.state,
+                                verdict_gate=step_idx,
+                                loader_state=ls, guard_state=gs)
+                        if saved:
+                            self._no_donate_once = True
+                    # multi-process only (single-process: no-op): run
+                    # verdict-cleared tier-1 writes HERE, on the main
+                    # thread at a deterministic boundary — the orbax
+                    # write's cross-process barriers are device
+                    # collectives and must stay sequenced with the
+                    # training collectives (tiered.py docstring)
+                    with self.save_blocked.blocked():
+                        tiered.pump()
+                elif mgr is not None:
                     # verdict-before-durability: a checkpoint must never
                     # commit a step whose guard/SDC verdict is still in
                     # flight — the ring drains BEFORE anything becomes
@@ -1454,10 +1628,45 @@ class Trainer:
                     # — the grace window must not fund an eval pass
                     if not saved:
                         _drain_all(allow_eval=False)
-                        mgr.save(step_idx + 1, self.state, force=True,
-                                 loader_state=loader_state_fn,
-                                 guard_state=guard_state_fn)
+                        if tiered is not None:
+                            # live handoff is donation-safe here: the
+                            # loop breaks below, so nothing ever
+                            # donates these buffers again
+                            with self.save_blocked.blocked():
+                                tiered.submit(
+                                    step_idx + 1, self.state,
+                                    verdict_gate=step_idx,
+                                    loader_state=(loader_state_fn()
+                                                  if loader_state_fn
+                                                  else None),
+                                    guard_state=(self._guard_state
+                                                 if self._guard_on
+                                                 else None))
+                        else:
+                            mgr.save(step_idx + 1, self.state, force=True,
+                                     loader_state=loader_state_fn,
+                                     guard_state=guard_state_fn)
+                    elif tiered is not None:
+                        # the interval submit above is gated on verdicts
+                        # still in flight — resolve them now so the
+                        # trickle commits inside the grace window
+                        _drain_all(allow_eval=False)
+                    # for tiered managers this blocks until every
+                    # verdict-cleared entry is durable — the grace
+                    # window is spent on durability, exactly like the
+                    # blocking path
                     mgr.wait_until_finished()
+                    if tiered is not None \
+                            and not tiered.is_durable(step_idx + 1):
+                        # a failed trickle must surface exactly like a
+                        # failed blocking save — never as a "durable"
+                        # log line the supervisor then trusts
+                        from torchacc_tpu.errors import CheckpointError
+                        raise CheckpointError(
+                            f"emergency checkpoint of step "
+                            f"{step_idx + 1} did not become durable "
+                            "(the tiered trickle failed — see the "
+                            "tiered_write_failures warning above)")
                     counters.inc("preemptions")
                     counters.inc("emergency_saves")
                     # the request is now handled — clear it so an
@@ -1487,7 +1696,14 @@ class Trainer:
             close = getattr(data_it, "close", None)
             if close is not None:
                 close()
+            self._tiered_active = None
             if mgr is not None:
+                # tiered: flush every verdict-cleared entry to
+                # durability, then close() discards the unverdicted
+                # ones (an abort exit's snapshots must never commit)
+                # and stops the writer — the tier-0 RAM store and the
+                # tier-1 manager survive on the trainer for
+                # restore-from-RAM
                 mgr.wait_until_finished()
                 mgr.close()
             if mw is not None:
