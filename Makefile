@@ -35,9 +35,14 @@ bench-smoke:
 		--quant int8 --no-decode --no-idle-probe
 
 # serving gate (docs/serving.md): drive the continuous-batching engine
-# on a mixed-length staggered workload on CPU; reports tokens/s + TTFT
-# and per-token latency percentiles, and FAILS unless greedy outputs
-# are token-identical to batch-synchronous generate()
+# on a mixed-length staggered workload on CPU, PLUS the shared-prefix
+# leg (N requests over K system prompts through a prefix-cache +
+# batched-prefill + priority engine, one request streamed, no-prefix
+# control); reports tokens/s + TTFT and per-token latency percentiles
+# + prefix_hit_rate / prefill_tokens_saved, and FAILS unless greedy
+# outputs on EVERY leg are token-identical to batch-synchronous
+# generate() AND the prefix cache actually fired (hit rate > 0,
+# tokens saved > 0)
 serve-smoke:
 	JAX_PLATFORMS=cpu python bench.py --serve --fast --platform cpu
 
@@ -71,7 +76,8 @@ chaos:
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) tests/test_resilience.py \
 			tests/test_watchdog.py tests/test_elastic.py \
 			tests/test_sdc.py tests/test_perf.py \
-			tests/test_serving.py tests/test_quant.py \
+			tests/test_serving.py tests/test_prefix_cache.py \
+			tests/test_quant.py \
 			tests/test_handoff.py tests/test_tiered.py \
 			-m "not slow" \
 			-q || exit 1; \

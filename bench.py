@@ -304,8 +304,15 @@ def main() -> int:
                          "reports tokens/s + TTFT and per-token latency "
                          "percentiles, and verifies greedy outputs are "
                          "token-identical to batch-synchronous "
-                         "generate() (`make serve-smoke` runs this on "
-                         "CPU as the PR gate)")
+                         "generate().  Includes the shared-prefix leg: "
+                         "N requests over K system prompts through a "
+                         "prefix-cache + batched-prefill + priority "
+                         "engine (one request streamed), FAILING unless "
+                         "token-identical AND prefix_hit_rate > 0 with "
+                         "prefill_tokens_saved > 0; emits hit rate, "
+                         "tokens saved, cow/eviction counts and warm-vs-"
+                         "cold TTFT p50/p95 (`make serve-smoke` runs "
+                         "this on CPU as the PR gate)")
     args = ap.parse_args()
 
     wd = Watchdog()
@@ -559,6 +566,20 @@ def _bench(args, wd: Watchdog) -> int:
     return 0
 
 
+def _ragged_batch(prompts):
+    """Left-padded (ids, mask, p_max) for ONE batch-synchronous
+    generate() call over ragged prompts — the ONE home for the padding
+    recipe both serve legs' identity gates compare against."""
+    import numpy as np
+    p_max = max(len(p) for p in prompts)
+    ids = np.zeros((len(prompts), p_max), np.int32)
+    mask = np.zeros((len(prompts), p_max), np.int32)
+    for i, p in enumerate(prompts):
+        ids[i, p_max - len(p):] = p
+        mask[i, p_max - len(p):] = 1
+    return ids, mask, p_max
+
+
 def _bench_serve(args, wd: Watchdog, devs) -> int:
     """Continuous-batching serving benchmark (docs/serving.md).
 
@@ -665,12 +686,7 @@ def _bench_serve(args, wd: Watchdog, devs) -> int:
     # would do: everyone padded to the longest prompt, nobody returns
     # before the slowest request)
     wd.stage("serve_reference", args.compile_budget)
-    p_max = max(lens)
-    ids_np = np.zeros((len(prompts), p_max), np.int32)
-    mask = np.zeros((len(prompts), p_max), np.int32)
-    for i, p in enumerate(prompts):
-        ids_np[i, p_max - len(p):] = p
-        mask[i, p_max - len(p):] = 1
+    ids_np, mask, p_max = _ragged_batch(prompts)
     out = generate(model, params, jnp.asarray(ids_np),
                    max_new_tokens=max_new, prompt_mask=jnp.asarray(mask))
     jax.block_until_ready(out)               # compiled; now time it
@@ -688,6 +704,125 @@ def _bench_serve(args, wd: Watchdog, devs) -> int:
     if mismatched:
         return fail(f"continuous-batching outputs diverge from "
                     f"generate() on requests {mismatched}", "verify")
+
+    # ---- shared-prefix leg (docs/serving.md "Prefix cache"): N
+    # requests over K system prompts through a prefix-cache + batched-
+    # prefill + priority-policy engine, one of them streamed.  Gates:
+    # (a) token identity to generate() for every request — prefix-hit,
+    # partial-hit, COW-dup, batched-prefill, priority and streamed
+    # mixes all ride this wave; (b) prefix_hit_rate > 0 AND
+    # prefill_tokens_saved > 0 (the cache must actually fire).  The
+    # no-prefix control engine serves the SAME wave for the TTFT /
+    # tokens-per-sec comparison (and is itself identity-gated).
+    if args.fast:
+        k_sys, n_per, sys_len, suf_len, p_new = 3, 2, 48, 7, 8
+    else:
+        k_sys, n_per, sys_len, suf_len, p_new = 4, 3, 256, 32, 32
+    rng_p = np.random.default_rng(7)
+    sys_prompts = [rng_p.integers(1, mc.vocab_size, size=sys_len).tolist()
+                   for _ in range(k_sys)]
+    # per system prompt: n_per suffixed requests (partial hits) + one
+    # exact duplicate (fully-cached prompt -> copy-on-write)
+    p_prompts = []
+    for sp in sys_prompts:
+        for _ in range(n_per):
+            p_prompts.append(
+                sp + rng_p.integers(1, mc.vocab_size, size=suf_len).tolist())
+        p_prompts.append(list(sp))
+    pn = len(p_prompts)
+
+    def serve_prefix_wave(prefix_on: bool):
+        c2 = ta.Config()
+        c2.serve.block_size = 16
+        c2.serve.max_slots = max_slots
+        c2.serve.prefill_chunk = chunk
+        c2.serve.num_blocks = 2 + sum(
+            blocks_needed(len(p) + p_new + c2.serve.decode_depth, 16)
+            for p in p_prompts + sys_prompts)
+        # the control differs ONLY in prefix_cache, so the noprefix
+        # TTFT/throughput deltas isolate the cache (batched prefill +
+        # priority policy run on BOTH engines)
+        c2.serve.prefix_cache = prefix_on
+        c2.serve.prefill_batch = min(4, max_slots)
+        c2.serve.policy = "priority"
+        eng2 = ServeEngine(model, params, c2)
+        # warmers, two phases: the bare system prompts register the
+        # prefix chains and compile the batched-prefill/decode/sample
+        # programs off the measured window; THEN one duplicate — only
+        # after the first phase completed, so its prompt actually hits
+        # the (now-registered) cache and compiles the copy-on-write +
+        # single-sequence-prefill programs too (submitted together, it
+        # would admit cold in the same first admission pass and leave
+        # those compiles inside the measured wave)
+        warm_ids = [eng2.submit(Request(prompt_ids=sp, max_new_tokens=2))
+                    for sp in sys_prompts]
+        eng2.run()
+        warm_ids.append(eng2.submit(
+            Request(prompt_ids=list(sys_prompts[0]), max_new_tokens=2)))
+        eng2.run()
+        for wi in warm_ids:
+            eng2.discard(wi)
+        eng2.reset_stats()
+        streamed: list = []
+        t0 = time.perf_counter()
+        ids2 = []
+        for i, p in enumerate(p_prompts):
+            ids2.append(eng2.submit(
+                Request(prompt_ids=p, max_new_tokens=p_new,
+                        priority=i % 3, deadline_s=120.0),
+                on_token=((lambda t, ts: streamed.append(t))
+                          if i == 0 else None)))
+        eng2.run()
+        dt2 = time.perf_counter() - t0
+        st2 = eng2.stats()
+        res2 = [eng2.result(i) for i in ids2]
+        eng2.close()
+        return res2, st2, dt2, streamed
+
+    wd.stage("serve_prefix_leg", 60.0 * max(4, pn))
+    p_res, p_stats, p_dt, p_streamed = serve_prefix_wave(True)
+    c_res, c_stats, c_dt, _ = serve_prefix_wave(False)
+    ids2_np, mask2, p_max2 = _ragged_batch(p_prompts)
+    out2 = generate(model, params, jnp.asarray(ids2_np),
+                    max_new_tokens=p_new, prompt_mask=jnp.asarray(mask2))
+    p_refs = [np.asarray(out2)[i, p_max2:].tolist() for i in range(pn)]
+    bad = [i for i in range(pn) if p_res[i].tokens != p_refs[i]]
+    if bad:
+        return fail(f"shared-prefix serving diverges from generate() "
+                    f"on requests {bad}", "prefix_verify")
+    bad = [i for i in range(pn) if c_res[i].tokens != p_refs[i]]
+    if bad:
+        return fail(f"no-prefix control diverges from generate() on "
+                    f"requests {bad}", "prefix_control_verify")
+    if p_streamed != p_res[0].tokens:
+        return fail("streamed tokens diverge from the request's result",
+                    "prefix_stream_verify")
+    if not (p_stats.get("prefix_hit_rate", 0) > 0
+            and p_stats.get("prefill_tokens_saved", 0) > 0):
+        return fail(
+            f"prefix cache never fired: hit_rate="
+            f"{p_stats.get('prefix_hit_rate')} tokens_saved="
+            f"{p_stats.get('prefill_tokens_saved')}", "prefix_hit_gate")
+    prefix_detail = {
+        "requests": pn,
+        "system_prompts": k_sys,
+        "prefix_hit_rate": round(float(p_stats["prefix_hit_rate"]), 3),
+        "prefill_tokens_saved": int(p_stats["prefill_tokens_saved"]),
+        "prefix_blocks_reused": int(p_stats["prefix_blocks_reused"]),
+        "cow_copies": int(p_stats["cow_copies"]),
+        "prefix_evictions": int(p_stats["prefix_evictions"]),
+        "deadline_misses": int(p_stats["deadline_misses"]),
+        "tokens_per_sec": round(pn * p_new / p_dt, 1),
+        "tokens_per_sec_noprefix": round(pn * p_new / c_dt, 1),
+        "ttft_s_p50": round(float(p_stats["ttft_s_p50"]), 4),
+        "ttft_s_p95": round(float(p_stats["ttft_s_p95"]), 4),
+        "ttft_s_p50_noprefix": round(float(c_stats["ttft_s_p50"]), 4),
+        "ttft_s_p95_noprefix": round(float(c_stats["ttft_s_p95"]), 4),
+        "prefill_batch": min(4, max_slots),
+        "policy": "priority",
+        "streamed_ok": True,
+        "token_identical_to_generate": True,
+    }
 
     n_tokens = sum(len(r.tokens) for r in results)
     tps = n_tokens / dt
@@ -710,6 +845,7 @@ def _bench_serve(args, wd: Watchdog, devs) -> int:
             "queue_wait_s_p50": r4("queue_wait_s_p50"),
             "host_blocked_ms": r4("host_blocked_ms"),
             "token_identical_to_generate": True,
+            "prefix": prefix_detail,
             "warmup_tokens": n_warm_tokens,
             "prompt_lens": lens,
             "max_new_tokens": max_new,

@@ -12,6 +12,10 @@ Run (CPU works; tiny random model by default):
   python examples/serve.py
   python examples/serve.py --requests 12 --max-new 48 --policy sjf
   python examples/serve.py --temperature 0.8 --top-k 40 --top-p 0.95
+  python examples/serve.py --prefix --policy priority   # shared system
+        # prompt workload: prefix-cache hits + batched prefill +
+        # deadline-aware admission, with request 0 streamed token by
+        # token as the lagged ring resolves it
 
 Prints one line per completed request (tokens + its SLO metrics) and
 the aggregate p50/p95 table an operator would alert on.
@@ -33,8 +37,13 @@ def main() -> int:
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--top-k", type=int, default=0)
     p.add_argument("--top-p", type=float, default=1.0)
-    p.add_argument("--policy", default="fcfs", choices=("fcfs", "sjf"))
+    p.add_argument("--policy", default="fcfs",
+                   choices=("fcfs", "sjf", "priority"))
     p.add_argument("--max-slots", type=int, default=4)
+    p.add_argument("--prefix", action="store_true",
+                   help="shared-system-prompt workload through the "
+                        "prefix cache + batched prefill (+ streams "
+                        "request 0's tokens as they resolve)")
     args = p.parse_args()
 
     import numpy as np
@@ -60,22 +69,46 @@ def main() -> int:
     cfg.serve.max_slots = args.max_slots
     cfg.serve.prefill_chunk = 16
     cfg.serve.policy = args.policy
+    if args.prefix:
+        cfg.serve.prefix_cache = True        # shared-prefix KV reuse
+        cfg.serve.prefill_batch = 4          # burst prefill, one dispatch
     engine = ServeEngine(model, params, cfg)
 
-    # prompt lengths spanning >8x, like real traffic
     rng = np.random.default_rng(0)
-    lens = [int(rng.integers(4, 80)) for _ in range(args.requests)]
-    prompts = [rng.integers(1, mc.vocab_size, size=n).tolist()
-               for n in lens]
+    if args.prefix:
+        # real template traffic: every request = one shared system
+        # prompt + a short unique turn.  Request 0 prefills the prefix
+        # cold; everyone after it hits the cache
+        system = rng.integers(1, mc.vocab_size, size=32).tolist()
+        prompts = [system + rng.integers(1, mc.vocab_size,
+                                         size=int(rng.integers(4, 12))
+                                         ).tolist()
+                   for _ in range(args.requests)]
+    else:
+        # prompt lengths spanning >8x, like real traffic
+        lens = [int(rng.integers(4, 80)) for _ in range(args.requests)]
+        prompts = [rng.integers(1, mc.vocab_size, size=n).tolist()
+                   for n in lens]
     req = dict(max_new_tokens=args.max_new, temperature=args.temperature,
                top_k=args.top_k, top_p=args.top_p)
+    if args.policy == "priority":
+        # odd requests are latency-sensitive: higher class, tight ddl
+        prio = lambda i: dict(priority=i % 2,  # noqa: E731
+                              deadline_s=5.0 if i % 2 else 60.0)
+    else:
+        prio = lambda i: {}  # noqa: E731
 
+    on_tok = ((lambda t, ts: print(f"  [stream req 0] token {t}",
+                                   flush=True))
+              if args.prefix else None)
     half = len(prompts) // 2
-    ids = [engine.submit(Request(prompt_ids=pr, seed=i, **req))
+    ids = [engine.submit(Request(prompt_ids=pr, seed=i, **req, **prio(i)),
+                         on_token=on_tok if i == 0 else None)
            for i, pr in enumerate(prompts[:half])]
     for _ in range(4):
         engine.step()                        # first wave is mid-decode…
-    ids += [engine.submit(Request(prompt_ids=pr, seed=half + i, **req))
+    ids += [engine.submit(Request(prompt_ids=pr, seed=half + i, **req,
+                                  **prio(half + i)))
             for i, pr in enumerate(prompts[half:])]   # …second wave lands
     engine.run()
 

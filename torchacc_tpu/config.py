@@ -445,9 +445,30 @@ class ServeConfig:
     # iteration, interleaved with the decode step so a long prompt
     # never stalls in-flight decodes for its whole length.
     prefill_chunk: int = 64
+    # sequences whose chunks prefill TOGETHER in one dispatched program
+    # per iteration (padded to [prefill_batch, prefill_chunk] so the
+    # trace count stays 1).  1 = the PR-6 single-sequence prefill
+    # programs, bitwise-unchanged.  Raise under bursty arrivals so K
+    # waiting prompts cost one dispatch, not K iterations.
+    prefill_batch: int = 1
+    # shared-prefix KV reuse over the paged pool (docs/serving.md
+    # "Prefix cache"): admission maps the longest cached prefix of a
+    # new prompt to existing blocks with zero recompute (refcounted
+    # sharing + copy-on-write on a fully-matched prompt's last block);
+    # refcount-0 blocks park in an LRU and are evicted only under pool
+    # pressure.  OFF = the PR-6 allocator exactly.
+    prefix_cache: bool = False
     # 'fcfs' (arrival order) | 'sjf' (shortest prompt first — better
-    # mean TTFT under mixed lengths, can starve long prompts)
+    # mean TTFT under mixed lengths, can starve long prompts) |
+    # 'priority' (per-request priority class, earliest-deadline-first
+    # within a class, starvation-bounded by priority_aging_s)
     policy: str = "fcfs"
+    # 'priority' policy aging: a queued request's effective class rises
+    # by 1 per priority_aging_s seconds waited, so any request
+    # eventually outranks any fixed class (wait bounded by
+    # (max_class - its_class) * priority_aging_s).  0 disables aging
+    # (pure class order — a saturated high class can starve lower ones).
+    priority_aging_s: float = 30.0
     # engine iterations the host may keep in flight before reading
     # tokens back (the PR-5 lagged-readback ring applied to decode):
     # the sampled-token feedback loop stays ON DEVICE between
@@ -466,8 +487,11 @@ class ServeConfig:
                "null block)")
         _check(self.max_slots >= 1, "serve.max_slots must be >= 1")
         _check(self.prefill_chunk >= 1, "serve.prefill_chunk must be >= 1")
-        _check(self.policy in ("fcfs", "sjf"),
-               f"serve.policy must be fcfs|sjf, got {self.policy}")
+        _check(self.prefill_batch >= 1, "serve.prefill_batch must be >= 1")
+        _check(self.policy in ("fcfs", "sjf", "priority"),
+               f"serve.policy must be fcfs|sjf|priority, got {self.policy}")
+        _check(self.priority_aging_s >= 0,
+               "serve.priority_aging_s must be >= 0")
         _check(self.decode_depth >= 1, "serve.decode_depth must be >= 1")
         _check(self.max_new_tokens >= 1, "serve.max_new_tokens must be >= 1")
         _check(self.max_queue >= 1, "serve.max_queue must be >= 1")

@@ -27,12 +27,18 @@ handoff" section for the fit↔serve loop).
 """
 
 from torchacc_tpu.serve.engine import Request, RequestResult, ServeEngine
-from torchacc_tpu.serve.kv_cache import BlockPool, blocks_needed, make_pools
+from torchacc_tpu.serve.kv_cache import (
+    BlockPool,
+    PrefixIndex,
+    blocks_needed,
+    make_pools,
+)
 from torchacc_tpu.serve.scheduler import PagedDecoder, Scheduler
 
 __all__ = [
     "BlockPool",
     "PagedDecoder",
+    "PrefixIndex",
     "Request",
     "RequestResult",
     "Scheduler",

@@ -13,7 +13,15 @@ pool has headroom for its WHOLE reservation (prompt + max_new +
 in-flight overhang, scheduler.blocks_for) — a sequence admitted is a
 sequence that can always finish; there is no mid-decode OOM or
 preemption path to handle.  Until then it waits in the queue
-(``serve.policy``: 'fcfs' arrival order, 'sjf' shortest prompt first).
+(``serve.policy``: 'fcfs' arrival order, 'sjf' shortest prompt first,
+'priority' per-request class + earliest-deadline-first within a class,
+starvation-bounded by ``serve.priority_aging_s``).
+
+Streaming: ``submit(req, on_token=...)`` invokes the callback as the
+lagged decode ring resolves each token, and ``stream(rid)`` is the
+pull-style generator over the same seam — tokens surface at most
+``decode_depth - 1`` engine iterations after the device produced them
+(the documented readback lag; docs/serving.md "Streaming").
 
 Per-request SLO metrics (each ``RequestResult``): queue wait, TTFT
 (submit -> first token RESOLVED on the host — readback lag included,
@@ -34,7 +42,7 @@ from typing import Any, Dict, List, Optional, Sequence as Seq
 import numpy as np
 
 from torchacc_tpu.config import Config
-from torchacc_tpu.serve.scheduler import Scheduler, Sequence
+from torchacc_tpu.serve.scheduler import Scheduler, Sequence, priority_key
 from torchacc_tpu.utils.logger import logger
 from torchacc_tpu.utils.metrics import BlockedMeter, counters, open_metrics
 
@@ -50,6 +58,13 @@ class Request:
     top_p: float = 1.0
     eos_id: Optional[int] = None
     seed: int = 0
+    # 'priority' policy inputs (ignored under fcfs/sjf): higher
+    # priority = more urgent; deadline_s is seconds from submit() by
+    # which the request wants to FINISH — within a priority class the
+    # earliest deadline admits first (EDF), and stats()/metrics count
+    # the misses.  Neither field drops or preempts work.
+    priority: int = 0
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -65,10 +80,33 @@ class RequestResult:
     total_s: float                           # submit -> finish
     token_latencies_s: List[float]           # inter-token gaps
     tokens_per_sec: float
+    # prompt tokens served from the prefix cache (0 = cold / cache off)
+    cached_prompt_tokens: int = 0
+    # finish beat the request's deadline (None = no deadline given)
+    deadline_met: Optional[bool] = None
 
 
 def _percentile(xs: List[float], q: float) -> float:
     return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
+
+_tpu_block_size_warned = False
+
+
+def _warn_tpu_block_size(block_size: int, backend: str) -> None:
+    """Warn once per process when serving on a real TPU with a block
+    size the Pallas paged-attention kernel cannot tile on the 128-lane
+    dim (docs/serving.md "ServeConfig tuning")."""
+    global _tpu_block_size_warned
+    if backend != "tpu" or block_size % 128 == 0 or _tpu_block_size_warned:
+        return
+    _tpu_block_size_warned = True
+    logger.warning(
+        f"serve.block_size={block_size} is not a multiple of 128 on a "
+        f"TPU backend: the Pallas paged-attention kernel tiles the "
+        f"block (lane) dim at 128, so this forces the slower jnp "
+        f"gather fallback / padded kernel blocks.  Use 128 (or a "
+        f"multiple) on real TPU; small sizes are for CPU tests.")
 
 
 class ServeEngine:
@@ -89,9 +127,11 @@ class ServeEngine:
 
     def __init__(self, model, params, config: Optional[Config] = None,
                  mesh=None, metrics_dir: Optional[str] = None):
+        import jax
         cfg = getattr(model, "cfg", model)
         config = config or Config()
         config.serve.validate()
+        _warn_tpu_block_size(config.serve.block_size, jax.default_backend())
         self.cfg = cfg
         self.config = config
         self.mesh = mesh
@@ -106,11 +146,14 @@ class ServeEngine:
         self._metrics = open_metrics(metrics_dir)
         self._completed = 0
         self._agg = self._fresh_agg()
+        self._evict_base = 0                 # pool.evictions at window start
 
     @staticmethod
     def _fresh_agg() -> Dict:
         return {"ttft": [], "waits": [], "gaps": [], "tokens": 0,
-                "requests": 0, "t0": None, "t1": None}
+                "requests": 0, "t0": None, "t1": None,
+                "prefix_hits": 0, "cached_tokens": 0, "shared_blocks": 0,
+                "cow": 0, "deadline_total": 0, "deadline_miss": 0}
 
     def _mesh_ctx(self):
         import contextlib
@@ -157,7 +200,15 @@ class ServeEngine:
         two models' logits into one stream, so occupied decode slots
         raise instead.  In-flight ring entries are resolved first —
         they were computed under the old weights and their tokens are
-        still valid."""
+        still valid.
+
+        The prefix cache is FLUSHED before the swap: cached blocks hold
+        k/v computed under the old weights, and a prefix hit after the
+        handoff would splice stale keys/values under every new-weight
+        decode step — a correctness bug, not a perf detail
+        (regression-tested: a post-handoff warm-prefix request is
+        token-identical to a cold one).  ``from_train_state`` builds a
+        fresh engine, so its cache starts empty by construction."""
         self.scheduler.drain()
         self._drain_events()
         if self.scheduler.busy():
@@ -167,14 +218,26 @@ class ServeEngine:
                 f"cannot swap weights while sequences {busy} occupy "
                 f"decode slots — run() the engine to completion (or let "
                 f"them finish) first")
+        flushed = self.scheduler.flush_prefix_cache()
+        if flushed:
+            logger.info(
+                f"prefix cache flushed on weight swap ({flushed} cached "
+                f"blocks dropped: k/v banked under the old weights must "
+                f"never serve the new ones)")
         self.scheduler.params = params
 
     # -- submission ---------------------------------------------------------
 
-    def submit(self, req: Request) -> int:
+    def submit(self, req: Request, on_token=None) -> int:
         """Queue a request; returns its id.  Raises when the request
         can NEVER be served (pool too small, position table exceeded)
-        or the queue is full — fail at the front door, not mid-decode."""
+        or the queue is full — fail at the front door, not mid-decode.
+
+        ``on_token``: optional ``f(token: int, t_monotonic: float)``
+        streaming callback, invoked as the lagged ring resolves each
+        token (<= ``decode_depth - 1`` iterations after dispatch; never
+        a post-finish garbage token).  Runs inside the engine loop —
+        keep it cheap, hand off to a queue/socket for real delivery."""
         prompt = np.asarray(list(req.prompt_ids), np.int32)
         if prompt.ndim != 1 or prompt.shape[0] < 1:
             raise ValueError("prompt_ids must be a non-empty 1-D sequence")
@@ -185,10 +248,15 @@ class ServeEngine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new} (a decode "
                 f"slot always generates at least one token)")
+        if req.deadline_s is not None and req.deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 seconds from submit, got "
+                f"{req.deadline_s}")
         serve = self.config.serve
         seq = Sequence(sid=self._next_id, prompt=prompt, max_new=max_new,
                        temperature=req.temperature, top_k=req.top_k,
-                       top_p=req.top_p, eos_id=req.eos_id, seed=req.seed)
+                       top_p=req.top_p, eos_id=req.eos_id, seed=req.seed,
+                       priority=req.priority, on_token=on_token)
         need = self.scheduler.blocks_for(seq)
         if need > self.scheduler.max_blocks_per_seq:
             raise ValueError(
@@ -208,6 +276,8 @@ class ServeEngine:
                 f"admission queue full ({serve.max_queue}); shed load "
                 f"upstream or raise serve.max_queue")
         seq.t_submit = time.monotonic()
+        if req.deadline_s is not None:
+            seq.deadline = seq.t_submit + req.deadline_s
         self._next_id += 1
         self._all[seq.sid] = seq
         self._queue.append(seq)
@@ -218,44 +288,60 @@ class ServeEngine:
 
     def _admit(self) -> None:
         """Move queue entries into free slots while headroom lasts.
+        'fcfs' preserves arrival order (no request is skipped past);
         'sjf' reorders by prompt length (better mean TTFT under mixed
-        lengths); 'fcfs' preserves arrival order.  Admission stops at
-        the first request that does not fit — sjf may skip past it
-        only when a shorter request fits in the remaining headroom."""
+        lengths); 'priority' orders by effective class then deadline
+        (see :meth:`_priority_key`) — both may skip a request that does
+        not fit when a later one fits the remaining headroom.
+        ``scheduler.admit`` is all-or-nothing with no side effects on
+        failure, so attempting it IS the fit check (and the only one
+        that sees prefix-cache hits, which shrink the fresh-block
+        need)."""
         if not self._queue or self.scheduler.free_slot() is None:
             # at capacity: don't copy/sort the (possibly thousands
             # deep) queue on the per-token hot loop when nothing can
             # possibly admit
             return
         if self.config.serve.policy == "fcfs":
-            # fcfs admits only from the head — O(1) early exit
-            if not self.scheduler.can_admit(self._queue[0]):
-                return
-        elif not self.scheduler.pool.can_alloc(
-                min(self.scheduler.blocks_for(s) for s in self._queue)):
-            # sjf: one O(Q) min beats the O(Q log Q) sort + scan when
-            # even the cheapest reservation cannot fit
+            # fcfs admits only from the head — stop at the first miss
+            while self._queue and self.scheduler.admit(self._queue[0]):
+                self._queue.popleft()
+                counters.inc("serve_requests_admitted")
+            return
+        # sjf/priority: one O(Q) min beats the O(Q log Q) sort + scan
+        # when even the cheapest BEST-CASE reservation (full prefix
+        # hit) cannot fit
+        if not self.scheduler.pool.can_alloc(
+                min(self.scheduler.min_fresh_blocks(s)
+                    for s in self._queue)):
             return
         order = list(self._queue)
         if self.config.serve.policy == "sjf":
             order.sort(key=lambda s: (s.prompt_len, s.sid))
+        else:
+            # scheduler.priority_key is the ONE home for the effective-
+            # class/EDF/aging semantics (prefill ordering uses it too)
+            now = time.monotonic()
+            aging = self.config.serve.priority_aging_s
+            order.sort(key=lambda s: priority_key(s, now, aging))
         admitted = []
         for seq in order:
-            if not self.scheduler.can_admit(seq):
-                if self.config.serve.policy == "fcfs":
-                    break
-                continue
-            self.scheduler.admit(seq)
-            admitted.append(seq)
-            counters.inc("serve_requests_admitted")
+            if self.scheduler.free_slot() is None:
+                break
+            if self.scheduler.admit(seq):
+                admitted.append(seq)
+                counters.inc("serve_requests_admitted")
         for seq in admitted:
             self._queue.remove(seq)
 
     def step(self) -> bool:
         """One engine iteration (admission + scheduler.step + completion
         accounting).  Returns True while there is work anywhere."""
-        self._admit()
         with self._mesh_ctx():
+            # admission inside the mesh context too: a fully-cached
+            # prompt's admit dispatches the copy-on-write program over
+            # the (possibly tp-sharded) pools
+            self._admit()
             self.scheduler.step()
         self._drain_events()
         # scheduler.busy() == False already implies the ring drained
@@ -289,6 +375,45 @@ class ServeEngine:
         self.run()
         return [self.result(i) for i in ids]
 
+    def stream(self, request_id: int):
+        """Yield request ``request_id``'s tokens as the lagged decode
+        ring resolves them, driving the engine loop in between (every
+        other queued/running request progresses too — interleave
+        multiple ``stream()`` generators or mix with :meth:`step` at
+        will).  Each token surfaces at most ``decode_depth - 1`` engine
+        iterations after the device produced it — the documented
+        readback lag; resolution timestamps feed the same TTFT /
+        per-token-gap SLO metrics as non-streamed requests.  Returns
+        when the request finishes; its :class:`RequestResult` stays
+        available via :meth:`result`.  For push-style delivery use
+        ``submit(req, on_token=...)`` instead."""
+        seq = self._all[request_id]
+        sent = 0
+        idle = 0
+        while True:
+            if sent < len(seq.out_tokens):
+                yield seq.out_tokens[sent]
+                sent += 1
+                continue
+            if seq.finished:
+                return
+            if not self.step():
+                raise RuntimeError(
+                    f"request {request_id} streamed {sent} tokens but "
+                    f"the engine ran out of work before it finished")
+            # mirror run()'s no-progress defense: queued work that can
+            # never admit while nothing runs is a config error, not a
+            # reason to spin forever
+            if self._queue and not self.scheduler.busy():
+                idle += 1
+                if idle > 3:
+                    raise RuntimeError(
+                        "serving stalled: queued requests cannot be "
+                        "admitted and no sequence is running (pool "
+                        "fragmentation should be impossible — report)")
+            else:
+                idle = 0
+
     # -- results / metrics --------------------------------------------------
 
     def _drain_events(self) -> None:
@@ -317,15 +442,27 @@ class ServeEngine:
                        else min(a["t0"], seq.t_submit))
             a["t1"] = (seq.t_finish if a["t1"] is None
                        else max(a["t1"], seq.t_finish))
+            a["prefix_hits"] += 1 if seq.cached_tokens else 0
+            a["cached_tokens"] += seq.cached_tokens
+            a["shared_blocks"] += seq.shared_blocks
+            a["cow"] += 1 if seq.cow else 0
+            if seq.deadline != float("inf"):
+                a["deadline_total"] += 1
+                a["deadline_miss"] += (1 if seq.t_finish > seq.deadline
+                                       else 0)
             if self._metrics is not None:
                 r = self.result(seq.sid)
-                self._metrics.log(self._completed, {
+                rec = {
                     "serve/ttft_s": r.ttft_s,
                     "serve/queue_wait_s": r.queue_wait_s,
                     "serve/total_s": r.total_s,
                     "serve/tokens": len(r.tokens),
                     "serve/tokens_per_sec": r.tokens_per_sec,
-                })
+                    "serve/cached_prompt_tokens": r.cached_prompt_tokens,
+                }
+                if r.deadline_met is not None:
+                    rec["serve/deadline_met"] = float(r.deadline_met)
+                self._metrics.log(self._completed, rec)
 
     def result(self, request_id: int, pop: bool = False) -> RequestResult:
         """The finished request's tokens + SLO metrics.  ``pop=True``
@@ -347,6 +484,9 @@ class ServeEngine:
             total_s=total,
             token_latencies_s=gaps,
             tokens_per_sec=len(seq.out_tokens) / total,
+            cached_prompt_tokens=seq.cached_tokens,
+            deadline_met=(None if seq.deadline == float("inf")
+                          else bool(seq.t_finish <= seq.deadline)),
         )
         if pop:
             del self._all[request_id]
@@ -369,6 +509,7 @@ class ServeEngine:
         a = self._agg
         if not a["requests"]:
             return {"requests": 0}
+        pool = self.scheduler.pool
         return {
             "requests": a["requests"],
             "tokens": a["tokens"],
@@ -384,6 +525,20 @@ class ServeEngine:
             "queue_wait_s_p95": _percentile(a["waits"], 95),
             "per_token_s_p50": _percentile(a["gaps"], 50),
             "per_token_s_p95": _percentile(a["gaps"], 95),
+            # prefix cache (docs/serving.md "Prefix cache"): all window
+            # counts accrue at request COMPLETION except evictions
+            # (pool lifetime delta since the window opened)
+            "prefix_hits": a["prefix_hits"],
+            "prefix_hit_rate": a["prefix_hits"] / a["requests"],
+            "prefill_tokens_saved": a["cached_tokens"],
+            "prefix_blocks_reused": a["shared_blocks"],
+            "cow_copies": a["cow"],
+            "prefix_evictions": pool.evictions - self._evict_base,
+            "prefix_cached_blocks": pool.cached,
+            # 'priority' policy deadline accounting (requests that set
+            # deadline_s; misses finished after their deadline)
+            "deadline_requests": a["deadline_total"],
+            "deadline_misses": a["deadline_miss"],
         }
 
     def reset_stats(self) -> None:
@@ -391,6 +546,7 @@ class ServeEngine:
         meter — call after warmup so compile waits and warmup requests
         never pollute the reported SLOs (bench.py --serve does)."""
         self._agg = self._fresh_agg()
+        self._evict_base = self.scheduler.pool.evictions
         self.blocked.take_ms()
 
     def close(self) -> None:

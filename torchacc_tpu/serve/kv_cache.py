@@ -16,6 +16,18 @@ tail tokens) write their garbage k/v there, so the jitted step needs
 no write masking — the standard trick.  It is never handed out by the
 allocator.
 
+Prefix sharing (``serve.prefix_cache``): blocks are REFCOUNTED, and a
+:class:`PrefixIndex` maps a hash chain over each FULL block of prompt
+tokens (``key_i = blake2b(key_{i-1} || tokens[i*bs:(i+1)*bs])`` —
+radix-style: position and content are both in the chain) to the pool
+block holding that span's k/v.  A new prompt's longest cached prefix
+resolves to existing blocks with zero recompute; a block whose last
+reference drops moves to a CACHED LRU list instead of the free list,
+where it stays matchable until the allocator reclaims it under
+pressure.  Eviction only ever takes refcount-0 cached blocks, so the
+whole-reservation admission guarantee survives: blocks owned by an
+admitted sequence are untouchable until that sequence frees them.
+
 The allocator is deliberately host-side and synchronous: allocation
 decisions happen at admission time (serve/engine.py), outside the
 jitted hot path, exactly like the trainer's host/device split
@@ -24,9 +36,14 @@ jitted hot path, exactly like the trainer's host/device split
 
 from __future__ import annotations
 
-from typing import List, Optional
+import collections
+import hashlib
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
+import numpy as np
+
+from torchacc_tpu.utils.metrics import counters
 
 
 def blocks_needed(num_tokens: int, block_size: int) -> int:
@@ -34,55 +51,212 @@ def blocks_needed(num_tokens: int, block_size: int) -> int:
     return -(-max(num_tokens, 0) // block_size)
 
 
-class BlockPool:
-    """Free-list allocator over pool blocks 1..num_blocks-1.
+class PrefixIndex:
+    """Token-hash prefix index over pool blocks (radix-style chain).
 
-    Invariants (tested in tests/test_serving.py):
-    - block 0 (the null block) is never allocated;
-    - a block is owned by at most one caller at a time (no aliasing);
-    - ``free`` of a block not currently allocated raises (double-free /
-      foreign-block detection);
-    - ``available + len(allocated) == num_blocks - 1`` always (no leak).
+    Each FULL block of a prompt gets a chain key: the blake2b digest of
+    the parent block's key concatenated with this block's token ids.
+    Chaining makes the key encode the block's absolute position AND the
+    entire token prefix before it, so two entries collide only when the
+    whole prefix up to and including the block is token-identical —
+    exactly the condition under which the banked k/v is reusable
+    (deterministic forward, same weights; serve/engine.load_params
+    flushes the index on weight swaps).  16-byte digests make an
+    accidental collision astronomically unlikely (~2^-128); there is no
+    token-level compare on hit, which is the standard vLLM trade.
+
+    The index never owns pool headroom: entries point at blocks that
+    are either ALLOCATED (refcount >= 1, some live sequence reads them)
+    or CACHED (refcount 0, parked in the pool's LRU).  ``forget`` is
+    called by the pool when it evicts a cached block.
     """
 
-    def __init__(self, num_blocks: int):
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._by_key: Dict[bytes, int] = {}
+        self._key_of: Dict[int, bytes] = {}
+
+    def __len__(self) -> int:
+        return len(self._by_key)
+
+    def keys(self, prompt: np.ndarray) -> List[bytes]:
+        """Chain keys for every FULL block of ``prompt`` (a prompt
+        shorter than one block has no keyable span)."""
+        bs = self.block_size
+        toks = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        out: List[bytes] = []
+        parent = b""
+        for i in range(int(toks.shape[0]) // bs):
+            h = hashlib.blake2b(parent, digest_size=16)
+            h.update(toks[i * bs:(i + 1) * bs].tobytes())
+            parent = h.digest()
+            out.append(parent)
+        return out
+
+    def match(self, keys: List[bytes]) -> List[int]:
+        """The longest resident chain: blocks for keys[0..m) where every
+        key hits.  Stops at the first miss — a surviving child whose
+        parent was evicted is unreachable (and will age out of the LRU)
+        but never wrongly matched."""
+        blocks: List[int] = []
+        for k in keys:
+            b = self._by_key.get(k)
+            if b is None:
+                break
+            blocks.append(b)
+        return blocks
+
+    def register(self, key: bytes, block: int) -> bool:
+        """Map ``key`` -> ``block``; no-op (False) when the key is
+        already mapped (first writer wins — concurrent identical
+        prompts keep the earlier block, the later one stays private)
+        or the block already carries a key."""
+        if key in self._by_key or block in self._key_of:
+            return False
+        self._by_key[key] = block
+        self._key_of[block] = key
+        return True
+
+    def owns(self, block: int) -> bool:
+        return block in self._key_of
+
+    def forget(self, block: int) -> None:
+        k = self._key_of.pop(block, None)
+        if k is not None:
+            del self._by_key[k]
+
+    def clear(self) -> int:
+        """Drop every entry (weight swap / flush); returns the count."""
+        n = len(self._by_key)
+        self._by_key.clear()
+        self._key_of.clear()
+        return n
+
+
+class BlockPool:
+    """Refcounted free-list allocator over pool blocks 1..num_blocks-1.
+
+    A block is in exactly one of three states:
+
+    - FREE: on the free list, content garbage;
+    - ALLOCATED: refcount >= 1 — handed to one ``alloc`` caller and
+      possibly shared into other sequences' tables via :meth:`share`;
+    - CACHED: refcount 0 but still holding reusable prefix k/v
+      (``index.owns`` it), parked in an LRU from which :meth:`alloc`
+      evicts oldest-first when the free list runs dry.
+
+    Invariants (tested in tests/test_serving.py + test_prefix_cache.py):
+    - block 0 (the null block) is never handed out;
+    - ``free`` of a block with no outstanding reference raises
+      (double-free / foreign-block detection — releasing a SHARED block
+      once per sharer is legal, once more raises);
+    - eviction only ever takes refcount-0 cached blocks, so an admitted
+      sequence's reservation can never be reclaimed under it;
+    - ``available + in_use == num_blocks - 1`` always (no leak;
+      ``available`` counts free + cached since both are allocatable).
+    """
+
+    def __init__(self, num_blocks: int, index: Optional[PrefixIndex] = None):
         if num_blocks < 2:
             raise ValueError(
                 f"need >= 2 blocks (block 0 is reserved), got {num_blocks}")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._allocated: set = set()
+        self._ref: Dict[int, int] = {}
+        self._cached: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._index = index
+        self.evictions = 0
 
     @property
     def available(self) -> int:
-        return len(self._free)
+        """Blocks an ``alloc`` could grant: free + evictable cached."""
+        return len(self._free) + len(self._cached)
 
     @property
     def in_use(self) -> int:
-        return len(self._allocated)
+        return len(self._ref)
+
+    @property
+    def cached(self) -> int:
+        return len(self._cached)
+
+    def refcount(self, block: int) -> int:
+        return self._ref.get(block, 0)
 
     def can_alloc(self, n: int) -> bool:
-        return n <= len(self._free)
+        return n <= self.available
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` blocks, or None when the pool lacks headroom (the
-        admission-control signal — never a partial grant)."""
+        admission-control signal — never a partial grant).  Evicts
+        cached refcount-0 blocks oldest-first when the free list alone
+        cannot cover the grant."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if n > len(self._free):
+        if n > self.available:
             return None
+        while len(self._free) < n:
+            b, _ = self._cached.popitem(last=False)      # LRU: oldest out
+            if self._index is not None:
+                self._index.forget(b)
+            self.evictions += 1
+            counters.inc("prefix_evictions")
+            self._free.append(b)
         blocks = [self._free.pop() for _ in range(n)]
-        self._allocated.update(blocks)
+        for b in blocks:
+            self._ref[b] = 1
         return blocks
 
+    def share(self, block: int) -> None:
+        """Take one more reference on an allocated block, or revive a
+        cached one (prefix hit) — the block leaves the LRU and cannot
+        be evicted until every reference drops."""
+        if block in self._ref:
+            self._ref[block] += 1
+        elif block in self._cached:
+            del self._cached[block]
+            self._ref[block] = 1
+        else:
+            raise ValueError(
+                f"share of block {block} which is neither allocated nor "
+                f"cached (stale prefix-index entry, or a block this pool "
+                f"never handed out)")
+
     def free(self, blocks: List[int]) -> None:
+        """Release one reference per listed block.  The LAST release
+        parks a prefix-indexed block in the cached LRU (most-recent
+        end) instead of the free list, keeping its k/v matchable."""
         for b in blocks:
-            if b not in self._allocated:
+            r = self._ref.get(b)
+            if r is None:
                 raise ValueError(
                     f"free of block {b} which is not allocated (double "
                     f"free, or a block this pool never handed out)")
-            self._allocated.remove(b)
+            if r > 1:
+                self._ref[b] = r - 1
+                continue
+            del self._ref[b]
+            if self._index is not None and self._index.owns(b):
+                self._cached[b] = None
+            else:
+                self._free.append(b)
+
+    def flush_cached(self) -> int:
+        """Drop every cached refcount-0 block (and its index entries) —
+        the weight-swap flush: banked k/v under old weights must never
+        match a prompt served under new ones.  Blocks still referenced
+        by live sequences are untouched (the caller guarantees there
+        are none — serve/engine.load_params requires an idle engine)."""
+        n = len(self._cached)
+        while self._cached:
+            b, _ = self._cached.popitem(last=False)
+            if self._index is not None:
+                self._index.forget(b)
             self._free.append(b)
+        if self._index is not None:
+            self._index.clear()
+        return n
 
 
 def make_pools(model_cfg, serve_cfg, dtype=None):

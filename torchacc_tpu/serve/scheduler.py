@@ -12,10 +12,31 @@ block free/reuse.  Three compiled programs cover any request mix:
   device array — the token feedback loop never touches the host.
 - ``prefill_chunk``: ``serve.prefill_chunk`` tokens of ONE sequence
   (padded; the pad tail writes to the null block), interleaved with
-  decode so a long prompt never stalls in-flight decodes.
+  decode so a long prompt never stalls in-flight decodes.  With
+  ``serve.prefill_batch > 1`` one iteration instead prefills up to
+  that many chunks from DISTINCT waiting sequences in a single
+  dispatched program (rows padded to the [prefill_batch,
+  prefill_chunk] geometry — trace count stays 1; the head projects
+  only each row's last valid token, the one row whose logits anyone
+  reads).
 - ``sample_first`` / ``set_slot``: sample the first token from the
   final prefill chunk's logits and splice it into the decode carry —
   tiny jitted ops, no readback.
+- ``cow``: copy one pool block's k/v to another across all layers —
+  the copy-on-write step behind a fully-cached prompt (see admit()).
+
+Prefix cache (``serve.prefix_cache`` — kv_cache.PrefixIndex): admit()
+maps the longest token-hash-chain match of a new prompt onto resident
+blocks (refcount++ — zero recompute, zero copies) and starts prefill
+past them; when the match covers the WHOLE prompt, the last matched
+block is copy-on-written into a private block and only the final
+prompt token re-runs (its logits are needed to sample the first output
+token; its k/v write lands in the private copy, never the shared
+block), so a warm prompt's TTFT is one final-chunk dispatch.  Blocks
+register in the index as their prefill chunk completes, which means a
+live sequence's prompt blocks are matchable immediately — concurrent
+requests behind the same system prompt share from the first one that
+prefilled it, not the first one that finished.
 
 Host reads happen only at lag ``serve.decode_depth - 1`` through the
 in-flight ring (the PR-5 lagged-readback pattern): iteration i's
@@ -47,7 +68,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchacc_tpu.ops.paged_attention import paged_attention
-from torchacc_tpu.serve.kv_cache import BlockPool, blocks_needed, make_pools
+from torchacc_tpu.serve.kv_cache import (
+    BlockPool,
+    PrefixIndex,
+    blocks_needed,
+    make_pools,
+)
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import counters
 
 
 # every ModelConfig field the paged forward (_layer/_forward) has been
@@ -154,8 +182,18 @@ class PagedDecoder:
         # the full-chunk head so first-token numerics are unchanged
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(1,),
                                 static_argnums=(6,))
+        # batched multi-sequence prefill: ONE trace for any mix of
+        # final/non-final/padded rows (the head projects only the
+        # gathered last-valid row of each sequence — [PB, H] x [H, V],
+        # a decode-step-sized matmul, so there is no non-final trace to
+        # skip it)
+        self._prefill_batch = jax.jit(self._prefill_batch_impl,
+                                      donate_argnums=(1,))
         self._sample_first = jax.jit(self._sample_first_impl)
         self._set_slot = jax.jit(self._set_slot_impl, donate_argnums=(0,))
+        # copy-on-write: clone one pool block across all layers (the
+        # fully-cached-prompt path in Scheduler.admit)
+        self._cow = jax.jit(self._cow_impl, donate_argnums=(0,))
 
     # -- model forward ------------------------------------------------------
 
@@ -355,6 +393,46 @@ class PagedDecoder:
             axis=0)[0]                                             # [V]
         return pools, last
 
+    def _prefill_batch_impl(self, params, pools, table_rows, t0s, tokens,
+                            n_valids):
+        """One chunk each of up to ``prefill_batch`` DISTINCT sequences
+        in one program: ``table_rows`` [PB, MB], ``t0s``/``n_valids``
+        [PB] (0 valid = padded row: runs on the null block, output
+        discarded), ``tokens`` [PB, C].  Returns the last valid row's
+        logits per sequence [PB, V] — the only rows anyone reads (final
+        rows sample their first token from them; non-final and padded
+        rows are ignored by the host), so the head is a [PB, H] x
+        [H, V] matmul, not the full-chunk head, and final-vs-non-final
+        needs no static flag: trace count is 1."""
+        bs, c = self.block_size, self.chunk
+        i = jnp.arange(c, dtype=jnp.int32)[None, :]              # [1, C]
+        valid = i < n_valids[:, None]                            # [PB, C]
+        pos = t0s[:, None] + i
+        last_pos = jnp.maximum(t0s + n_valids - 1, 0)[:, None]
+        positions = jnp.where(valid, pos, last_pos)              # [PB, C]
+        blk = jnp.where(
+            valid, jnp.take_along_axis(table_rows, pos // bs, axis=1), 0)
+        off = jnp.where(valid, pos % bs, 0)
+        ctx = t0s + n_valids                                     # [PB]
+        pools, x = self._forward(params, pools, tokens, positions,
+                                 table_rows, ctx, blk, off)
+        from torchacc_tpu.models.transformer import head_logits
+        last = jnp.take_along_axis(
+            x, jnp.maximum(n_valids - 1, 0)[:, None, None], axis=1)
+        logits = head_logits(self.cfg, params, last)             # [PB, 1, V]
+        return pools, logits[:, 0]
+
+    def _cow_impl(self, pools, src, dst):
+        """Copy block ``src``'s k/v into block ``dst`` across every
+        layer — the copy-on-write behind a fully-cached prompt: the
+        final prompt token must re-run (its logits seed the first
+        sampled token) and its k/v write needs a block this sequence
+        owns; everything before it stays shared."""
+        kp, vp = pools
+        kp = kp.at[:, dst].set(kp[:, src])
+        vp = vp.at[:, dst].set(vp[:, src])
+        return kp, vp
+
     def _sample_first_impl(self, logits, key, temp, top_k, top_p):
         return self._sample_slots(logits[None], key[None], temp[None],
                                   top_k[None], top_p[None])[0]
@@ -376,6 +454,15 @@ class Sequence:
     top_p: float = 1.0
     eos_id: Optional[int] = None
     seed: int = 0
+    # 'priority' policy inputs: higher priority = more urgent;
+    # deadline is ABSOLUTE host monotonic time (engine.submit converts
+    # the request's relative deadline_s), inf = none
+    priority: int = 0
+    deadline: float = float("inf")
+    # streaming: called as on_token(token, t_monotonic) when the lagged
+    # ring resolves each token (<= decode_depth - 1 iterations after
+    # dispatch) — engine.submit(..., on_token=...) plumbs it here
+    on_token: Any = None
     # runtime
     slot: int = -1
     blocks: List[int] = dataclasses.field(default_factory=list)
@@ -384,6 +471,12 @@ class Sequence:
     finished: bool = False
     finish_reason: str = ""
     key: Any = None                          # host-held PRNG key
+    # prefix-cache runtime (admit() fills these)
+    block_keys: Optional[List[bytes]] = None  # chain key per full block
+    registered: int = 0                      # prompt blocks indexed so far
+    cached_tokens: int = 0                   # prompt tokens NOT recomputed
+    shared_blocks: int = 0                   # blocks reused via refcount
+    cow: bool = False                        # fully-cached prompt path
     # metrics timestamps (host wall clock; engine fills t_submit)
     t_submit: float = 0.0
     t_admit: float = 0.0
@@ -394,6 +487,18 @@ class Sequence:
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
+
+
+def priority_key(seq: "Sequence", now: float, aging_s: float):
+    """'priority' policy ordering — the ONE home for the semantics, so
+    admission (engine._admit) and prefill order (scheduler.
+    _prefill_candidates) can never drift apart: effective class
+    descending (declared class + 1 per ``aging_s`` seconds waited — the
+    starvation bound: any request eventually outranks any fixed class),
+    then earliest deadline, then arrival."""
+    eff = seq.priority + (int((now - seq.t_submit) / aging_s)
+                          if aging_s > 0 else 0)
+    return (-eff, seq.deadline, seq.sid)
 
 
 @dataclasses.dataclass
@@ -423,7 +528,12 @@ class Scheduler:
         self.params = params
         self.blocked = blocked               # optional BlockedMeter
         self.decoder = PagedDecoder(model_cfg, serve_cfg, attention_impl)
-        self.pool = BlockPool(serve_cfg.num_blocks)
+        # shared-prefix KV reuse: the index maps token-hash chains to
+        # resident blocks; the pool refcounts them and parks refcount-0
+        # indexed blocks in its cached LRU instead of freeing
+        self.prefix = (PrefixIndex(serve_cfg.block_size)
+                       if serve_cfg.prefix_cache else None)
+        self.pool = BlockPool(serve_cfg.num_blocks, index=self.prefix)
         self.k_pools, self.v_pools = make_pools(model_cfg, serve_cfg)
         s = serve_cfg.max_slots
         # table width bounds the LONGEST admissible sequence, not the
@@ -481,26 +591,92 @@ class Scheduler:
                 return i
         return None
 
+    def min_fresh_blocks(self, seq: Sequence) -> int:
+        """Cheapest POSSIBLE fresh-block need (best case: every full
+        prompt block is a prefix hit) — the engine's O(Q) admission
+        early-exit bound.  No hashing, so it may be optimistic; only
+        ``admit`` itself is authoritative."""
+        total = self.blocks_for(seq)
+        if self.prefix is None:
+            return total
+        return max(1, total - seq.prompt_len // self.serve_cfg.block_size)
+
     def can_admit(self, seq: Sequence) -> bool:
         return (self.free_slot() is not None
                 and self.pool.can_alloc(self.blocks_for(seq)))
 
     def admit(self, seq: Sequence) -> bool:
+        """Give ``seq`` a decode slot + its whole block reservation, or
+        return False with NO state change (all-or-nothing; the engine
+        retries next iteration).  With the prefix cache on, the longest
+        token-hash-chain match replaces that many fresh blocks with
+        refcounted shared ones and prefill starts past them."""
         slot = self.free_slot()
         if slot is None:
             return False
-        blocks = self.pool.alloc(self.blocks_for(seq))
-        if blocks is None:
+        total = self.blocks_for(seq)
+        shared: List[int] = []
+        cow_src: Optional[int] = None
+        if self.prefix is not None:
+            # hash once, not per attempt: a queued request re-attempts
+            # admission every engine iteration while it waits for blocks
+            if seq.block_keys is None:
+                seq.block_keys = self.prefix.keys(seq.prompt)
+            shared = self.prefix.match(seq.block_keys)
+            if shared and (len(shared) * self.serve_cfg.block_size
+                           >= seq.prompt_len):
+                # fully cached prompt: the final token must still run
+                # (its logits seed the first sampled token) and its k/v
+                # write needs a block this sequence owns — copy-on-write
+                # the last matched block, share the rest
+                cow_src = shared.pop()
+        # pin the match BEFORE alloc: alloc may evict cached refcount-0
+        # blocks to cover the grant, and it must not reclaim the match
+        for b in shared:
+            self.pool.share(b)
+        if cow_src is not None:
+            self.pool.share(cow_src)
+        fresh = self.pool.alloc(total - len(shared))
+        if fresh is None:
+            # roll back the pins — admission never partially grants
+            self.pool.free(shared)
+            if cow_src is not None:
+                self.pool.free([cow_src])
             return False
+        blocks = shared + fresh
         seq.slot = slot
         seq.blocks = blocks
-        seq.prefilled = 0
         seq.key = jax.random.PRNGKey(seq.seed)
         seq.t_admit = time.monotonic()
+        cached = len(shared) * self.serve_cfg.block_size
+        if cow_src is not None:
+            # dst is fresh[0] == table index len(shared): the copy sits
+            # exactly where the popped match sat.  Device program order
+            # makes the copy read src before any later program could
+            # recycle it, so the pin can drop right after dispatch.
+            pools = (self.k_pools, self.v_pools)
+            self.k_pools, self.v_pools = self.decoder._cow(
+                pools, jnp.asarray(cow_src, jnp.int32),
+                jnp.asarray(fresh[0], jnp.int32))
+            self.pool.free([cow_src])
+            cached = seq.prompt_len - 1
+            seq.cow = True
+            counters.inc("cow_copies")
+        seq.prefilled = cached
+        seq.cached_tokens = cached
+        seq.shared_blocks = len(shared)
+        seq.registered = len(shared)
+        if cached:
+            # engine.stats() aggregates the per-sequence fields at
+            # completion; these global counters are the operator's
+            # process-wide degradation/observability surface
+            counters.inc("prefix_hits")
+            if shared:
+                counters.inc("prefix_blocks_reused", len(shared))
         self.slot_seq[slot] = seq
         self.tables[slot, :] = 0
         self.tables[slot, :len(blocks)] = blocks
-        self.seq_lens[slot] = 0
+        self.seq_lens[slot] = cached
         self.active[slot] = False          # decode starts after prefill
         self.temp[slot] = seq.temperature
         self.top_k[slot] = seq.top_k
@@ -508,22 +684,52 @@ class Scheduler:
         self._dev_stable = None
         return True
 
+    def flush_prefix_cache(self) -> int:
+        """Drop every cached prefix block + index entry; returns the
+        block count.  The weight-swap seam (engine.load_params): k/v
+        banked under old weights must never satisfy a prompt served
+        under new ones.  The caller guarantees no live sequences."""
+        if self.prefix is None:
+            return 0
+        return self.pool.flush_cached()
+
     # -- the iteration ------------------------------------------------------
 
-    def _prefilling(self) -> Optional[Sequence]:
+    def _prefill_candidates(self) -> List[Sequence]:
+        """Up to ``prefill_batch`` distinct sequences with prompt left
+        to prefill, most-urgent first ('priority' policy: class then
+        deadline — the same order admission used; otherwise arrival)."""
         cands = [s for s in self.slot_seq
                  if s is not None and not s.finished
                  and s.prefilled < s.prompt_len]
-        return min(cands, key=lambda s: s.sid) if cands else None
+        if not cands:
+            return []
+        if self.serve_cfg.policy == "priority":
+            # the same effective class admission uses, so a request
+            # that aged past a higher class keeps its precedence once
+            # both occupy slots
+            now = time.monotonic()
+            aging = self.serve_cfg.priority_aging_s
+            cands.sort(key=lambda s: priority_key(s, now, aging))
+        else:
+            cands.sort(key=lambda s: s.sid)
+        return cands[:self.serve_cfg.prefill_batch]
 
     def step(self) -> bool:
         """One engine iteration.  Returns True when any device work was
         dispatched (False = idle: nothing admitted, prefilling or
         decoding)."""
         did = False
-        seq = self._prefilling()
-        if seq is not None:
-            self._prefill_one(seq)
+        seqs = self._prefill_candidates()
+        if seqs:
+            if len(seqs) == 1:
+                # a lone prefilling sequence (prefill_batch == 1, or
+                # the steady-state trickle under a bigger batch) takes
+                # the single-sequence program — no pad rows burning
+                # prefill_batch x the FLOPs on the null block
+                self._prefill_one(seqs[0])
+            else:
+                self._prefill_batched(seqs)
             did = True
         if self.active.any():
             self._decode_once()
@@ -556,25 +762,76 @@ class Scheduler:
         self.k_pools, self.v_pools = pools
         seq.prefilled += n_valid
         self.seq_lens[seq.slot] = seq.prefilled
+        self._register_prefix(seq)
         if seq.prefilled >= seq.prompt_len:
-            # final chunk: sample the first generated token on device
-            # and splice it into the decode carry — no readback; the
-            # host learns it through the ring like any other token
-            seq.key, sub = jax.random.split(seq.key)
-            tok = self.decoder._sample_first(
-                last_logits, sub,
-                jnp.asarray(seq.temperature, jnp.float32),
-                jnp.asarray(seq.top_k, jnp.int32),
-                jnp.asarray(seq.top_p, jnp.float32))
-            seq.key, slot_key = jax.random.split(seq.key)
-            self.carry = self.decoder._set_slot(
-                self.carry, jnp.asarray(seq.slot, jnp.int32), tok,
-                slot_key.astype(jnp.uint32))
-            self.active[seq.slot] = True
-            self._dev_stable = None
-            self._ring.append(_InFlight(
-                kind="first", tokens=tok, seq=seq,
-                t_dispatch=time.monotonic()))
+            self._seed_first_token(seq, last_logits)
+
+    def _prefill_batched(self, seqs: List[Sequence]) -> None:
+        """One chunk each of up to ``prefill_batch`` sequences in a
+        single dispatched program.  Short rows pad to [prefill_batch,
+        prefill_chunk] (pad rows run on the null block, outputs
+        discarded) so the program traces exactly once."""
+        pb = self.serve_cfg.prefill_batch
+        c = self.serve_cfg.prefill_chunk
+        tables = np.zeros((pb, self.max_blocks_per_seq), np.int32)
+        t0s = np.zeros((pb,), np.int32)
+        toks = np.zeros((pb, c), np.int32)
+        n_valids = np.zeros((pb,), np.int32)
+        taken = []
+        for r, seq in enumerate(seqs):
+            t0 = seq.prefilled
+            chunk = seq.prompt[t0:t0 + c]
+            n = int(chunk.shape[0])
+            tables[r] = self.tables[seq.slot]
+            t0s[r] = t0
+            toks[r, :n] = chunk
+            n_valids[r] = n
+            taken.append(n)
+        pools = (self.k_pools, self.v_pools)
+        pools, logits = self.decoder._prefill_batch(
+            self.params, pools, jnp.asarray(tables), jnp.asarray(t0s),
+            jnp.asarray(toks), jnp.asarray(n_valids))
+        self.k_pools, self.v_pools = pools
+        for r, seq in enumerate(seqs):
+            seq.prefilled += taken[r]
+            self.seq_lens[seq.slot] = seq.prefilled
+            self._register_prefix(seq)
+            if seq.prefilled >= seq.prompt_len:
+                self._seed_first_token(seq, logits[r])
+
+    def _register_prefix(self, seq: Sequence) -> None:
+        """Index every newly completed FULL prompt block so later (and
+        concurrent) prompts can share it.  First writer wins: blocks
+        whose chain key is already mapped (the shared match itself, the
+        COW copy, a concurrent identical prompt) stay private."""
+        if self.prefix is None or not seq.block_keys:
+            return
+        n_full = min(seq.prefilled, seq.prompt_len) \
+            // self.serve_cfg.block_size
+        while seq.registered < n_full:
+            i = seq.registered
+            self.prefix.register(seq.block_keys[i], seq.blocks[i])
+            seq.registered += 1
+
+    def _seed_first_token(self, seq: Sequence, last_logits) -> None:
+        """Final prefill chunk done: sample the first generated token
+        on device and splice it into the decode carry — no readback;
+        the host learns it through the ring like any other token."""
+        seq.key, sub = jax.random.split(seq.key)
+        tok = self.decoder._sample_first(
+            last_logits, sub,
+            jnp.asarray(seq.temperature, jnp.float32),
+            jnp.asarray(seq.top_k, jnp.int32),
+            jnp.asarray(seq.top_p, jnp.float32))
+        seq.key, slot_key = jax.random.split(seq.key)
+        self.carry = self.decoder._set_slot(
+            self.carry, jnp.asarray(seq.slot, jnp.int32), tok,
+            slot_key.astype(jnp.uint32))
+        self.active[seq.slot] = True
+        self._dev_stable = None
+        self._ring.append(_InFlight(
+            kind="first", tokens=tok, seq=seq,
+            t_dispatch=time.monotonic()))
 
     def _dev_stable_arrays(self):
         if self._dev_stable is None:
@@ -611,6 +868,19 @@ class Scheduler:
             seq.t_first_token = now
         seq.out_tokens.append(token)
         seq.token_times.append(now)
+        if seq.on_token is not None:
+            # streaming delivery: the callback sees each token at
+            # resolution time — <= decode_depth - 1 iterations after
+            # its dispatch, never a garbage post-finish token.  A
+            # raising callback is disabled, not allowed to corrupt the
+            # ring resolution for every other request.
+            try:
+                seq.on_token(token, now)
+            except Exception:
+                logger.exception(
+                    f"on_token callback for request {seq.sid} raised; "
+                    f"disabling the stream callback for this request")
+                seq.on_token = None
         if seq.eos_id is not None and token == seq.eos_id:
             self._finish(seq, "eos", now)
         elif len(seq.out_tokens) >= seq.max_new:

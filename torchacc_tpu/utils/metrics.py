@@ -82,9 +82,11 @@ class Counters:
     ``watchdog_stalls``, the elastic-resume trio
     ``resume_replayed_batches`` / ``bad_batches_skipped`` /
     ``elastic_reshards``, the SDC-defense trio ``sdc_checks`` /
-    ``replica_divergences`` / ``sdc_mismatches``, and the
+    ``replica_divergences`` / ``sdc_mismatches``, the
     layout-transfer pair ``transfer_compiles`` /
-    ``transfer_cache_hits`` — parallel/transfer.py) and the Trainer
+    ``transfer_cache_hits`` — parallel/transfer.py — and the serving
+    prefix-cache set ``prefix_hits`` / ``prefix_blocks_reused`` /
+    ``prefix_evictions`` / ``cow_copies`` — serve/) and the Trainer
     surfaces the non-zero ones in
     every step log line AND every metrics.jsonl step record — an
     operator sees a run degrading without grepping worker logs.
