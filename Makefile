@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke obs-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -65,6 +65,16 @@ ckpt-smoke:
 	JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		python bench.py --checkpoint --fast --platform cpu
 
+# telemetry gate (docs/observability.md): obs off-vs-on per-step
+# overhead under a budget at dispatch_depth=2, /metrics Prometheus-
+# parseable with non-zero step + serve series, /healthz flips to
+# degraded under an injected watchdog stall and recovers, trainer +
+# tiered-checkpoint + serving spans export as ONE valid Chrome-trace
+# JSON, and an injected flip_bits SDC abort writes a flight-recorder
+# bundle naming the flagged step
+obs-smoke:
+	JAX_PLATFORMS=cpu python bench.py --obs --fast --platform cpu
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -79,6 +89,7 @@ chaos:
 			tests/test_serving.py tests/test_prefix_cache.py \
 			tests/test_quant.py \
 			tests/test_handoff.py tests/test_tiered.py \
+			tests/test_obs.py tests/test_profiling.py \
 			-m "not slow" \
 			-q || exit 1; \
 	done

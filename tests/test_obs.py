@@ -1,0 +1,750 @@
+"""Unified telemetry plane tests (torchacc_tpu/obs/,
+docs/observability.md).
+
+The contracts under test:
+
+- spans nest with thread-local parent propagation, live in a BOUNDED
+  buffer, export as valid Chrome-trace JSON, and are exact no-ops while
+  disabled;
+- histograms bucket/merge/percentile correctly and export Prometheus
+  cumulative-``le`` text;
+- the HTTP endpoint serves parseable ``/metrics`` (counters + gauges +
+  histograms) and a ``/healthz`` that flips ok -> degraded -> unhealthy
+  (503) with the registered providers;
+- the flight recorder keeps a bounded step ring with counter deltas and
+  every typed-error fit exit (and preemption) dumps a strict-JSON
+  postmortem bundle naming the failing step;
+- with ``obs`` enabled the fit trajectory is BITWISE identical to the
+  disabled run, and trainer/tiered-checkpoint/serving spans land in one
+  exportable trace;
+- the MetricsWriter satellites: non-finite floats serialise as null
+  (counted), and a non-numeric value raises before EITHER sink wrote.
+"""
+
+import json
+import math
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchacc_tpu as ta
+from torchacc_tpu.errors import AnomalyError, SDCError
+from torchacc_tpu.models import TransformerLM, get_preset
+from torchacc_tpu.obs import flight, hist, server, tracing
+from torchacc_tpu.resilience import ChaosLoader, ChaosPlan, chaos_loss
+from torchacc_tpu.train import accelerate
+from torchacc_tpu.utils.metrics import MetricsWriter, counters
+
+pytestmark = pytest.mark.obs
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every obs seam is process-global (by design, like counters) —
+    scrub them around each test."""
+    counters.reset()
+    tracing.configure(enabled=False)
+    tracing.clear()
+    hist.configure(enabled=False)
+    hist.reset()
+    server.stop()
+    server.clear_registries()
+    flight.recorder.clear()
+    yield
+    counters.reset()
+    tracing.configure(enabled=False)
+    tracing.clear()
+    hist.configure(enabled=False)
+    hist.reset()
+    server.stop()
+    server.clear_registries()
+    flight.recorder.clear()
+
+
+def _model():
+    return get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      intermediate_size=64, dtype=jnp.float32)
+
+
+def _batches(n, seed=None):
+    rng = np.random.default_rng(CHAOS_SEED if seed is None else seed)
+    return [{"input_ids": rng.integers(0, 64, size=(8, 16)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def _trainer(obs=None, loss=None, **res_kwargs):
+    import optax
+    cfg = ta.Config(resilience=ta.ResilienceConfig(**res_kwargs),
+                    obs=obs or ta.ObsConfig())
+    tr, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3),
+                       loss=loss)
+    return tr
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+def _parse_prometheus(text):
+    """Minimal Prometheus text parser: {name: {labels_str: value}} —
+    raises on any malformed sample line, so parsing IS the validity
+    check."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        if "{" in name_labels:
+            name, rest = name_labels.split("{", 1)
+            assert rest.endswith("}"), line
+            labels = rest[:-1]
+        else:
+            name, labels = name_labels, ""
+        out.setdefault(name, {})[labels] = float(value)
+    return out
+
+
+# -- tracing ------------------------------------------------------------------
+
+def test_span_disabled_is_noop_singleton():
+    s1 = tracing.span("x", a=1)
+    s2 = tracing.span("y")
+    assert s1 is s2                        # shared null object
+    with s1:
+        s1.set(b=2)                        # no-op, no error
+    assert tracing.snapshot() == []
+
+
+def test_span_nesting_and_parent_ids():
+    tracing.configure(enabled=True)
+    with tracing.span("outer", step=3):
+        with tracing.span("inner"):
+            pass
+        with tracing.span("inner2"):
+            pass
+    spans = tracing.snapshot()
+    assert [s["name"] for s in spans] == ["inner", "inner2", "outer"]
+    outer = spans[2]
+    assert outer["parent"] is None
+    assert spans[0]["parent"] == outer["id"]
+    assert spans[1]["parent"] == outer["id"]
+    assert outer["attrs"] == {"step": 3}
+    assert all(s["dur"] >= 0 for s in spans)
+
+
+def test_span_thread_local_stacks_do_not_cross():
+    tracing.configure(enabled=True)
+    ready = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with tracing.span("worker_span"):
+            ready.set()
+            release.wait(5)
+
+    t = threading.Thread(target=worker)
+    with tracing.span("main_span"):
+        t.start()
+        ready.wait(5)
+        with tracing.span("main_child"):
+            pass
+    release.set()
+    t.join(5)
+    by_name = {s["name"]: s for s in tracing.snapshot()}
+    # the worker's open span is NOT the parent of main's child (and
+    # vice versa): stacks are per-thread
+    assert by_name["main_child"]["parent"] == by_name["main_span"]["id"]
+    assert by_name["worker_span"]["parent"] is None
+
+
+def test_span_buffer_bounded():
+    tracing.configure(enabled=True, buffer_size=16)
+    for i in range(100):
+        with tracing.span("s", i=i):
+            pass
+    spans = tracing.snapshot()
+    assert len(spans) == 16
+    assert spans[-1]["attrs"]["i"] == 99   # newest kept
+    tracing.configure(buffer_size=4096)
+
+
+def test_record_span_explicit_interval():
+    tracing.configure(enabled=True)
+    import time
+    now = time.perf_counter()
+    tracing.record_span("serve/queue", now - 0.25, now, sid=7)
+    s = tracing.snapshot()[-1]
+    assert s["name"] == "serve/queue"
+    assert s["dur"] == pytest.approx(0.25)
+    assert s["attrs"]["sid"] == 7
+
+
+def test_chrome_trace_export_valid(tmp_path):
+    tracing.configure(enabled=True)
+    with tracing.span("train/dispatch", step=1):
+        pass
+    path = str(tmp_path / "trace.json")
+    doc = tracing.export_chrome_trace(path)
+    loaded = json.load(open(path))       # file round-trips as JSON
+    assert loaded["traceEvents"]
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 1
+    e = xs[0]
+    assert e["name"] == "train/dispatch" and e["cat"] == "train"
+    assert e["dur"] >= 0 and e["ts"] > 0   # microseconds, wall anchor
+    assert e["args"]["step"] == 1 and "span_id" in e["args"]
+    # metadata rows name the process/threads for the viewer
+    assert any(m["name"] == "thread_name" for m in doc["traceEvents"]
+               if m["ph"] == "M")
+
+
+def test_span_set_attaches_attrs():
+    tracing.configure(enabled=True)
+    with tracing.span("serve/admit", sid=1) as sp:
+        sp.set(admitted=True)
+    assert tracing.snapshot()[-1]["attrs"] == {"sid": 1, "admitted": True}
+
+
+# -- histograms ---------------------------------------------------------------
+
+def test_hist_percentiles_and_snapshot():
+    h = hist.Histogram()
+    for v in range(1, 101):
+        h.observe(float(v))
+    snap = h.snapshot()
+    assert snap["count"] == 100
+    assert snap["sum"] == pytest.approx(5050.0)
+    # log-bucket resolution: estimates within one bucket ratio (1.5x)
+    assert 50 / 1.5 <= snap["p50"] <= 50 * 1.5
+    assert 95 / 1.5 <= snap["p95"] <= 95 * 1.5
+    assert 99 / 1.5 <= snap["p99"] <= 99 * 1.5
+    assert h.percentile(0) >= 0
+    assert h.percentile(100) <= 100 * 1.5
+
+
+def test_hist_empty_and_nan():
+    h = hist.Histogram()
+    assert h.percentile(50) == 0.0
+    h.observe(float("nan"))               # never lands in a bucket
+    assert h.count == 0
+
+
+def test_hist_merge_matches_combined():
+    a, b, c = hist.Histogram(), hist.Histogram(), hist.Histogram()
+    rng = np.random.default_rng(0)
+    xs, ys = rng.uniform(0.1, 50, 200), rng.uniform(10, 5000, 300)
+    for x in xs:
+        a.observe(x)
+        c.observe(x)
+    for y in ys:
+        b.observe(y)
+        c.observe(y)
+    a.merge(b)
+    assert a.count == c.count == 500
+    assert a.counts == c.counts
+    assert a.percentile(95) == c.percentile(95)
+
+
+def test_hist_merge_bounds_mismatch_raises():
+    a = hist.Histogram(bounds=[1.0, 2.0])
+    b = hist.Histogram(bounds=[1.0, 3.0])
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_hist_prometheus_lines_cumulative():
+    h = hist.Histogram(bounds=[1.0, 10.0, 100.0])
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    lines = h.prometheus_lines("m")
+    assert lines[0] == "# TYPE m histogram"
+    assert 'm_bucket{le="1"} 1' in lines
+    assert 'm_bucket{le="10"} 2' in lines
+    assert 'm_bucket{le="100"} 3' in lines
+    assert 'm_bucket{le="+Inf"} 4' in lines
+    assert "m_count 4" in lines
+
+
+def test_hist_registry_gated_on_enabled():
+    hist.observe("gated", 1.0)            # disabled: nothing records
+    assert "gated" not in hist.all_histograms() \
+        or hist.get("gated").count == 0
+    hist.configure(enabled=True)
+    hist.observe("gated", 1.0)
+    assert hist.get("gated").count == 1
+
+
+# -- HTTP server --------------------------------------------------------------
+
+def test_metrics_endpoint_counters_gauges_hists():
+    counters.inc("ckpt_retries", 3)
+    hist.configure(enabled=True)
+    hist.observe("step_time_ms", 12.0)
+    server.register_gauge("train_inflight_depth", lambda: 2, help="ring")
+    srv = server.start(0)
+    code, text = _get(srv.url + "/metrics")
+    assert code == 200
+    metrics = _parse_prometheus(text)    # parsing IS the format gate
+    assert metrics["torchacc_ckpt_retries_total"][""] == 3.0
+    assert metrics["torchacc_train_inflight_depth"][""] == 2.0
+    assert metrics["torchacc_step_time_ms_count"][""] == 1.0
+    assert metrics["torchacc_step_time_ms_bucket"]['le="+Inf"'] == 1.0
+
+
+def test_metrics_broken_gauge_skipped():
+    server.register_gauge("broken", lambda: 1 / 0)
+    server.register_gauge("fine", lambda: 5)
+    srv = server.start(0)
+    code, text = _get(srv.url + "/metrics")
+    assert code == 200
+    metrics = _parse_prometheus(text)
+    assert "torchacc_broken" not in metrics
+    assert metrics["torchacc_fine"][""] == 5.0
+
+
+def test_healthz_ok_degraded_unhealthy():
+    srv = server.start(0)
+    code, body = _get(srv.url + "/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+    server.register_health("a", lambda: ("ok", None))
+    server.register_health("b", lambda: ("degraded", "slow"))
+    code, body = _get(srv.url + "/healthz")
+    h = json.loads(body)
+    assert code == 200 and h["status"] == "degraded"
+    assert h["checks"]["b"]["reason"] == "slow"
+    server.register_health("c", lambda: ("unhealthy", "dead"))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.url + "/healthz")
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["status"] == "unhealthy"
+
+
+def test_healthz_raising_provider_degrades_not_500():
+    server.register_health("boom", lambda: 1 / 0)
+    srv = server.start(0)
+    code, body = _get(srv.url + "/healthz")
+    assert code == 200
+    assert json.loads(body)["status"] == "degraded"
+
+
+def test_server_singleton_and_stop():
+    s1 = server.start(0)
+    s2 = server.start(0)
+    assert s1 is s2
+    server.stop()
+    assert server.get() is None
+    with pytest.raises(urllib.error.URLError):
+        _get(s1.url + "/metrics")
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_flight_ring_bounded_with_counter_deltas():
+    flight.recorder.configure(capacity=8)
+    for i in range(20):
+        counters.inc("resumes")
+        flight.recorder.record_step(i, {"loss": float(i)})
+    recs = flight.recorder.records()
+    assert len(recs) == 8
+    assert recs[-1]["step"] == 19
+    # each step contributed exactly +1 to the counter — the delta is
+    # attributed per step, not cumulative
+    assert all(r["counter_delta"] == {"resumes": 1} for r in recs)
+
+
+def test_flight_dump_strict_json(tmp_path):
+    tracing.configure(enabled=True)
+    with tracing.span("train/dispatch", step=4):
+        pass
+    flight.recorder.configure(capacity=8, dump_dir=str(tmp_path))
+    flight.recorder.set_context("config", {"seed": 0})
+    flight.recorder.record_step(4, {"loss": float("nan"),
+                                    "grad_norm": float("inf")})
+    err = SDCError("boom", step=4, kind="replica", hosts=[1])
+    path = flight.recorder.dump("SDCError", error=err)
+    assert os.path.basename(path) == "flight_4.json"
+    raw = open(path).read()
+    assert "NaN" not in raw and "Infinity" not in raw   # strict JSON
+    b = json.loads(raw)
+    assert b["step"] == 4 and b["reason"] == "SDCError"
+    assert b["error"]["fields"]["hosts"] == [1]
+    assert b["records"][0]["record"]["loss"] is None
+    assert b["context"]["config"] == {"seed": 0}
+    assert any(s["name"] == "train/dispatch" for s in b["spans"])
+
+
+def test_flight_dump_without_dir_returns_none():
+    assert flight.recorder.dump("HangError", step=1) is None
+
+
+# -- MetricsWriter satellites -------------------------------------------------
+
+class _FakeTB:
+    def __init__(self):
+        self.calls = []
+
+    def add_scalar(self, k, v, step):
+        self.calls.append((k, v, step))
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_metrics_writer_nonfinite_serialises_null(tmp_path):
+    mw = MetricsWriter(str(tmp_path), tensorboard=False)
+    mw.log(1, {"train/loss": float("nan"), "train/lr": 0.1,
+               "train/gn": float("inf")})
+    mw.close()
+    line = open(os.path.join(str(tmp_path), "metrics.jsonl")).read()
+    assert "NaN" not in line and "Infinity" not in line
+    rec = json.loads(line)                # strict consumers parse it
+    assert rec["train/loss"] is None
+    assert rec["train/gn"] is None
+    assert rec["train/lr"] == 0.1
+    assert counters.get("metrics_nonfinite_values") == 2
+
+
+def test_metrics_writer_validates_before_either_sink(tmp_path):
+    mw = MetricsWriter(str(tmp_path), tensorboard=False)
+    tb = _FakeTB()
+    mw._tb = tb
+    # a non-numeric value anywhere in the dict: NEITHER sink may have
+    # written anything for this record (the old code wrote TB scalars
+    # mid-validation and left the sinks inconsistent)
+    with pytest.raises((TypeError, ValueError)):
+        mw.log(1, {"a": 1.0, "b": "not-a-number", "c": 2.0})
+    assert tb.calls == []
+    mw.log(2, {"a": 3.0})
+    mw.close()
+    lines = open(os.path.join(str(tmp_path), "metrics.jsonl")).readlines()
+    assert len(lines) == 1                # only the valid record landed
+    assert json.loads(lines[0])["step"] == 2
+    assert tb.calls == [("a", 3.0, 2)]
+
+
+def test_metrics_writer_tb_gets_raw_nonfinite(tmp_path):
+    mw = MetricsWriter(str(tmp_path), tensorboard=False)
+    tb = _FakeTB()
+    mw._tb = tb
+    mw.log(3, {"x": float("nan")})
+    mw.close()
+    (k, v, step), = tb.calls
+    assert k == "x" and math.isnan(v) and step == 3
+
+
+# -- trainer e2e --------------------------------------------------------------
+
+def test_fit_trajectory_bitwise_identical_obs_on_off(tmp_path):
+    def run(obs_on, sub):
+        counters.reset()
+        tr = _trainer(obs=ta.ObsConfig(enabled=obs_on,
+                                       flight_dir=str(tmp_path / sub)))
+        hist_ = tr.fit(_batches(6), max_steps=6, log_every=1,
+                       metrics_dir=str(tmp_path / sub))
+        params = [np.asarray(x) for x in
+                  jax.device_get(jax.tree.leaves(tr.state.params))]
+        return [r["loss"] for r in hist_], params
+
+    l_off, p_off = run(False, "off")
+    l_on, p_on = run(True, "on")
+    assert l_off == l_on
+    for a, b in zip(p_off, p_on):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fit_emits_spans_hists_flight(tmp_path):
+    tr = _trainer(obs=ta.ObsConfig(enabled=True))
+    tr.fit(_batches(5), max_steps=5, log_every=1,
+           metrics_dir=str(tmp_path))
+    names = {s["name"] for s in tracing.snapshot()}
+    assert {"train/dispatch", "train/resolve"} <= names
+    assert hist.get("step_time_ms").count == 5
+    assert hist.get("host_blocked_ms").count == 5
+    assert len(flight.recorder.records()) == 5
+    # session hygiene: gauges/health unregistered after fit returns
+    assert server.health()["checks"] == {}
+    code = server.prometheus_text()
+    assert "torchacc_train_inflight_depth" not in code
+
+
+def test_fit_save_and_tiered_spans(tmp_path):
+    tracingnames = lambda: {s["name"] for s in tracing.snapshot()}  # noqa: E731
+    tr = _trainer(obs=ta.ObsConfig(enabled=True),
+                  tiered_checkpointing=True)
+    tr.fit(_batches(4), max_steps=4, log_every=0,
+           checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    names = tracingnames()
+    assert "train/save" in names
+    assert "ckpt/tier0_fetch" in names
+    assert "ckpt/tier1_commit" in names
+
+
+def test_fit_anomaly_abort_writes_flight_bundle(tmp_path):
+    md = str(tmp_path / "run")
+    tr = _trainer(obs=ta.ObsConfig(enabled=True), loss=chaos_loss(),
+                  nan_guard=True, max_consecutive_anomalies=2)
+    with pytest.raises(AnomalyError):
+        tr.fit(ChaosLoader(_batches(8), nan_loss_steps={2, 3, 4, 5}),
+               max_steps=8, log_every=1, metrics_dir=md)
+    path = flight.recorder.last_dump_path
+    assert path is not None and path.startswith(md)
+    b = json.load(open(path))
+    assert b["reason"] == "AnomalyError"
+    assert b["error"]["fields"]["consecutive"] == 2
+    assert b["context"]["config"]["resilience"]["nan_guard"] is True
+    assert b["counters"]["anomalies_skipped"] == 2
+
+
+def test_fit_sdc_abort_bundle_names_flagged_step(tmp_path):
+    k = 1 + CHAOS_SEED % 2
+    md = str(tmp_path / "run")
+    tr = _trainer(obs=ta.ObsConfig(enabled=True),
+                  sdc_recompute_interval_steps=1)
+    with pytest.raises(SDCError) as ei:
+        with ChaosPlan(seed=CHAOS_SEED).flip_bits(host=0, at=k,
+                                                  where="recompute"):
+            tr.fit(_batches(4), max_steps=4, log_every=1,
+                   metrics_dir=md)
+    b = json.load(open(flight.recorder.last_dump_path))
+    assert b["step"] == ei.value.step == k
+    assert b["error"]["type"] == "SDCError"
+    assert b["error"]["fields"]["hosts"] == [0]
+
+
+def test_fit_preemption_writes_bundle(tmp_path):
+    ck = str(tmp_path / "ck")
+    tr = _trainer(obs=ta.ObsConfig(enabled=True))
+    tr.fit(ChaosLoader(_batches(8), preempt_after_step=3), max_steps=8,
+           log_every=1, checkpoint_dir=ck, checkpoint_every=100)
+    path = flight.recorder.last_dump_path
+    assert path is not None
+    b = json.load(open(path))
+    assert b["reason"] == "preemption"
+    assert b["step"] == 4                 # the emergency-saved step
+
+
+def test_fit_health_providers_live_during_run(tmp_path):
+    """While a fit is running, /healthz answers from the trainer's
+    watchdog/guard/sdc state; a stalled heartbeat degrades it."""
+    seen = []
+
+    class Probe:
+        def __iter__(self):
+            for i, b in enumerate(_batches(4)):
+                if i == 2:
+                    seen.append(server.health())
+                yield b
+
+    tr = _trainer(obs=ta.ObsConfig(enabled=True,
+                                   health_degraded_heartbeat_s=60.0),
+                  step_deadline_s=30.0)
+    tr.fit(Probe(), max_steps=4, log_every=0,
+           metrics_dir=str(tmp_path))
+    assert seen and seen[0]["status"] == "ok"
+    assert set(seen[0]["checks"]) == {"watchdog_heartbeat",
+                                      "guard_anomalies", "sdc"}
+    # after fit: providers deregistered
+    assert server.health()["checks"] == {}
+
+
+def test_healthz_degrades_under_stalled_heartbeat():
+    """Drive the heartbeat provider directly with a fake-clock watchdog
+    — the exact signal the obs-smoke gate trips with a real injected
+    hang."""
+    from torchacc_tpu.obs.runtime import FitObs
+    from torchacc_tpu.resilience.watchdog import Watchdog
+    now = [0.0]
+    tr = _trainer(obs=ta.ObsConfig(enabled=True,
+                                   health_degraded_heartbeat_s=5.0,
+                                   health_unhealthy_heartbeat_s=50.0))
+    fo = FitObs(tr, tr.config.obs, run_dir=None)
+    try:
+        wd = Watchdog(poll_interval_s=None, clock=lambda: now[0])
+        tr._watchdog = wd
+        assert server.health()["status"] == "ok"
+        now[0] = 10.0                      # heartbeat age 10s > 5s
+        h = server.health()
+        assert h["status"] == "degraded"
+        assert "heartbeat" in h["checks"]["watchdog_heartbeat"]["reason"]
+        now[0] = 100.0
+        assert server.health()["status"] == "unhealthy"
+        wd.beat()
+        assert server.health()["status"] == "ok"
+    finally:
+        tr._watchdog = None
+        fo.close()
+
+
+# -- serving e2e --------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_serve():
+    cfg = get_preset(
+        "llama-tiny", dtype=jnp.float32, num_layers=2, hidden_size=64,
+        num_heads=4, num_kv_heads=2, intermediate_size=128,
+        vocab_size=257, max_seq_len=128)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def test_serve_engine_obs_gauges_hists_spans(tiny_serve):
+    from torchacc_tpu.serve import Request, ServeEngine
+    model, params = tiny_serve
+    cfg = ta.Config(
+        serve=ta.config.ServeConfig(block_size=8, num_blocks=64,
+                                    max_slots=4, prefill_chunk=8,
+                                    decode_depth=2),
+        obs=ta.ObsConfig(enabled=True))
+    engine = ServeEngine(model, params, cfg)
+    # gauges live while the engine lives
+    text = server.prometheus_text()
+    m = _parse_prometheus(text)
+    assert "torchacc_serve_queue_depth" in m
+    assert "torchacc_kv_pool_free_blocks" in m
+    assert m["torchacc_kv_pool_free_blocks"][""] == 63.0
+    rng = np.random.default_rng(0)
+    reqs = [Request(prompt_ids=rng.integers(1, 257, size=n).tolist(),
+                    max_new_tokens=4) for n in (5, 9)]
+    results = engine.generate(reqs)
+    assert all(len(r.tokens) == 4 for r in results)
+    assert hist.get("serve_ttft_ms").count == 2
+    assert hist.get("serve_token_gap_ms").count == 2 * 3
+    names = {s["name"] for s in tracing.snapshot()}
+    assert {"serve/queue", "serve/admit", "serve/prefill",
+            "serve/decode", "serve/deliver"} <= names
+    engine.close()
+    # gauges deregistered with the engine
+    assert "torchacc_serve_queue_depth" not in server.prometheus_text()
+
+
+def test_serve_obs_disabled_no_state(tiny_serve):
+    from torchacc_tpu.serve import Request, ServeEngine
+    model, params = tiny_serve
+    cfg = ta.Config(serve=ta.config.ServeConfig(
+        block_size=8, num_blocks=64, max_slots=4, prefill_chunk=8))
+    engine = ServeEngine(model, params, cfg)
+    engine.generate([Request(prompt_ids=[1, 2, 3], max_new_tokens=2)])
+    engine.close()
+    assert tracing.snapshot() == []
+    assert hist.all_histograms() == {} or \
+        all(h.count == 0 for h in hist.all_histograms().values())
+
+
+def test_failed_admission_retries_record_no_spans(tiny_serve):
+    """A saturated engine re-attempts its queue head every iteration;
+    those failures must not evict useful spans from the bounded ring —
+    serve/admit records successful admissions only."""
+    from torchacc_tpu.serve import Request, ServeEngine
+    model, params = tiny_serve
+    cfg = ta.Config(
+        serve=ta.config.ServeConfig(block_size=8, num_blocks=64,
+                                    max_slots=1, prefill_chunk=8),
+        obs=ta.ObsConfig(enabled=True))
+    engine = ServeEngine(model, params, cfg)
+    rng = np.random.default_rng(0)
+    # 3 requests through 1 slot: #2 and #3 retry admission every
+    # iteration while the predecessor decodes
+    engine.generate([Request(prompt_ids=rng.integers(
+        1, 257, size=6).tolist(), max_new_tokens=6) for _ in range(3)])
+    engine.close()
+    admits = [s for s in tracing.snapshot()
+              if s["name"] == "serve/admit"]
+    assert len(admits) == 3               # one per SUCCESSFUL admission
+    assert all("cached_tokens" in s["attrs"] for s in admits)
+
+
+def test_flight_ring_resets_when_new_fit_takes_ownership(tmp_path):
+    """Fit #2's postmortem must not be dominated by fit #1's records:
+    taking flight ownership starts a fresh ring."""
+    tr = _trainer(obs=ta.ObsConfig(enabled=True))
+    tr.fit(_batches(5), max_steps=5, log_every=1,
+           metrics_dir=str(tmp_path / "run1"))
+    assert len(flight.recorder.records()) == 5
+    md2 = str(tmp_path / "run2")
+    tr2 = _trainer(obs=ta.ObsConfig(enabled=True), loss=chaos_loss(),
+                   nan_guard=True, max_consecutive_anomalies=2)
+    with pytest.raises(AnomalyError):
+        tr2.fit(ChaosLoader(_batches(6), nan_loss_steps={1, 2, 3}),
+                max_steps=6, log_every=1, metrics_dir=md2)
+    b = json.load(open(flight.recorder.last_dump_path))
+    # only fit #2's records in the bundle — nothing from fit #1 (the
+    # abort raises while RESOLVING step 2, so its record never emits:
+    # steps 0 and 1 are the recorded history)
+    assert [r["step"] for r in b["records"]] == [0, 1]
+    assert b["context"]["run_dir"] == md2
+
+
+def test_closing_old_engine_keeps_new_engines_gauges(tiny_serve):
+    """Last-owner-wins cuts both ways: engine B replaces A's gauge
+    registrations, and closing A afterwards must NOT delete B's."""
+    from torchacc_tpu.serve import ServeEngine
+    model, params = tiny_serve
+
+    def mk():
+        cfg = ta.Config(
+            serve=ta.config.ServeConfig(block_size=8, num_blocks=64,
+                                        max_slots=4, prefill_chunk=8),
+            obs=ta.ObsConfig(enabled=True))
+        return ServeEngine(model, params, cfg)
+
+    a = mk()
+    b = mk()                               # replaces a's registrations
+    a.close()
+    assert "torchacc_serve_queue_depth" in server.prometheus_text()
+    b.close()
+    assert "torchacc_serve_queue_depth" not in server.prometheus_text()
+
+
+def test_flight_dump_dir_not_inherited_across_fits(tmp_path):
+    """A fit WITHOUT any run dir must not misfile its postmortem into
+    a previous fit's checkpoint dir."""
+    ck1 = str(tmp_path / "run1")
+    tr = _trainer(obs=ta.ObsConfig(enabled=True))
+    tr.fit(_batches(2), max_steps=2, log_every=0, checkpoint_dir=ck1,
+           checkpoint_every=100)
+    assert flight.recorder.dump_dir == ck1
+    tr2 = _trainer(obs=ta.ObsConfig(enabled=True), loss=chaos_loss(),
+                   nan_guard=True, max_consecutive_anomalies=1)
+    with pytest.raises(AnomalyError):
+        tr2.fit(ChaosLoader(_batches(4), nan_loss_steps={0, 1, 2}),
+                max_steps=4, log_every=0)   # no dirs at all
+    # the bundle was NOT written into run1 (dump_dir honestly None ->
+    # warned + skipped)
+    assert flight.recorder.dump_dir is None
+    assert flight.recorder.last_dump_path is None
+    assert not [f for f in os.listdir(ck1)
+                if f.startswith("flight_")]
+
+
+# -- config -------------------------------------------------------------------
+
+def test_obs_config_validation_and_roundtrip():
+    with pytest.raises(ta.ConfigError):
+        ta.Config(obs=ta.ObsConfig(trace_buffer=2)).validate()
+    with pytest.raises(ta.ConfigError):
+        ta.Config(obs=ta.ObsConfig(http_port=99999)).validate()
+    with pytest.raises(ta.ConfigError):
+        ta.Config(obs=ta.ObsConfig(
+            health_degraded_heartbeat_s=10.0,
+            health_unhealthy_heartbeat_s=5.0)).validate()
+    cfg = ta.Config(obs=ta.ObsConfig(enabled=True, http_port=0,
+                                     flight_capacity=32))
+    d = cfg.to_dict()
+    assert d["obs"]["enabled"] is True
+    cfg2 = ta.Config.from_dict(d)
+    assert cfg2.obs.flight_capacity == 32 and cfg2.obs.http_port == 0

@@ -1,0 +1,197 @@
+"""utils/profiling edge cases: ``_merge_busy`` + ``device_idle_from_trace``.
+
+Until now these were exercised only indirectly via bench.py's idle
+probe; the parsing/merging corner cases (empty traces, metadata-only
+traces, zero-duration events, overlapping device lanes, the CPU-thread
+fallback) get direct coverage here with synthetic Chrome traces.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from torchacc_tpu.utils.profiling import _merge_busy, device_idle_from_trace
+
+pytestmark = pytest.mark.obs
+
+
+# -- _merge_busy --------------------------------------------------------------
+
+def test_merge_busy_empty():
+    assert _merge_busy([]) == (0.0, 0.0)
+
+
+def test_merge_busy_single_interval():
+    busy, span = _merge_busy([(10.0, 25.0)])
+    assert busy == 15.0 and span == 15.0
+
+
+def test_merge_busy_disjoint_intervals_sum_and_hull():
+    busy, span = _merge_busy([(0.0, 10.0), (20.0, 30.0)])
+    assert busy == 20.0           # union measure: two 10us chunks
+    assert span == 30.0           # hull: 0 -> 30
+
+
+def test_merge_busy_overlapping_intervals_union():
+    # [0,10) and [5,15) overlap: union is [0,15), not 10+10
+    busy, span = _merge_busy([(0.0, 10.0), (5.0, 15.0)])
+    assert busy == 15.0 and span == 15.0
+
+
+def test_merge_busy_contained_interval():
+    # [3,5) sits inside [0,10): contributes nothing to the union
+    busy, span = _merge_busy([(0.0, 10.0), (3.0, 5.0)])
+    assert busy == 10.0 and span == 10.0
+
+
+def test_merge_busy_unsorted_input():
+    # the function sorts internally — order of arrival must not matter
+    busy, span = _merge_busy([(20.0, 30.0), (0.0, 10.0), (8.0, 12.0)])
+    assert busy == 22.0 and span == 30.0
+
+
+def test_merge_busy_touching_intervals_no_gap():
+    # [0,10) then [10,20): adjacent, zero idle between them
+    busy, span = _merge_busy([(0.0, 10.0), (10.0, 20.0)])
+    assert busy == 20.0 and span == 20.0
+
+
+# -- device_idle_from_trace ---------------------------------------------------
+
+def _write_trace(logdir, events):
+    os.makedirs(logdir, exist_ok=True)
+    path = os.path.join(logdir, "host.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def _meta(pid, name, tid=None, tname=None):
+    evs = [{"ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": name}}]
+    if tid is not None:
+        evs.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tid, "args": {"name": tname}})
+    return evs
+
+
+def test_idle_no_trace_files_returns_none(tmp_path):
+    assert device_idle_from_trace(str(tmp_path)) is None
+
+
+def test_idle_unreadable_trace_returns_none(tmp_path):
+    # a torn/truncated .gz (profiler killed mid-write) must yield None,
+    # not an exception — bench treats None as "no row"
+    p = os.path.join(str(tmp_path), "torn.trace.json.gz")
+    with open(p, "wb") as f:
+        f.write(b"\x1f\x8b\x08\x00garbage")
+    assert device_idle_from_trace(str(tmp_path)) is None
+
+
+def test_idle_metadata_only_trace_returns_none(tmp_path):
+    # metadata events but zero complete ('X') events -> no span -> None
+    _write_trace(str(tmp_path), _meta(7, "/device:TPU:0"))
+    assert device_idle_from_trace(str(tmp_path)) is None
+
+
+def test_idle_zero_duration_events_skipped(tmp_path):
+    # zero/negative-duration events carry no busy time; with nothing
+    # else on the lane there is no span and the result is None
+    evs = _meta(7, "/device:TPU:0") + [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 100.0, "dur": 0.0,
+         "name": "noop"},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 200.0, "name": "no_dur"},
+    ]
+    _write_trace(str(tmp_path), evs)
+    assert device_idle_from_trace(str(tmp_path)) is None
+
+
+def test_idle_device_plane_gap_sum(tmp_path):
+    # two ops with a 30us gap on one device lane: idle == gap
+    evs = _meta(7, "/device:TPU:0") + [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 10.0,
+         "name": "op1"},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 40.0, "dur": 10.0,
+         "name": "op2"},
+    ]
+    _write_trace(str(tmp_path), evs)
+    out = device_idle_from_trace(str(tmp_path))
+    assert out is not None
+    assert out["source"] == 1.0            # a real device plane
+    assert out["device_busy_ms"] == pytest.approx(0.020)
+    assert out["span_ms"] == pytest.approx(0.050)
+    assert out["device_idle_ms"] == pytest.approx(0.030)
+
+
+def test_idle_overlapping_device_lanes_union_merged(tmp_path):
+    # two device lanes whose ops overlap: busy is the UNION ([0,10) u
+    # [5,15) = 15us), so concurrent compute+comm never double-counts
+    evs = (_meta(7, "/device:TPU:0") + _meta(8, "/device:TPU:1") + [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 10.0,
+         "name": "compute"},
+        {"ph": "X", "pid": 8, "tid": 1, "ts": 5.0, "dur": 10.0,
+         "name": "collective"},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 25.0, "dur": 5.0,
+         "name": "tail"},
+    ])
+    _write_trace(str(tmp_path), evs)
+    out = device_idle_from_trace(str(tmp_path))
+    assert out["source"] == 1.0
+    assert out["device_busy_ms"] == pytest.approx(0.020)
+    assert out["device_idle_ms"] == pytest.approx(0.010)  # [15,25) gap
+
+
+def test_idle_host_events_excluded_when_device_plane_exists(tmp_path):
+    # host-lane events must not pollute the device gap-sum
+    evs = (_meta(7, "/device:TPU:0")
+           + _meta(1, "/host:CPU", tid=9, tname="tf_XLAEigen_worker") + [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 10.0,
+         "name": "op"},
+        {"ph": "X", "pid": 1, "tid": 9, "ts": 1000.0, "dur": 500.0,
+         "name": "host_busywork"},
+    ])
+    _write_trace(str(tmp_path), evs)
+    out = device_idle_from_trace(str(tmp_path))
+    assert out["source"] == 1.0
+    assert out["span_ms"] == pytest.approx(0.010)
+
+
+def test_idle_cpu_thread_fallback_flagged(tmp_path):
+    # no /device:* plane: the XLA:CPU execution threads stand in and
+    # the source flag says so (0.0)
+    evs = _meta(1, "/host:CPU", tid=9,
+                tname="tf_XLATfrtCpuClient_worker") + [
+        {"ph": "X", "pid": 1, "tid": 9, "ts": 0.0, "dur": 10.0,
+         "name": "op1"},
+        {"ph": "X", "pid": 1, "tid": 9, "ts": 20.0, "dur": 10.0,
+         "name": "op2"},
+    ]
+    _write_trace(str(tmp_path), evs)
+    out = device_idle_from_trace(str(tmp_path))
+    assert out is not None
+    assert out["source"] == 0.0
+    assert out["device_idle_ms"] == pytest.approx(0.010)
+
+
+def test_idle_newest_trace_wins(tmp_path):
+    # two trace files: the newer one is parsed
+    old = tmp_path / "old"
+    evs_old = _meta(7, "/device:TPU:0") + [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 1.0,
+         "name": "op"}]
+    _write_trace(str(tmp_path), evs_old)
+    os.utime(os.path.join(str(tmp_path), "host.trace.json.gz"),
+             (1_000_000, 1_000_000))
+    evs_new = _meta(7, "/device:TPU:0") + [
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 0.0, "dur": 10.0,
+         "name": "op1"},
+        {"ph": "X", "pid": 7, "tid": 1, "ts": 40.0, "dur": 10.0,
+         "name": "op2"}]
+    os.makedirs(str(old), exist_ok=True)
+    path = os.path.join(str(old), "new.trace.json.gz")
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": evs_new}, f)
+    out = device_idle_from_trace(str(tmp_path))
+    assert out["device_idle_ms"] == pytest.approx(0.030)

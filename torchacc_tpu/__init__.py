@@ -31,6 +31,7 @@ from torchacc_tpu.config import (
     EPConfig,
     FSDPConfig,
     MemoryConfig,
+    ObsConfig,
     PerfConfig,
     PPConfig,
     ResilienceConfig,
@@ -52,6 +53,7 @@ __all__ = [
     "PPConfig",
     "SPConfig",
     "EPConfig",
+    "ObsConfig",
     "PerfConfig",
     "ResilienceConfig",
     "accelerate",

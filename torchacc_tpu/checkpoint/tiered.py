@@ -65,6 +65,7 @@ from torchacc_tpu.errors import (
     CheckpointError,
     CheckpointNotFoundError,
 )
+from torchacc_tpu.obs import tracing
 from torchacc_tpu.resilience import coordination as coord
 from torchacc_tpu.resilience.chaos import failpoint
 from torchacc_tpu.utils.logger import logger
@@ -253,7 +254,8 @@ class TieredCheckpointManager:
         host = None
         try:
             import jax
-            host = jax.device_get(e.snap)
+            with tracing.span("ckpt/tier0_fetch", step=e.step):
+                host = jax.device_get(e.snap)
         except Exception as err:  # noqa: BLE001 - multi-host shards not
             # fully addressable here: no RAM tier for this step; tier 1
             # writes straight from the device snapshot via orbax's own
@@ -326,7 +328,8 @@ class TieredCheckpointManager:
         if self._mirror_dir is not None and coord.process_index() == 0:
             try:
                 failpoint("tiered.tier2", step=e.step)
-                self._mirror_step(e.step)
+                with tracing.span("ckpt/mirror", step=e.step):
+                    self._mirror_step(e.step)
                 with self._cond:
                     e.mirrored = True
                 counters.inc("mirror_writes")
@@ -349,7 +352,8 @@ class TieredCheckpointManager:
             raise CheckpointError(
                 f"tiered checkpoint step {e.step}: no writable source "
                 "(snapshot released before the tier-1 write)")
-        with self._io_lock:
+        with tracing.span("ckpt/tier1_commit", step=e.step), \
+                self._io_lock:
             inner = self._inner_mgr()
             if os.path.isdir(os.path.join(self._dir, str(e.step))):
                 # same label exists from a discarded timeline (a

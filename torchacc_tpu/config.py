@@ -498,6 +498,66 @@ class ServeConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Unified telemetry plane (torchacc_tpu/obs/, docs/observability.md).
+
+    Off (the default), nothing records, nothing serves, and the fit
+    trajectory is bitwise identical to a build without the package —
+    every seam is host-side and behind this one switch.  On, the
+    trainer/tiered-checkpoint/serving paths emit tracing spans into a
+    bounded buffer (Chrome-trace exportable), feed the streaming
+    histograms, publish gauges + health to the optional HTTP endpoint,
+    and arm the crash flight recorder.  bench.py --obs measures the
+    enabled hot-loop cost as ``telemetry_overhead_ms_per_step``.
+    """
+
+    enabled: bool = False
+    # record tracing spans (obs/tracing.py).  Only consulted while
+    # enabled; off = span() stays the shared no-op.
+    trace: bool = True
+    # completed spans retained in the in-process ring buffer (each is a
+    # small dict; 4096 spans ~ a few hundred trainer steps of history)
+    trace_buffer: int = 4096
+    # HTTP telemetry endpoint (obs/server.py): None = no server;
+    # 0 = bind an ephemeral port (read it back from obs.server.get());
+    # otherwise the literal port.  Serves /metrics (Prometheus text)
+    # and /healthz (ok|degraded|unhealthy JSON).
+    http_port: Optional[int] = None
+    http_host: str = "127.0.0.1"
+    # crash flight recorder (obs/flight.py): ring of recent step
+    # records + counter deltas, dumped as flight_<step>.json on every
+    # typed-error abort (SDCError / HangError / AnomalyError /
+    # QuarantinedHostError / BadBatchError / CheckpointError) and on
+    # preemption.
+    flight_recorder: bool = True
+    flight_capacity: int = 256
+    # where bundles land; None = the fit's checkpoint_dir or
+    # metrics_dir (in that order)
+    flight_dir: Optional[str] = None
+    # /healthz heartbeat thresholds: the watchdog heartbeat age at
+    # which the probe reports degraded / unhealthy.  Tune to a few
+    # step times; only consulted while a fit with a watchdog
+    # (resilience.step_deadline_s) is running.
+    health_degraded_heartbeat_s: float = 60.0
+    health_unhealthy_heartbeat_s: float = 300.0
+
+    def validate(self) -> None:
+        _check(self.trace_buffer >= 16,
+               "obs.trace_buffer must be >= 16")
+        _check(self.flight_capacity >= 8,
+               "obs.flight_capacity must be >= 8")
+        if self.http_port is not None:
+            _check(0 <= self.http_port <= 65535,
+                   "obs.http_port must be in [0, 65535] (0 = ephemeral)")
+        _check(self.health_degraded_heartbeat_s > 0,
+               "obs.health_degraded_heartbeat_s must be positive")
+        _check(self.health_unhealthy_heartbeat_s
+               >= self.health_degraded_heartbeat_s,
+               "obs.health_unhealthy_heartbeat_s must be >= "
+               "health_degraded_heartbeat_s")
+
+
+@dataclass
 class ResilienceConfig:
     """Fault tolerance: anomaly guards, retries, preemption handling.
 
@@ -776,6 +836,7 @@ class Config:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     perf: PerfConfig = field(default_factory=PerfConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     # Gradient accumulation micro-steps per optimizer step (non-PP path;
     # under PP the pipeline's num_micro_batches plays this role).
     grad_accum: int = 1
@@ -791,6 +852,7 @@ class Config:
         self.resilience.validate()
         self.perf.validate()
         self.serve.validate()
+        self.obs.validate()
         _check(self.grad_accum >= 1, "grad_accum must be >= 1")
         # quantized matmuls thread delayed-scaling state through the
         # non-pp forward paths only; the 1F1B/GPipe regions apply blocks
@@ -866,6 +928,7 @@ _TYPE_MAP = {
     "resilience": ResilienceConfig,
     "perf": PerfConfig,
     "serve": ServeConfig,
+    "obs": ObsConfig,
     "dp": DPConfig,
     "tp": TPConfig,
     "fsdp": FSDPConfig,

@@ -1,0 +1,37 @@
+"""Unified telemetry plane (docs/observability.md).
+
+One package, four seams, all host-side and all zero-cost when
+``ObsConfig.enabled`` is off (the fit trajectory is bitwise unchanged
+either way — nothing here touches device programs):
+
+- :mod:`~torchacc_tpu.obs.tracing` — nestable ``span()`` context
+  managers recorded into a bounded ring, exported as Chrome-trace /
+  Perfetto JSON on the same timeline viewers open ``jax.profiler``
+  traces with;
+- :mod:`~torchacc_tpu.obs.hist` — fixed log-bucket streaming
+  histograms (mergeable, p50/p95/p99) for step time, host/save blocked
+  time, serve TTFT and inter-token gaps;
+- :mod:`~torchacc_tpu.obs.server` — opt-in stdlib HTTP endpoint:
+  ``/metrics`` in Prometheus text (counters + gauges + histograms) and
+  ``/healthz`` (ok/degraded/unhealthy from watchdog heartbeat age,
+  consecutive guard anomalies, SDC/quarantine state) — the probe the
+  ROADMAP #3(b) supervisor daemon consumes;
+- :mod:`~torchacc_tpu.obs.flight` — a crash flight recorder: ring of
+  recent step records + counter deltas + span completions, dumped as
+  ``flight_<step>.json`` by every typed-error abort and preemption.
+
+``Config.obs`` (:class:`~torchacc_tpu.config.ObsConfig`) is the
+switch; ``Trainer.fit`` and ``ServeEngine`` wire themselves through
+:mod:`~torchacc_tpu.obs.runtime` when it is enabled.
+"""
+
+from torchacc_tpu.obs import flight, hist, tracing
+from torchacc_tpu.obs.tracing import record_span, span
+
+__all__ = [
+    "flight",
+    "hist",
+    "tracing",
+    "span",
+    "record_span",
+]

@@ -1,0 +1,191 @@
+"""Crash flight recorder: the last-N-steps postmortem bundle.
+
+When a run aborts with a typed error — ``SDCError``, ``HangError``,
+``AnomalyError``, ``QuarantinedHostError``, a preemption — the logs say
+what raised; they do not say what the last minute looked like.  The
+flight recorder keeps a bounded ring of recent step records (with the
+counter DELTAS each step contributed, so a retry burst is attributed to
+its step, not smeared over the run) and, at dump time, folds in the
+recent span completions (``obs/tracing.py``), the config snapshot, the
+quarantine file, and the error's typed fields into ONE JSON bundle:
+
+    <dump_dir>/flight_<step>.json
+
+— the artefact an operator (or the future supervisor) opens first.
+Dumps are strict JSON: non-finite floats serialise as ``null`` (same
+policy as ``MetricsWriter``) so every downstream consumer parses them.
+
+``Trainer.fit`` records every emitted step record and dumps on every
+typed-error exit + on preemption; anything else can call
+``flight.recorder.dump(...)`` directly.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from torchacc_tpu.utils.logger import logger
+
+_DEFAULT_CAPACITY = 256
+
+
+def json_safe(obj: Any) -> Any:
+    """Recursively convert ``obj`` into strict-JSON-serialisable data:
+    non-finite floats -> None, numpy scalars/arrays -> python, unknown
+    objects -> repr.  Shared by the flight bundle and anything else
+    that must never emit bare ``NaN``/``Infinity``."""
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [json_safe(v) for v in obj]
+    # numpy scalars / 0-d arrays (duck-typed: obs must not import numpy
+    # for the common path)
+    item = getattr(obj, "item", None)
+    if callable(item):
+        try:
+            if getattr(obj, "ndim", 0) == 0 or getattr(obj, "size", 2) == 1:
+                return json_safe(item())
+        except Exception:  # noqa: BLE001 - fall through to repr
+            pass
+    tolist = getattr(obj, "tolist", None)
+    if callable(tolist):
+        try:
+            return json_safe(tolist())
+        except Exception:  # noqa: BLE001
+            pass
+    return repr(obj)
+
+
+class FlightRecorder:
+    """Bounded ring of step records + context, dumped on abort."""
+
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
+        self._last_counters: Dict[str, int] = {}
+        self._context: Dict[str, Any] = {}
+        self.dump_dir: Optional[str] = None
+        self.last_dump_path: Optional[str] = None
+
+    def configure(self, capacity: Optional[int] = None,
+                  dump_dir: Optional[str] = None) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = deque(self._ring,
+                                   maxlen=max(int(capacity), 8))
+            if dump_dir is not None:
+                self.dump_dir = dump_dir
+
+    def set_context(self, key: str, value: Any) -> None:
+        """Attach long-lived context to every future bundle (config
+        snapshot, run dir, mesh shape...)."""
+        with self._lock:
+            self._context[key] = json_safe(value)
+
+    def record_step(self, step: int, record: Dict[str, Any]) -> None:
+        """Append one step record with the counter delta it contributed
+        (vs the previous recorded step)."""
+        from torchacc_tpu.utils.metrics import counters
+        snap = counters.snapshot()
+        with self._lock:
+            delta = {k: v - self._last_counters.get(k, 0)
+                     for k, v in snap.items()
+                     if v != self._last_counters.get(k, 0)}
+            self._last_counters = snap
+            self._ring.append({"step": int(step),
+                               "record": json_safe(record),
+                               "counter_delta": delta})
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        """Drop the ring, context and dump dir (tests / fresh runs)."""
+        with self._lock:
+            self._ring.clear()
+            self._last_counters = {}
+            self._context.clear()
+            self.dump_dir = None
+            self.last_dump_path = None
+
+    def dump(self, reason: str, *, step: Optional[int] = None,
+             error: Optional[BaseException] = None,
+             dump_dir: Optional[str] = None,
+             extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Write the postmortem bundle; returns its path (None when no
+        dump dir is configured or the write failed — a failing dump
+        must never mask the abort it documents)."""
+        from torchacc_tpu.obs import tracing
+        from torchacc_tpu.utils.metrics import counters
+        d = dump_dir or self.dump_dir
+        if not d:
+            logger.warning(
+                f"flight recorder: no dump dir configured — {reason} "
+                "bundle not written (set ObsConfig.flight_dir or pass "
+                "checkpoint_dir/metrics_dir to fit)")
+            return None
+        with self._lock:
+            records = list(self._ring)
+            context = dict(self._context)
+        if step is None and error is not None:
+            step = getattr(error, "step", None)
+        if step is None and records:
+            step = records[-1]["step"]
+        bundle: Dict[str, Any] = {
+            "reason": reason,
+            "step": step,
+            "time": time.time(),
+            "error": None,
+            "context": context,
+            "counters": counters.snapshot(),
+            "records": records,
+            "spans": json_safe(tracing.snapshot()),
+        }
+        if error is not None:
+            fields = {
+                k: json_safe(v) for k, v in vars(error).items()
+                if not k.startswith("_")
+            }
+            bundle["error"] = {
+                "type": type(error).__name__,
+                "message": str(error),
+                "fields": fields,
+            }
+        if extra:
+            bundle["extra"] = json_safe(extra)
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{step if step is not None else 'unknown'}"
+                   f".json")
+            tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                # strict JSON by construction: everything above went
+                # through json_safe, and allow_nan=False enforces it
+                json.dump(bundle, f, allow_nan=False)
+            os.replace(tmp, path)
+        except (OSError, ValueError) as e:
+            logger.warning(
+                f"flight recorder: could not write {reason} bundle "
+                f"({e!r})")
+            return None
+        self.last_dump_path = path
+        logger.warning(
+            f"flight recorder: {reason} postmortem bundle written to "
+            f"{path} ({len(records)} step records, step {step})")
+        return path
+
+
+#: The process-wide instance (mirrors ``utils.metrics.counters``).
+recorder = FlightRecorder()

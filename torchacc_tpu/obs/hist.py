@@ -1,0 +1,214 @@
+"""Streaming histograms: fixed log buckets, mergeable, p50/p95/p99.
+
+``utils/metrics.Counters`` answers "how many"; these answer "how slow,
+and how is it distributed" without storing samples: a fixed geometric
+bucket ladder (every instance shares the same bounds unless constructed
+otherwise, so histograms merge by adding counts — the multi-host /
+multi-window story), constant memory, lock-guarded single-increment
+observe.  Percentiles interpolate linearly inside the landed bucket —
+resolution is the bucket ratio (1.5x by default), exactly the
+coarseness Prometheus histogram_quantile has, and exported in the same
+cumulative-``le`` text format (:meth:`Histogram.prometheus_lines`).
+
+A process-wide registry mirrors ``metrics.counters``: subsystems call
+``hist.observe("step_time_ms", dt)`` and the telemetry server
+(``obs/server.py``) exports whatever exists.  Observation is gated on
+the module ``enabled`` flag (set by ``obs.configure``) so the hot loop
+pays nothing while observability is off.
+
+Registered series (one home; docs/observability.md has the table):
+``step_time_ms``, ``host_blocked_ms``, ``save_blocked_ms`` (trainer),
+``serve_ttft_ms``, ``serve_token_gap_ms`` (serving engine).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence
+
+# Default ladder: 0.05 ms .. ~17 min in 1.5x steps (48 finite bounds).
+# Covers a Pallas kernel dispatch and a stuck orbax write on the same
+# axis; everything above the last bound lands in the +Inf bucket.
+_DEFAULT_START = 0.05
+_DEFAULT_FACTOR = 1.5
+_DEFAULT_COUNT = 48
+
+
+def default_bounds() -> List[float]:
+    b, v = [], _DEFAULT_START
+    for _ in range(_DEFAULT_COUNT):
+        b.append(v)
+        v *= _DEFAULT_FACTOR
+    return b
+
+
+class Histogram:
+    """Fixed-bucket streaming histogram.
+
+    ``bounds`` are the finite upper bucket edges (ascending); bucket i
+    counts observations ``<= bounds[i]`` exclusive of lower buckets,
+    with one extra overflow (+Inf) bucket at the end.  Thread-safe.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max",
+                 "_lock")
+
+    def __init__(self, bounds: Optional[Sequence[float]] = None):
+        self.bounds = list(bounds) if bounds is not None else \
+            default_bounds()
+        if self.bounds != sorted(self.bounds) or len(self.bounds) < 1:
+            raise ValueError("histogram bounds must be ascending and "
+                             "non-empty")
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        # non-finite values never land: NaN has no bucket, one +/-inf
+        # would corrupt sum/mean (and -inf the min + every percentile)
+        # for the rest of the process
+        if v != v or v in (float("inf"), float("-inf")):
+            return
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += v
+            if v < self.min:
+                self.min = v
+            if v > self.max:
+                self.max = v
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into self (same bounds required) — the
+        cross-host / cross-window aggregation primitive."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different "
+                             "bucket bounds")
+        with other._lock:
+            oc = list(other.counts)
+            ocount, osum = other.count, other.sum
+            omin, omax = other.min, other.max
+        with self._lock:
+            self.counts = [a + b for a, b in zip(self.counts, oc)]
+            self.count += ocount
+            self.sum += osum
+            self.min = min(self.min, omin)
+            self.max = max(self.max, omax)
+        return self
+
+    def percentile(self, q: float) -> float:
+        """Estimate the q-th percentile (q in [0, 100]) by linear
+        interpolation inside the landed bucket; 0.0 when empty."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+            hi_obs = self.max
+        if total == 0:
+            return 0.0
+        rank = max(q / 100.0 * total, 1e-12)
+        cum = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            prev_cum, cum = cum, cum + c
+            if cum + 1e-12 >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else max(hi_obs, lo))
+                if hi <= lo:
+                    return float(hi)
+                frac = (rank - prev_cum) / c
+                return float(lo + (hi - lo) * frac)
+        return float(hi_obs)
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def snapshot(self) -> Dict[str, float]:
+        """Summary scalars (the metrics.jsonl / bench payload view)."""
+        with self._lock:
+            count, s = self.count, self.sum
+        return {
+            "count": count,
+            "sum": s,
+            "mean": (s / count) if count else 0.0,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+    def prometheus_lines(self, name: str) -> List[str]:
+        """Prometheus text-format lines (cumulative ``le`` buckets +
+        ``_sum`` + ``_count``) for metric ``name``."""
+        with self._lock:
+            counts = list(self.counts)
+            total, s = self.count, self.sum
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for bound, c in zip(self.bounds, counts[:-1]):
+            cum += c
+            lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{name}_sum {s:g}")
+        lines.append(f"{name}_count {total}")
+        return lines
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counts = [0] * (len(self.bounds) + 1)
+            self.count = 0
+            self.sum = 0.0
+            self.min = float("inf")
+            self.max = float("-inf")
+
+
+# -- process-wide registry ----------------------------------------------------
+
+_enabled = False
+_lock = threading.Lock()
+_registry: Dict[str, Histogram] = {}
+
+
+def configure(enabled: Optional[bool] = None) -> None:
+    global _enabled
+    if enabled is not None:
+        _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def get(name: str) -> Histogram:
+    """The named registry histogram, created on first use."""
+    with _lock:
+        h = _registry.get(name)
+        if h is None:
+            h = _registry[name] = Histogram()
+        return h
+
+
+def observe(name: str, value: float) -> None:
+    """Hot-path entry: one bucket increment when observability is on,
+    one ``if`` when it is off."""
+    if not _enabled:
+        return
+    get(name).observe(value)
+
+
+def all_histograms() -> Dict[str, Histogram]:
+    with _lock:
+        return dict(_registry)
+
+
+def reset() -> None:
+    """Drop every registered histogram (tests)."""
+    with _lock:
+        _registry.clear()

@@ -1,0 +1,242 @@
+"""Opt-in HTTP telemetry: ``/metrics`` (Prometheus text) + ``/healthz``.
+
+Stdlib-only (``http.server`` on a daemon thread): the machine-readable
+surface ROADMAP #3(b)'s supervisor daemon needs — a liveness/health
+probe it can poll without parsing logs, and the counter/gauge/histogram
+series a Prometheus scraper (or ``curl | grep``) reads during a live
+run.  The server is a process-wide singleton (:func:`start` /
+:func:`stop`): the trainer and the serving engine both publish into the
+module-level gauge/health registries regardless of which one started
+it, so a co-located fit + serve process exposes ONE endpoint.
+
+``/metrics`` — Prometheus text exposition (0.0.4):
+
+- every non-zero ``utils.metrics.counters`` entry as
+  ``torchacc_<name>_total`` (counter);
+- every registered gauge (``register_gauge``) as ``torchacc_<name>``,
+  value read at scrape time from its callable (a raising/broken gauge
+  is skipped, never a 500);
+- every ``obs/hist.py`` registry histogram as ``torchacc_<name>`` with
+  cumulative ``le`` buckets.
+
+``/healthz`` — JSON ``{"status": ok|degraded|unhealthy, "checks":
+{...}}``, the worst status over the registered health providers
+(``register_health``); HTTP 200 for ok/degraded, 503 for unhealthy —
+the exact probe semantics a supervisor/load-balancer consumes (degraded
+keeps traffic, unhealthy sheds it).  With no providers registered
+(nothing running) the status is ``ok``.
+
+Providers registered by the framework (docs/observability.md):
+watchdog heartbeat age vs the ObsConfig thresholds, consecutive
+guard anomalies vs ``max_consecutive_anomalies``, and SDC mismatch /
+quarantine state for the run dir.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from torchacc_tpu.obs import hist as _hist
+from torchacc_tpu.utils.logger import logger
+
+# -- gauge / health registries ------------------------------------------------
+
+_reg_lock = threading.Lock()
+_gauges: Dict[str, Tuple[Callable[[], float], str]] = {}
+_health: Dict[str, Callable[[], Tuple[str, Optional[str]]]] = {}
+
+_STATUS_RANK = {"ok": 0, "degraded": 1, "unhealthy": 2}
+
+
+def register_gauge(name: str, fn: Callable[[], float],
+                   help: str = "") -> None:
+    """Publish a gauge: ``fn`` is called at scrape time.  Re-registering
+    a name replaces it (the newest owner wins)."""
+    with _reg_lock:
+        _gauges[name] = (fn, help)
+
+
+def unregister_gauge(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove a gauge.  With ``fn`` given, remove ONLY if ``name`` is
+    still bound to that exact callable — a closed older session must
+    not delete a newer session's replacement registration (the
+    last-owner-wins policy cuts both ways)."""
+    with _reg_lock:
+        if fn is None or _gauges.get(name, (None, ""))[0] is fn:
+            _gauges.pop(name, None)
+
+
+def register_health(name: str,
+                    fn: Callable[[], Tuple[str, Optional[str]]]) -> None:
+    """Publish a health check: ``fn`` returns ``(status, reason)`` with
+    status in ok|degraded|unhealthy (reason may be None when ok)."""
+    with _reg_lock:
+        _health[name] = fn
+
+
+def unregister_health(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove a health check (same ownership rule as
+    :func:`unregister_gauge`)."""
+    with _reg_lock:
+        if fn is None or _health.get(name) is fn:
+            _health.pop(name, None)
+
+
+def clear_registries() -> None:
+    """Drop every gauge + health provider (tests)."""
+    with _reg_lock:
+        _gauges.clear()
+        _health.clear()
+
+
+def health() -> Dict[str, object]:
+    """Aggregate health: worst status over providers, with per-check
+    detail.  A provider that raises reports ``degraded`` (a broken
+    check is itself a degradation, but must not fabricate an abort)."""
+    with _reg_lock:
+        providers = dict(_health)
+    checks: Dict[str, Dict[str, Optional[str]]] = {}
+    worst = "ok"
+    for name, fn in sorted(providers.items()):
+        try:
+            status, reason = fn()
+            if status not in _STATUS_RANK:
+                status, reason = "degraded", f"bad status {status!r}"
+        except Exception as e:  # noqa: BLE001 - probe must answer
+            status, reason = "degraded", f"health provider raised: {e!r}"
+        checks[name] = {"status": status, "reason": reason}
+        if _STATUS_RANK[status] > _STATUS_RANK[worst]:
+            worst = status
+    return {"status": worst, "checks": checks}
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "torchacc_" + _NAME_RE.sub("_", name)
+
+
+def prometheus_text() -> str:
+    """The full ``/metrics`` payload (also the seam tests/bench parse
+    without going through a socket)."""
+    from torchacc_tpu.utils.metrics import counters
+    lines: List[str] = []
+    for name, value in counters.snapshot().items():
+        m = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {value}")
+    with _reg_lock:
+        gauges = dict(_gauges)
+    for name, (fn, help_text) in sorted(gauges.items()):
+        try:
+            value = float(fn())
+        except Exception as e:  # noqa: BLE001 - one dead gauge must not
+            # take the whole scrape down
+            logger.debug(f"gauge {name} read failed: {e!r}")
+            continue
+        m = _prom_name(name)
+        if help_text:
+            lines.append(f"# HELP {m} {help_text}")
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {value:g}")
+    for name, h in sorted(_hist.all_histograms().items()):
+        lines.extend(h.prometheus_lines(_prom_name(name)))
+    return "\n".join(lines) + "\n"
+
+
+# -- the server ---------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):  # noqa: A003 - BaseHTTP API
+        pass                            # scrapes must not spam stderr
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTP API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                body = prometheus_text().encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path in ("/healthz", "/health"):
+                h = health()
+                code = 503 if h["status"] == "unhealthy" else 200
+                self._send(code, json.dumps(h).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found: try /metrics or /healthz\n",
+                           "text/plain")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-response
+
+
+class TelemetryServer:
+    """The HTTP endpoint on a daemon thread.  ``port=0`` binds an
+    ephemeral port — read the real one from ``.port``."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="obs-telemetry")
+        self._thread.start()
+        logger.info(
+            f"telemetry server on http://{host}:{self.port} "
+            f"(/metrics Prometheus text, /healthz JSON)")
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_server_lock = threading.Lock()
+_server: Optional[TelemetryServer] = None
+
+
+def start(port: int = 0, host: str = "127.0.0.1") -> TelemetryServer:
+    """Start (or return) the process-wide server.  A second caller gets
+    the existing instance — its port wins; the request is logged when
+    it asked for a different one."""
+    global _server
+    with _server_lock:
+        if _server is not None:
+            if port not in (0, _server.port) or host != _server.host:
+                logger.warning(
+                    f"telemetry server already on "
+                    f"{_server.host}:{_server.port}; ignoring request "
+                    f"for {host}:{port}")
+            return _server
+        _server = TelemetryServer(port=port, host=host)
+        return _server
+
+
+def get() -> Optional[TelemetryServer]:
+    return _server
+
+
+def stop() -> None:
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.close()
+            _server = None

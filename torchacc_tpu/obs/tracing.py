@@ -1,0 +1,239 @@
+"""Structured tracing spans: nestable, thread-aware, Chrome-trace export.
+
+The trainer's hot loop, the tiered-checkpoint trickle and the serving
+engine all run concurrent host-side state machines; ``metrics.jsonl``
+scalars say *that* something was slow, never *where the time went*.
+Spans close the gap: a ``span("name", **attrs)`` context manager records
+one completed interval into a bounded in-process ring buffer, with
+parent ids propagated through a per-thread stack (the tiered writer
+thread's spans nest under its own stack, never under the trainer's),
+and the whole buffer exports as Chrome-trace / Perfetto JSON
+(``export_chrome_trace``) so spans land on the same timeline viewers
+that already open ``jax.profiler`` traces.
+
+Zero-cost when disabled: ``span()`` returns a shared no-op context
+manager — one dict lookup and one ``if`` per call site, no allocation,
+no lock — so instrumentation stays in the hot path unconditionally and
+``ObsConfig.enabled`` is the only switch (bench.py --obs measures the
+residual as ``telemetry_overhead_ms_per_step``).
+
+Span-name registry (one home; docs/observability.md has the table):
+
+==================  =========================================================
+span                emitted by
+==================  =========================================================
+train/dispatch      Trainer.step — enqueue of one jitted train step
+train/resolve       Trainer.resolve_oldest — lagged readback of step N-k
+train/verdict       inside resolve — guard + SDC verdict fetch/compare
+train/save          Trainer.fit — snapshot + checkpoint hand-off on a
+                    writing step
+ckpt/tier0_fetch    tiered writer thread — device -> host RAM fetch
+ckpt/tier1_commit   tiered writer/pump — orbax commit-marker write
+ckpt/mirror         tiered writer — tier-2 mirror copy
+serve/queue         admission — submit -> slot (recorded at admit time)
+serve/admit         Scheduler.admit — block reservation + prefix match
+serve/prefill       Scheduler — one prefill chunk (single or batched)
+serve/decode        Scheduler._decode_once — one batched decode dispatch
+serve/deliver       Scheduler._resolve_one — token readback + stream
+                    callbacks for one ring entry
+==================  =========================================================
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_DEFAULT_BUFFER = 4096
+
+_enabled = False
+_buf: "deque[Dict[str, Any]]" = deque(maxlen=_DEFAULT_BUFFER)
+_ids = itertools.count(1)
+_tls = threading.local()
+
+# perf_counter -> wall-clock anchor, taken once at import: exported
+# timestamps are (wall0 + (t - perf0)) so every thread/process shares
+# one absolute timeline (the same convention the profiler's Chrome
+# traces use for their ts fields).
+_WALL0 = time.time()
+_PERF0 = time.perf_counter()
+
+
+def configure(enabled: Optional[bool] = None,
+              buffer_size: Optional[int] = None) -> None:
+    """Flip tracing on/off and/or resize the ring buffer (resizing
+    rebuilds the deque, keeping the newest entries that fit)."""
+    global _enabled, _buf
+    if buffer_size is not None and buffer_size != _buf.maxlen:
+        _buf = deque(_buf, maxlen=max(int(buffer_size), 16))
+    if enabled is not None:
+        _enabled = bool(enabled)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def _stack() -> List[int]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current_span_id() -> Optional[int]:
+    """Innermost open span id on THIS thread (None outside any span) —
+    the hook for explicit cross-thread parent linking."""
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
+
+
+class _NullSpan:
+    """The disabled-path singleton: enter/exit do nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "id", "parent", "_t0")
+
+    def __init__(self, name: str, attrs: Dict[str, Any],
+                 parent: Optional[int]):
+        self.name = name
+        self.attrs = attrs
+        self.id = next(_ids)
+        self.parent = parent
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> None:
+        """Attach attributes after entry (e.g. a result computed
+        inside the span)."""
+        self.attrs.update(attrs)
+
+    def __enter__(self) -> "_Span":
+        st = _stack()
+        if self.parent is None and st:
+            self.parent = st[-1]
+        st.append(self.id)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        st = _stack()
+        if st and st[-1] == self.id:
+            st.pop()
+        _buf.append({
+            "name": self.name,
+            "t0": self._t0,
+            "dur": t1 - self._t0,
+            "tid": threading.get_ident(),
+            "thread": threading.current_thread().name,
+            "id": self.id,
+            "parent": self.parent,
+            "attrs": self.attrs,
+        })
+        return False
+
+
+def span(name: str, *, parent: Optional[int] = None, **attrs):
+    """Nestable tracing span.  ``parent`` overrides the thread-stack
+    parent (cross-thread linking: pass :func:`current_span_id` captured
+    on the submitting thread).  No-op (shared singleton, no allocation)
+    while tracing is disabled."""
+    if not _enabled:
+        return _NULL
+    return _Span(name, attrs, parent)
+
+
+def record_span(name: str, start: float, end: float, *,
+                parent: Optional[int] = None, **attrs) -> None:
+    """Record an already-measured interval (``start``/``end`` are
+    ``time.perf_counter`` values) — for durations whose start predates
+    the call site, like a request's queue wait recorded at admission."""
+    if not _enabled:
+        return
+    _buf.append({
+        "name": name,
+        "t0": float(start),
+        "dur": max(float(end) - float(start), 0.0),
+        "tid": threading.get_ident(),
+        "thread": threading.current_thread().name,
+        "id": next(_ids),
+        "parent": parent,
+        "attrs": attrs,
+    })
+
+
+def snapshot(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Completed spans, oldest first (``n``: only the newest n)."""
+    spans = list(_buf)
+    if n is not None:
+        spans = spans[-n:]
+    return spans
+
+
+def clear() -> None:
+    _buf.clear()
+
+
+def chrome_trace_events(spans: Optional[List[Dict[str, Any]]] = None
+                        ) -> List[Dict[str, Any]]:
+    """The span buffer as Chrome-trace ``traceEvents`` (``ph: "X"``
+    complete events, ts/dur in microseconds on the wall-clock anchor,
+    span/parent ids in ``args``) plus thread-name metadata events."""
+    spans = snapshot() if spans is None else spans
+    events: List[Dict[str, Any]] = []
+    seen_tids = {}
+    for s in spans:
+        seen_tids.setdefault(s["tid"], s.get("thread", ""))
+    for tid, tname in sorted(seen_tids.items()):
+        events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                       "tid": tid, "args": {"name": tname or str(tid)}})
+    events.append({"ph": "M", "name": "process_name", "pid": 1,
+                   "args": {"name": "torchacc_tpu.obs"}})
+    for s in spans:
+        args = dict(s["attrs"])
+        args["span_id"] = s["id"]
+        if s["parent"] is not None:
+            args["parent_id"] = s["parent"]
+        events.append({
+            "ph": "X",
+            "name": s["name"],
+            "cat": s["name"].split("/", 1)[0],
+            "pid": 1,
+            "tid": s["tid"],
+            "ts": (_WALL0 + (s["t0"] - _PERF0)) * 1e6,
+            "dur": s["dur"] * 1e6,
+            "args": args,
+        })
+    return events
+
+
+def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+    """The whole buffer as a Chrome-trace JSON object (Perfetto and
+    chrome://tracing open it directly; merge its ``traceEvents`` with a
+    ``jax.profiler`` trace's to see host spans against device lanes).
+    ``path`` additionally writes the JSON to a file."""
+    doc = {"traceEvents": chrome_trace_events(),
+           "displayTimeUnit": "ms"}
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    return doc

@@ -147,6 +147,13 @@ class ServeEngine:
         self._completed = 0
         self._agg = self._fresh_agg()
         self._evict_base = 0                 # pool.evictions at window start
+        # telemetry session (docs/observability.md): queue/KV-pool
+        # gauges on the HTTP endpoint + TTFT/inter-token histograms.
+        # Off by default; never touches the token path.
+        self._obs = None
+        if getattr(config, "obs", None) is not None and config.obs.enabled:
+            from torchacc_tpu.obs.runtime import ServeObs
+            self._obs = ServeObs(self, config.obs)
 
     @staticmethod
     def _fresh_agg() -> Dict:
@@ -450,6 +457,8 @@ class ServeEngine:
                 a["deadline_total"] += 1
                 a["deadline_miss"] += (1 if seq.t_finish > seq.deadline
                                        else 0)
+            if self._obs is not None:
+                self._obs.on_request_done(seq)
             if self._metrics is not None:
                 r = self.result(seq.sid)
                 rec = {
@@ -552,6 +561,9 @@ class ServeEngine:
     def close(self) -> None:
         self.scheduler.drain()
         self._drain_events()
+        if self._obs is not None:
+            self._obs.close()
+            self._obs = None
         if self._metrics is not None:
             self._metrics.close()
         if self._queue:

@@ -67,6 +67,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchacc_tpu.obs import tracing
 from torchacc_tpu.ops.paged_attention import paged_attention
 from torchacc_tpu.serve.kv_cache import (
     BlockPool,
@@ -611,6 +612,30 @@ class Scheduler:
         retries next iteration).  With the prefix cache on, the longest
         token-hash-chain match replaces that many fresh blocks with
         refcounted shared ones and prefill starts past them."""
+        if not tracing.enabled():
+            return self._admit_impl(seq)
+        t0 = time.perf_counter()
+        ok = self._admit_impl(seq)
+        # spans only for SUCCESSFUL admissions: a saturated engine
+        # re-attempts its queue head every iteration, and one
+        # admitted=False span per retry would evict the useful spans
+        # from the bounded ring exactly when an operator exports it
+        # (failed-admission pressure is visible as serve_queue_depth
+        # + kv_pool_free_blocks instead)
+        if ok:
+            now = time.perf_counter()
+            tracing.record_span("serve/admit", t0, now, sid=seq.sid,
+                                cached_tokens=seq.cached_tokens)
+            if seq.t_submit:
+                # the queue-wait interval, recorded at the only moment
+                # both endpoints are known (submit -> slot admission)
+                tracing.record_span(
+                    "serve/queue",
+                    now - max(seq.t_admit - seq.t_submit, 0.0), now,
+                    sid=seq.sid)
+        return ok
+
+    def _admit_impl(self, seq: Sequence) -> bool:
         slot = self.free_slot()
         if slot is None:
             return False
@@ -755,10 +780,12 @@ class Scheduler:
             chunk = np.pad(chunk, (0, c - n_valid))
         pools = (self.k_pools, self.v_pools)
         final = (t0 + n_valid) >= seq.prompt_len
-        pools, last_logits = self.decoder._prefill(
-            self.params, pools, jnp.asarray(self.tables[seq.slot]),
-            jnp.asarray(t0, jnp.int32), jnp.asarray(chunk, jnp.int32),
-            jnp.asarray(n_valid, jnp.int32), final)
+        with tracing.span("serve/prefill", sid=seq.sid, t0=t0,
+                          tokens=n_valid, batched=False):
+            pools, last_logits = self.decoder._prefill(
+                self.params, pools, jnp.asarray(self.tables[seq.slot]),
+                jnp.asarray(t0, jnp.int32), jnp.asarray(chunk, jnp.int32),
+                jnp.asarray(n_valid, jnp.int32), final)
         self.k_pools, self.v_pools = pools
         seq.prefilled += n_valid
         self.seq_lens[seq.slot] = seq.prefilled
@@ -788,9 +815,12 @@ class Scheduler:
             n_valids[r] = n
             taken.append(n)
         pools = (self.k_pools, self.v_pools)
-        pools, logits = self.decoder._prefill_batch(
-            self.params, pools, jnp.asarray(tables), jnp.asarray(t0s),
-            jnp.asarray(toks), jnp.asarray(n_valids))
+        with tracing.span("serve/prefill", batched=True,
+                          sids=[s.sid for s in seqs],
+                          tokens=int(sum(taken))):
+            pools, logits = self.decoder._prefill_batch(
+                self.params, pools, jnp.asarray(tables), jnp.asarray(t0s),
+                jnp.asarray(toks), jnp.asarray(n_valids))
         self.k_pools, self.v_pools = pools
         for r, seq in enumerate(seqs):
             seq.prefilled += taken[r]
@@ -847,10 +877,12 @@ class Scheduler:
         tables, active, temp, top_k, top_p = self._dev_stable_arrays()
         all_greedy = bool((self.temp[self.active] <= 0.0).all())
         pools = (self.k_pools, self.v_pools)
-        pools, self.carry, toks = self.decoder._decode(
-            self.params, pools, self.carry,
-            tables, jnp.asarray(self.seq_lens),
-            active, temp, top_k, top_p, all_greedy)
+        with tracing.span("serve/decode", iter=self._iter,
+                          slots=len(snapshot)):
+            pools, self.carry, toks = self.decoder._decode(
+                self.params, pools, self.carry,
+                tables, jnp.asarray(self.seq_lens),
+                active, temp, top_k, top_p, all_greedy)
         self.k_pools, self.v_pools = pools
         # host mirror: every active slot banked one more token
         self.seq_lens[self.active] += 1
@@ -922,18 +954,21 @@ class Scheduler:
 
     def _resolve_one(self) -> None:
         entry = self._ring.popleft()
-        if self.blocked is not None:         # the (only) blocking fetch
-            with self.blocked.blocked():
+        # stream-delivery span: token readback (the lagged blocking
+        # fetch) + per-request recording incl. on_token callbacks
+        with tracing.span("serve/deliver", kind=entry.kind):
+            if self.blocked is not None:     # the (only) blocking fetch
+                with self.blocked.blocked():
+                    toks = np.asarray(entry.tokens)
+            else:
                 toks = np.asarray(entry.tokens)
-        else:
-            toks = np.asarray(entry.tokens)
-        now = time.monotonic()
-        if entry.kind == "first":
-            self._record(entry.seq, int(toks), now)
-        else:
-            for slot, seq in entry.slots:
-                self._record(seq, int(toks[slot]), now)
-            self._resolved = entry.iter_idx + 1
+            now = time.monotonic()
+            if entry.kind == "first":
+                self._record(entry.seq, int(toks), now)
+            else:
+                for slot, seq in entry.slots:
+                    self._record(seq, int(toks[slot]), now)
+                self._resolved = entry.iter_idx + 1
         self._release_matured()
 
     def drain(self) -> None:

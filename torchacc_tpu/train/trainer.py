@@ -24,6 +24,7 @@ See docs/performance.md for the guarantee-vs-latency table.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -33,7 +34,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from torchacc_tpu.config import Config
-from torchacc_tpu.errors import TrainerStateError
+from torchacc_tpu.errors import TorchAccTPUError, TrainerStateError
+from torchacc_tpu.obs import tracing
 from torchacc_tpu.models.axes import param_axes as resolve_param_axes
 from torchacc_tpu.models.transformer import loss_sum_count
 from torchacc_tpu.parallel.sharding import (
@@ -217,6 +219,11 @@ class Trainer:
         # math).  Compiled lazily on the first post-save step.
         self._train_step_nodonate = None
         self._no_donate_once = False
+        # telemetry session state (obs/runtime.FitObs): set by fit()
+        # while a run is live; _watchdog is published for the heartbeat
+        # gauge/health provider
+        self._obs_fit = None
+        self._watchdog = None
         self._metrics_sharding = NamedSharding(self.mesh, PartitionSpec())
 
     def _batch_shardings(self, batch) -> Dict[str, Any]:
@@ -869,8 +876,9 @@ class Trainer:
                 self._train_step_nodonate = self._build_train_step(
                     batch, donate=False)
             fn = self._train_step_nodonate
-        with jax.sharding.set_mesh(self.mesh):
-            out = fn(*args)
+        with tracing.span("train/dispatch", step=si):
+            with jax.sharding.set_mesh(self.mesh):
+                out = fn(*args)
         if self._guard_on:
             self.state, self._guard_state, metrics = out
         else:
@@ -926,23 +934,32 @@ class Trainer:
         if not self._inflight:
             return None
         e = self._inflight.popleft()
-        if self._guard_on:
-            # the abort guarantee costs one scalar fetch per resolved
-            # step (see ResilienceConfig); raises AnomalyError with a
-            # diagnosis once max_consecutive_anomalies is reached
-            with self.blocked.blocked():
-                self._guard_monitor.observe(e.step, e.metrics)
-        if self._sdc_on and (e.sdc_check or e.sdc_spot):
-            with self.blocked.blocked():
-                digests = jax.device_get(e.digests)
-            # verdict from replicated data — identical on every
-            # process, so any raise (and any arbiter re-execution, a
-            # collective) happens in lockstep pod-wide: every process
-            # resolves at the same loop point because dispatch_depth is
-            # config, not discovered
-            self._sdc_monitor.observe(
-                e.step, digests,
-                check=e.sdc_check, spot=e.sdc_spot, recompute=e.rerun)
+        with tracing.span("train/resolve", step=e.step):
+            if self._guard_on or (self._sdc_on
+                                  and (e.sdc_check or e.sdc_spot)):
+                verdict_span = tracing.span("train/verdict", step=e.step)
+            else:
+                verdict_span = contextlib.nullcontext()
+            with verdict_span:
+                if self._guard_on:
+                    # the abort guarantee costs one scalar fetch per
+                    # resolved step (see ResilienceConfig); raises
+                    # AnomalyError with a diagnosis once
+                    # max_consecutive_anomalies is reached
+                    with self.blocked.blocked():
+                        self._guard_monitor.observe(e.step, e.metrics)
+                if self._sdc_on and (e.sdc_check or e.sdc_spot):
+                    with self.blocked.blocked():
+                        digests = jax.device_get(e.digests)
+                    # verdict from replicated data — identical on every
+                    # process, so any raise (and any arbiter
+                    # re-execution, a collective) happens in lockstep
+                    # pod-wide: every process resolves at the same loop
+                    # point because dispatch_depth is config, not
+                    # discovered
+                    self._sdc_monitor.observe(
+                        e.step, digests, check=e.sdc_check,
+                        spot=e.sdc_spot, recompute=e.rerun)
         # the verdict is recorded — release the digest matrix and the
         # rerun closure (which captures a state-sized arbiter snapshot
         # at dp<=2) NOW, not when the entry itself dies: last_resolved
@@ -1118,7 +1135,43 @@ class Trainer:
         return params
 
     # -- high-level loop ----------------------------------------------------
-    def fit(
+    def fit(self, loader, *, checkpoint_dir: Optional[str] = None,
+            metrics_dir: Optional[str] = None, **kwargs):
+        """Run the training loop — see :meth:`_fit_inner` for the full
+        parameter/semantics documentation (this wrapper adds only the
+        telemetry session).
+
+        With ``config.obs.enabled`` (docs/observability.md) the run is
+        wrapped in a telemetry session: gauges + health providers
+        registered for the HTTP endpoint, step/blocked-time histograms
+        fed, and — on ANY typed-error exit (SDCError, HangError,
+        AnomalyError, QuarantinedHostError, BadBatchError,
+        CheckpointError...) — a flight-recorder postmortem bundle
+        ``flight_<step>.json`` written to ``obs.flight_dir`` (default:
+        the checkpoint/metrics dir) before the error propagates.
+        Disabled (the default), this delegates straight through and
+        the trajectory is bitwise unchanged."""
+        obs_cfg = getattr(self.config, "obs", None)
+        if obs_cfg is None or not obs_cfg.enabled:
+            self._obs_fit = None
+            return self._fit_inner(loader, checkpoint_dir=checkpoint_dir,
+                                   metrics_dir=metrics_dir, **kwargs)
+        from torchacc_tpu.obs.runtime import FitObs
+        fo = FitObs(self, obs_cfg, run_dir=checkpoint_dir or metrics_dir)
+        self._obs_fit = fo
+        try:
+            return self._fit_inner(loader, checkpoint_dir=checkpoint_dir,
+                                   metrics_dir=metrics_dir, **kwargs)
+        except TorchAccTPUError as e:
+            # the postmortem bundle rides the abort, never replaces it
+            # (a failing dump is logged inside and returns None)
+            fo.on_abort(e)
+            raise
+        finally:
+            fo.close()
+            self._obs_fit = None
+
+    def _fit_inner(
         self,
         loader,
         *,
@@ -1378,6 +1431,9 @@ class Trainer:
             # the same stall twice (two dumps, two counter increments)
             fetch_deadline = (None if res_cfg.loader_deadline_s
                               else res_cfg.step_deadline_s)
+        # published for the telemetry session's heartbeat gauge/health
+        # provider (obs/runtime.py); cleared in the finally below
+        self._watchdog = wd
         history = []
         t0 = _time.perf_counter()
         t_prev, s_prev = t0, start_step
@@ -1478,6 +1534,10 @@ class Trainer:
             for k, v in counters.snapshot().items():
                 rec[k] = v
             history.append(rec)
+            if self._obs_fit is not None:
+                # histograms + the flight recorder's step ring ride the
+                # SAME records metrics.jsonl gets
+                self._obs_fit.on_record(rec)
             if mw is not None:
                 mw.log(metrics_step_offset + r,
                        {f"train/{k}": v for k, v in rec.items()
@@ -1516,7 +1576,15 @@ class Trainer:
                     # still means "a step's device work did not finish
                     # in time" (docs/resilience.md watchdog table)
                     wd.arm("train_step", res_cfg.step_deadline_s)
-                self.step(batch)
+                if self._obs_fit is not None:
+                    # step wall time (dispatch + lagged resolution) into
+                    # the step_time_ms histogram — host-side only
+                    _t_step = _time.perf_counter()
+                    self.step(batch)
+                    self._obs_fit.on_step_time(
+                        (_time.perf_counter() - _t_step) * 1e3)
+                else:
+                    self.step(batch)
                 if self.last_resolved is not None:
                     _emit(self.last_resolved)
                 if wd is not None:
@@ -1540,23 +1608,25 @@ class Trainer:
                     # loop); the guard statistics ride as live device
                     # scalars the writer fetches off the hot path.
                     if tiered.should_save(step_idx + 1):
-                        with self.save_blocked.blocked():
-                            ls = None
-                            if loader_state_fn is not None:
-                                try:
-                                    ls = loader_state_fn()
-                                except Exception as e:  # noqa: BLE001
-                                    logger.warning(
-                                        f"loader state_dict() failed for "
-                                        f"step {step_idx + 1} ({e!r}); "
-                                        "resume will fall back to "
-                                        "skip-replay")
-                            gs = (self._guard_state if self._guard_on
-                                  else None)
-                            saved = tiered.submit(
-                                step_idx + 1, self.state,
-                                verdict_gate=step_idx,
-                                loader_state=ls, guard_state=gs)
+                        with tracing.span("train/save", step=step_idx + 1,
+                                          tiered=True):
+                            with self.save_blocked.blocked():
+                                ls = None
+                                if loader_state_fn is not None:
+                                    try:
+                                        ls = loader_state_fn()
+                                    except Exception as e:  # noqa: BLE001
+                                        logger.warning(
+                                            f"loader state_dict() failed "
+                                            f"for step {step_idx + 1} "
+                                            f"({e!r}); resume will fall "
+                                            "back to skip-replay")
+                                gs = (self._guard_state if self._guard_on
+                                      else None)
+                                saved = tiered.submit(
+                                    step_idx + 1, self.state,
+                                    verdict_gate=step_idx,
+                                    loader_state=ls, guard_state=gs)
                         if saved:
                             self._no_donate_once = True
                     # multi-process only (single-process: no-op): run
@@ -1585,21 +1655,28 @@ class Trainer:
                     # materialised on steps that write).
                     if mgr.should_save(step_idx + 1):
                         from torchacc_tpu.checkpoint.io import _snapshot
-                        with self.save_blocked.blocked():
-                            snap = _snapshot(self.state)
-                        # the drain stays OUTSIDE the save meter: its
-                        # blocking fetches already land in
-                        # host_blocked_ms, and a drained entry may run
-                        # a whole eval pass (eval_every boundary) —
-                        # charging that to save_blocked_ms would
-                        # misattribute eval cost to the save path
-                        if self.pending:
-                            _drain_all()
-                        with self.save_blocked.blocked():
-                            saved = mgr.save(step_idx + 1, snap,
-                                             presnapshotted=True,
-                                             loader_state=loader_state_fn,
-                                             guard_state=guard_state_fn)
+                        # the save span covers snapshot + verdict drain +
+                        # hand-off; the drain's train/resolve spans nest
+                        # inside it, so the trace shows the breakdown the
+                        # save_blocked_ms scalar cannot
+                        with tracing.span("train/save", step=step_idx + 1,
+                                          tiered=False):
+                            with self.save_blocked.blocked():
+                                snap = _snapshot(self.state)
+                            # the drain stays OUTSIDE the save meter: its
+                            # blocking fetches already land in
+                            # host_blocked_ms, and a drained entry may run
+                            # a whole eval pass (eval_every boundary) —
+                            # charging that to save_blocked_ms would
+                            # misattribute eval cost to the save path
+                            if self.pending:
+                                _drain_all()
+                            with self.save_blocked.blocked():
+                                saved = mgr.save(
+                                    step_idx + 1, snap,
+                                    presnapshotted=True,
+                                    loader_state=loader_state_fn,
+                                    guard_state=guard_state_fn)
                     else:
                         # non-writing step: save() only commits pending
                         # manifests of finished background writes
@@ -1677,6 +1754,11 @@ class Trainer:
                         f"preemption requested: emergency checkpoint at "
                         f"step {step_idx + 1} is durable; stopping fit "
                         "(resume with fit(resume='auto'))")
+                    if self._obs_fit is not None:
+                        # preemption is a planned exit, but the operator
+                        # still wants the last-minute picture — same
+                        # bundle as a typed-error abort
+                        self._obs_fit.on_preempt(step_idx + 1)
                     break
             # drain the dispatch pipeline: the final k in-flight steps
             # still owe their guard/SDC verdicts and log records — a
@@ -1687,6 +1769,7 @@ class Trainer:
             # committed them), and a hung device cannot be drained.
             _drain_all()
         finally:
+            self._watchdog = None
             if wd is not None:
                 wd.close()
             # early exits (preemption, max_steps, errors) must shut the

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import threading
 import time
@@ -84,9 +85,11 @@ class Counters:
     ``elastic_reshards``, the SDC-defense trio ``sdc_checks`` /
     ``replica_divergences`` / ``sdc_mismatches``, the
     layout-transfer pair ``transfer_compiles`` /
-    ``transfer_cache_hits`` — parallel/transfer.py — and the serving
+    ``transfer_cache_hits`` — parallel/transfer.py — the serving
     prefix-cache set ``prefix_hits`` / ``prefix_blocks_reused`` /
-    ``prefix_evictions`` / ``cow_copies`` — serve/) and the Trainer
+    ``prefix_evictions`` / ``cow_copies`` — serve/ — and
+    ``metrics_nonfinite_values``, non-finite scalars this writer
+    serialised as ``null``) and the Trainer
     surfaces the non-zero ones in
     every step log line AND every metrics.jsonl step record — an
     operator sees a run degrading without grepping worker logs.
@@ -178,12 +181,30 @@ class MetricsWriter:
     def log(self, step: int, scalars: Dict[str, Number]) -> None:
         if self._jsonl is None:
             return
-        rec = {"step": int(step), "time": time.time()}
-        for k, v in scalars.items():
-            rec[k] = float(v)
-            if self._tb is not None:
-                self._tb.add_scalar(k, float(v), int(step))
-        self._jsonl.write(json.dumps(rec) + "\n")
+        # Coerce + validate EVERY value BEFORE touching either sink: a
+        # non-numeric value used to raise mid-loop after some TB
+        # scalars were already written, leaving the two sinks
+        # permanently out of step for that record.  Now the whole
+        # record is judged first — on a bad value neither sink writes.
+        vals = {k: float(v) for k, v in scalars.items()}
+        rec: Dict[str, Optional[float]] = {"step": int(step),
+                                           "time": time.time()}
+        for k, v in vals.items():
+            if math.isfinite(v):
+                rec[k] = v
+            else:
+                # bare NaN/Infinity is a json.dumps extension, NOT
+                # standard JSON — strict consumers reject the whole
+                # metrics.jsonl for one non-finite loss.  Serialise as
+                # null and count the occurrence so the signal (and its
+                # frequency) survives the substitution.
+                rec[k] = None
+                counters.inc("metrics_nonfinite_values")
+        self._jsonl.write(json.dumps(rec, allow_nan=False) + "\n")
+        if self._tb is not None:
+            # TB keeps the raw values (its format handles non-finite)
+            for k, v in vals.items():
+                self._tb.add_scalar(k, v, int(step))
 
     def log_text(self, tag: str, text: str, step: int = 0) -> None:
         if self._tb is not None:

@@ -102,7 +102,9 @@ def device_idle_from_trace(logdir: str) -> Optional[Dict[str, float]]:
     try:
         with gzip.open(paths[-1], "rt") as f:
             events = json.load(f).get("traceEvents", [])
-    except (OSError, ValueError):
+    except (OSError, ValueError, EOFError):
+        # EOFError: a truncated gzip stream (profiler killed mid-write)
+        # raises it directly, not as OSError
         return None
     proc_names: Dict[Any, str] = {}
     thread_names: Dict[Tuple[Any, Any], str] = {}
