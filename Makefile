@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke obs-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke obs-smoke supervisor-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -75,6 +75,19 @@ ckpt-smoke:
 obs-smoke:
 	JAX_PLATFORMS=cpu python bench.py --obs --fast --platform cpu
 
+# supervisor gate (docs/resilience.md "Supervisor"): the full
+# fault-tolerance loop with ZERO human intervention — (1) 2-process
+# dp=2 chaos SDC flip on host 1 -> both workers abort SDCError ->
+# supervisor restarts EXCLUDING host 1 -> shrunken dp=1 pod resumes
+# from the newest valid tier and matches an uninterrupted reference
+# trajectory, restart/exclusion counters scraped from the daemon's
+# /metrics; (2) injected hang -> HangError -> restart full pod ->
+# resumed completion; (3) induced crash loop through the `supervise`
+# CLI -> bounded backoff, budget exhaustion, terminal give-up with a
+# final flight bundle naming the reason
+supervisor-smoke:
+	JAX_PLATFORMS=cpu python scripts/supervisor_smoke.py
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -90,9 +103,11 @@ chaos:
 			tests/test_quant.py \
 			tests/test_handoff.py tests/test_tiered.py \
 			tests/test_obs.py tests/test_profiling.py \
+			tests/test_supervisor.py \
 			-m "not slow" \
 			-q || exit 1; \
 	done
+	$(MAKE) supervisor-smoke
 
 # multi-host robustness proof: 2-process jax.distributed fixtures
 # (cross-host resume consensus with divergent quarantine, preemption

@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""`make supervisor-smoke`: the end-to-end chaos gate for the
+supervisor daemon (docs/resilience.md "Supervisor").
+
+Three scenarios, zero human intervention, all on CPU:
+
+1. **SDC flip -> exclude-and-shrink resume** (2 jax.distributed
+   processes, dp=2): ChaosPlan flips bits on host 1's digest region at
+   step 3 -> both workers abort with SDCError naming host 1 and a
+   quarantine record -> the supervisor restarts EXCLUDING host 1 ->
+   the shrunken dp=1 pod resumes from the newest valid tier (step 2 —
+   the flagged step never became durable) and finishes, with a loss
+   trajectory matching an uninterrupted single-process reference run
+   on the same global batch stream (the PR 3 elastic-resume
+   equivalence).  Supervisor restart/exclusion counters are scraped
+   from its own /metrics endpoint.
+2. **hang -> restart** (world=1): the 3rd dispatched step sleeps past
+   the armed 1s watchdog deadline -> HangError -> the supervisor
+   restarts the full pod -> the rerun resumes from step 2 and
+   completes.
+3. **induced crash loop -> terminal give-up** (world=1, driven through
+   the `supervise` CLI subcommand — the operator entrypoint): every
+   incarnation raises CheckpointError on its 2nd step with no durable
+   progress -> after the 2-restart budget the supervisor gives up with
+   exit code 3 and a final flight bundle naming the reason.
+
+FAILS (exit 1) unless every assertion above holds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from torchacc_tpu.supervisor import (  # noqa: E402
+    RestartPolicy,
+    Supervisor,
+    WorkerSpec,
+    free_port,
+    valid_steps,
+)
+
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+FIXTURE = [sys.executable, "-m", "torchacc_tpu.supervisor.fixture"]
+# dp=2 prefix then dp=1 resume: different psum reduction order, same
+# math — PR 3's elastic fixtures bound the drift far below this
+LOSS_ATOL = 2e-3
+
+
+def check(ok, msg):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {msg}", flush=True)
+    if not ok:
+        raise SystemExit(f"supervisor-smoke FAILED: {msg}")
+
+
+def fixture_argv(max_steps, ckpt_every, chaos, chaos_inc=0):
+    return FIXTURE + [
+        "--run-dir", "{run_dir}", "--world", "{world}",
+        "--host", "{host}", "--coord-port", "{coord_port}",
+        "--obs-port", "{obs_port}", "--incarnation", "{incarnation}",
+        "--max-steps", str(max_steps),
+        "--checkpoint-every", str(ckpt_every),
+        "--chaos", json.dumps(chaos),
+        "--chaos-incarnation", str(chaos_inc),
+    ]
+
+
+def parse_worker_log(run_dir, incarnation, host):
+    """(resume_candidate, {step: loss}) from a fixture worker log."""
+    path = os.path.join(run_dir, "supervisor_logs",
+                        f"inc{incarnation}_host{host}.log")
+    cand, recs = None, {}
+    with open(path) as f:
+        for line in f:
+            if line.startswith("SUPERVISOR_RESUME_CANDIDATE="):
+                cand = int(line.strip().split("=", 1)[1])
+            elif line.startswith("SUPERVISOR_REC "):
+                r = json.loads(line[len("SUPERVISOR_REC "):])
+                recs[int(r["step"])] = float(r["loss"])
+    return cand, recs
+
+
+def reference_run(tmp, max_steps):
+    """Uninterrupted world=1 run on the same stream: the trajectory
+    the recovered pod must match."""
+    d = os.path.join(tmp, "reference")
+    os.makedirs(d)
+    env = dict(os.environ, **WORKER_ENV)
+    argv = FIXTURE + ["--run-dir", d, "--world", "1", "--host", "0",
+                      "--max-steps", str(max_steps),
+                      "--checkpoint-every", "2"]
+    out = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=600)
+    if out.returncode != 0:
+        print(out.stdout[-3000:], out.stderr[-3000:])
+        raise SystemExit("reference run failed")
+    recs = {}
+    for line in out.stdout.splitlines():
+        if line.startswith("SUPERVISOR_REC "):
+            r = json.loads(line[len("SUPERVISOR_REC "):])
+            recs[int(r["step"])] = float(r["loss"])
+    return recs
+
+
+def scenario_sdc(tmp):
+    print("== scenario 1: SDC flip on host 1 -> exclude + shrink + "
+          "resume (2 processes) ==", flush=True)
+    run_dir = os.path.join(tmp, "sdc")
+    obs_port = free_port()
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=2,
+        argv=fixture_argv(7, 2, {"flip": {"host": 1, "at": 3}}),
+        env=WORKER_ENV, exit_grace_s=120.0,
+        incarnation_timeout_s=600.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=3),
+                     obs_port=obs_port)
+    t0 = time.time()
+    rep = sup.run()
+    print(f"  report: {json.dumps({k: v for k, v in rep.items() if k != 'decisions'})}"
+          f" ({time.time() - t0:.0f}s)", flush=True)
+    check(rep["status"] == "completed", "run completed unattended")
+    check(rep["excluded"] == [1], f"host 1 excluded ({rep['excluded']})")
+    check(rep["world"] == 1, "pod shrunk to world=1")
+    d0 = rep["decisions"][0]
+    check(d0["rule"] == "sdc-exclude" and d0["error_type"] == "SDCError",
+          f"decision 0 = sdc-exclude on SDCError (got {d0['rule']} on "
+          f"{d0['error_type']})")
+    check(d0["flagged_step"] == 3, f"flagged step 3 ({d0['flagged_step']})")
+    # the flagged step never became durable; the shrunken pod resumed
+    # from the newest valid tier BELOW it
+    cand, recs = parse_worker_log(run_dir, 1, 0)
+    check(cand == 2, f"shrunken pod resumed from newest valid tier "
+                     f"step 2 (got {cand})")
+    check(d0["resumable"].get("tier1") == 2,
+          f"disposition named tier1=2 resumable "
+          f"({d0['resumable']})")
+    steps = sorted(recs)
+    check(steps and steps[0] == 2 and steps[-1] == 6,
+          f"recovered run trained steps 2..6 ({steps})")
+    # resume candidate 2 < the flagged step's would-be label 4: the
+    # flagged update never became durable; the recovered run re-earned
+    # labels 4 and 6 cleanly
+    durable = valid_steps(run_dir)
+    check(durable == [2, 4, 6],
+          f"durable tier = [2, 4, 6] (got {durable})")
+    # matched loss trajectory vs an uninterrupted dp=1 reference
+    ref = reference_run(tmp, 7)
+    worst = max(abs(recs[s] - ref[s]) for s in steps)
+    check(worst < LOSS_ATOL,
+          f"loss trajectory matches reference (max |delta| "
+          f"{worst:.2e} < {LOSS_ATOL})")
+    # observability: supervisor counters ride its /metrics endpoint
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{obs_port}/metrics", timeout=10) as r:
+        text = r.read().decode()
+    check("torchacc_supervisor_restarts_total" in text
+          and "torchacc_supervisor_exclusions_total 1" in text,
+          "supervisor restart/exclusion counters ride /metrics")
+
+
+def scenario_hang(tmp):
+    print("== scenario 2: injected hang -> kill + restart full pod ==",
+          flush=True)
+    run_dir = os.path.join(tmp, "hang")
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=1,
+        # deadline must clear step 0's compile (~2s); the injected
+        # sleep must clear the deadline with the same margin
+        argv=fixture_argv(
+            6, 2, {"hang": {"after": 2, "seconds": 16, "deadline": 6}}),
+        env=WORKER_ENV, incarnation_timeout_s=600.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=2))
+    rep = sup.run()
+    check(rep["status"] == "completed", "run completed unattended")
+    d0 = rep["decisions"][0]
+    check(d0["rule"] == "hang-restart"
+          and d0["error_type"] == "HangError",
+          f"decision 0 = hang-restart on HangError (got {d0['rule']} "
+          f"on {d0['error_type']})")
+    check(rep["world"] == 1 and rep["excluded"] == [],
+          "restart kept the full pod (no exclusion)")
+    cand, recs = parse_worker_log(run_dir, 1, 0)
+    check(cand is not None and cand >= 2,
+          f"rerun resumed from a durable step ({cand})")
+    check(sorted(recs) and max(recs) == 5,
+          f"rerun completed to step 5 ({sorted(recs)})")
+
+
+def scenario_crash_loop(tmp):
+    print("== scenario 3: unrecoverable crash loop -> terminal "
+          "give-up (supervise CLI) ==", flush=True)
+    run_dir = os.path.join(tmp, "crashloop")
+    worker = fixture_argv(6, 10, {"crash": {"after": 1}},
+                          chaos_inc=-1)
+    argv = ([sys.executable, "-m", "torchacc_tpu.checkpoint.cli",
+             "supervise", "--run-dir", run_dir, "--world", "1",
+             "--max-restarts", "2", "--backoff-initial-s", "0.2",
+             "--backoff-jitter", "0.1", "--incarnation-timeout-s",
+             "600"]
+            + [a for kv in WORKER_ENV.items()
+               for a in ("--env", f"{kv[0]}={kv[1]}")]
+            + ["--"] + worker)
+    out = subprocess.run(argv, capture_output=True, text=True,
+                         timeout=900,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    check(out.returncode == 3,
+          f"supervise CLI exits 3 on give-up (got {out.returncode}; "
+          f"tail: {out.stdout[-500:]} {out.stderr[-500:]})")
+    rep = json.loads(out.stdout[out.stdout.index("{"):])
+    check(rep["status"] == "gave_up" and rep["restarts_used"] == 2,
+          f"gave up after the 2-restart budget "
+          f"({rep['restarts_used']} used)")
+    bundle = os.path.join(run_dir, "flight_giveup.json")
+    check(os.path.exists(bundle), "final flight bundle written")
+    b = json.load(open(bundle))
+    check("budget exhausted" in b["extra"]["reason"],
+          f"bundle names the give-up reason ({b['extra']['reason']!r})")
+    last = b["extra"]["decisions"][-1]
+    check(last["error_type"] == "CheckpointError",
+          f"bundle names the crashing error "
+          f"({last['error_type']})")
+    check(all(d["rule"] == "crash-backoff"
+              for d in b["extra"]["decisions"]),
+          "every decision logged with its policy rule")
+
+
+def main() -> int:
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="supervisor_smoke_") as tmp:
+        scenario_sdc(tmp)
+        scenario_hang(tmp)
+        scenario_crash_loop(tmp)
+    print(f"supervisor-smoke PASSED in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

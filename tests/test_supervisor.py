@@ -1,0 +1,837 @@
+"""Supervisor-daemon tests (torchacc_tpu/supervisor/,
+docs/resilience.md "Supervisor").
+
+The contracts under test:
+
+- the declarative policy engine maps every typed error to its
+  documented action: SDC/quarantine -> restart excluding the named
+  hosts (idempotent — a host quarantined twice is excluded once),
+  hang/probe-dead -> restart the same world, preemption ->
+  wait-and-resume without consuming restart budget, anything else ->
+  bounded jittered crash-loop backoff with terminal give-up;
+- backoff growth, cap, and jitter bounds are exact under a seeded RNG
+  (no wall clock in the engine — delays are returned, sleeps are
+  injected);
+- the probe client never declares a worker dead off a single bad
+  sample: timeout-bounded requests, in-call jittered retry, and a
+  consecutive-failure threshold;
+- ``Trainer.fit`` emits the strict-JSON ``exit_disposition`` block
+  (error type, flagged step, newest resumable step per tier,
+  quarantine delta) on every typed-error exit and preemption — the
+  field the policy engine parses instead of scraping logs;
+- the daemon loop drives real subprocess workers: clean completion,
+  SDC exclusion with elastic shrink, preemption resume, crash-loop
+  give-up with a final flight bundle, probe-triggered kill;
+- ``ServeEngine`` drains gracefully on preemption: admission stops,
+  in-flight decodes finish, unserved request ids are reported.
+"""
+
+import json
+import os
+import socket
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchacc_tpu as ta
+from torchacc_tpu.errors import SDCError
+from torchacc_tpu.models import TransformerLM, get_preset
+from torchacc_tpu.obs import flight, hist, server, tracing
+from torchacc_tpu.resilience import ChaosLoader, ChaosPlan
+from torchacc_tpu.resilience.preemption import (
+    clear_preemption,
+    request_preemption,
+)
+from torchacc_tpu.serve import Request, ServeEngine
+from torchacc_tpu.supervisor import (
+    ExitDisposition,
+    PolicyEngine,
+    ProbeClient,
+    RestartPolicy,
+    Supervisor,
+    WorkerHandle,
+    WorkerProber,
+    WorkerSpec,
+    read_exit_disposition,
+)
+from torchacc_tpu.supervisor.worker import render_argv, valid_steps
+from torchacc_tpu.train import accelerate
+from torchacc_tpu.utils.metrics import counters
+
+pytestmark = pytest.mark.supervisor
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    counters.reset()
+    clear_preemption()
+    flight.recorder.clear()
+    yield
+    counters.reset()
+    clear_preemption()
+    tracing.configure(enabled=False)
+    tracing.clear()
+    hist.configure(enabled=False)
+    hist.reset()
+    server.stop()
+    server.clear_registries()
+    flight.recorder.clear()
+
+
+class _SeqRng:
+    """Deterministic 'random.Random' stand-in: yields the given
+    fractions in order (jitter bounds become exact assertions)."""
+
+    def __init__(self, vals):
+        self.vals = list(vals)
+        self.i = 0
+
+    def random(self):
+        v = self.vals[self.i % len(self.vals)]
+        self.i += 1
+        return v
+
+
+def _d(**kw):
+    return ExitDisposition(**kw)
+
+
+def _sdc(hosts, delta=None, step=3):
+    return _d(reason="SDCError", error_type="SDCError",
+              flagged_step=step, hosts=list(hosts),
+              quarantine_delta=list(delta if delta is not None
+                                    else hosts))
+
+
+# -- policy engine ------------------------------------------------------------
+
+def test_policy_sdc_excludes_named_hosts():
+    e = PolicyEngine(RestartPolicy(), 4)
+    a = e.decide(_sdc([1]), exit_code=1)
+    assert a.kind == "restart_excluding" and a.rule == "sdc-exclude"
+    assert a.hosts == (1,)
+    assert e.world == 3 and e.excluded == {1}
+
+
+def test_policy_exclusion_idempotent():
+    """A host quarantined twice is excluded once: the second SDC abort
+    naming only already-excluded hosts falls through to crash-loop
+    backoff (the exclusion did not fix it), and the world never
+    double-shrinks."""
+    e = PolicyEngine(RestartPolicy(backoff_jitter=0.0), 4)
+    a1 = e.decide(_sdc([1]), exit_code=1)
+    assert a1.kind == "restart_excluding" and e.world == 3
+    a2 = e.decide(_sdc([1]), exit_code=1)
+    assert a2.kind == "restart"
+    assert a2.rule == "sdc-reoccurred-excluded"
+    assert a2.hosts == ()
+    assert e.world == 3 and e.excluded == {1}
+
+
+def test_policy_quarantine_delta_excludes_without_error_hosts():
+    """The quarantine file is the shared supervisor<->worker contract:
+    a delta there excludes even when the error object names nobody
+    (e.g. QuarantinedHostError on a pre-loop refusal)."""
+    e = PolicyEngine(RestartPolicy(), 4)
+    d = _d(reason="QuarantinedHostError",
+           error_type="QuarantinedHostError", hosts=[],
+           quarantine_delta=[2])
+    a = e.decide(d, exit_code=1)
+    assert a.kind == "restart_excluding" and a.hosts == (2,)
+
+
+def test_policy_exclusion_below_min_world_gives_up():
+    e = PolicyEngine(RestartPolicy(min_world=2), 2)
+    a = e.decide(_sdc([1]), exit_code=1)
+    assert a.kind == "give_up" and "min_world" in a.reason
+
+
+def test_policy_hang_restarts_same_world():
+    e = PolicyEngine(RestartPolicy(), 2)
+    d = _d(reason="HangError", error_type="HangError", flagged_step=5)
+    a = e.decide(d, exit_code=1)
+    assert a.kind == "restart" and a.rule == "hang-restart"
+    assert e.world == 2
+
+
+def test_policy_probe_dead_restarts_same_world():
+    e = PolicyEngine(RestartPolicy(), 2)
+    a = e.decide(None, exit_code=None, probe_verdict="dead")
+    assert a.kind == "restart" and a.rule == "probe-dead-restart"
+
+
+def test_policy_preemption_resumes_without_budget():
+    """Preemption-vs-crash disambiguation rides the disposition, not
+    the exit code: a preempted worker exits 0 AND leaves a bundle —
+    resume, never spend budget."""
+    e = PolicyEngine(RestartPolicy(max_restarts=1,
+                                   preempt_resume_delay_s=2.5), 1)
+    d = _d(reason="preemption", preempted=True)
+    for _ in range(5):
+        a = e.decide(d, exit_code=0)
+        assert a.kind == "resume" and a.rule == "preempt-resume"
+        assert a.delay_s == 2.5
+    assert e.restarts_used == 0
+    # while a genuine crash with the same exit-code-0-impossible shape
+    # still burns budget
+    a = e.decide(_d(reason="CheckpointError",
+                    error_type="CheckpointError"), exit_code=1)
+    assert a.kind == "restart" and e.restarts_used == 1
+
+
+def test_policy_clean_exit_done():
+    e = PolicyEngine(RestartPolicy(), 2)
+    a = e.decide(None, exit_code=0)
+    assert a.kind == "done" and a.rule == "clean-exit"
+
+
+def test_policy_crash_backoff_growth_and_cap():
+    p = RestartPolicy(max_restarts=10, backoff_initial_s=1.0,
+                      backoff_multiplier=2.0, backoff_max_s=5.0,
+                      backoff_jitter=0.0)
+    e = PolicyEngine(p, 1)
+    crash = _d(reason="CheckpointError", error_type="CheckpointError")
+    delays = [e.decide(crash, exit_code=1).delay_s for _ in range(5)]
+    assert delays == [1.0, 2.0, 4.0, 5.0, 5.0]   # capped at max
+
+
+def test_policy_backoff_jitter_bounds():
+    p = RestartPolicy(max_restarts=10, backoff_initial_s=2.0,
+                      backoff_multiplier=1.0, backoff_jitter=0.25)
+    crash = _d(reason="unknown", error_type=None)
+    # rng extremes: 0.0 -> -jitter, 1.0 -> +jitter, 0.5 -> exact base
+    e = PolicyEngine(p, 1, rng=_SeqRng([0.0, 1.0, 0.5]))
+    d1 = e.decide(crash, exit_code=1).delay_s
+    d2 = e.decide(crash, exit_code=1).delay_s
+    d3 = e.decide(crash, exit_code=1).delay_s
+    assert d1 == pytest.approx(2.0 * 0.75)
+    assert d2 == pytest.approx(2.0 * 1.25)
+    assert d3 == pytest.approx(2.0)
+    # and the documented invariant for ANY rng value
+    e2 = PolicyEngine(p, 1, rng=_SeqRng([0.137, 0.86, 0.42]))
+    for _ in range(3):
+        d = e2.decide(crash, exit_code=1).delay_s
+        assert 2.0 * 0.75 <= d <= 2.0 * 1.25
+
+
+def test_policy_budget_exhaustion_gives_up():
+    e = PolicyEngine(RestartPolicy(max_restarts=2, backoff_jitter=0.0), 1)
+    crash = _d(reason="boom", error_type=None)
+    assert e.decide(crash, exit_code=1).kind == "restart"
+    assert e.decide(crash, exit_code=1).kind == "restart"
+    a = e.decide(crash, exit_code=1)
+    assert a.kind == "give_up" and "budget exhausted" in a.reason
+    # terminal: every later failure also gives up
+    assert e.decide(crash, exit_code=1).kind == "give_up"
+
+
+def test_policy_progress_resets_backoff_streak():
+    p = RestartPolicy(max_restarts=10, backoff_initial_s=1.0,
+                      backoff_multiplier=2.0, backoff_jitter=0.0)
+    e = PolicyEngine(p, 1)
+    crash = _d(reason="x", error_type=None)
+    assert e.decide(crash, exit_code=1).delay_s == 1.0
+    assert e.decide(crash, exit_code=1).delay_s == 2.0
+    e.note_progress()                 # a new durable step landed
+    assert e.decide(crash, exit_code=1).delay_s == 1.0
+
+
+def test_policy_supervisor_kill_never_reads_as_preemption():
+    """The daemon's OWN SIGTERM makes workers write preemption bundles;
+    with a probe verdict present, those must route to the hang rule and
+    consume budget — never a budget-free resume loop."""
+    e = PolicyEngine(RestartPolicy(max_restarts=2), 1)
+    d = _d(reason="preemption", preempted=True)
+    a = e.decide(d, exit_code=None, probe_verdict="dead")
+    assert a.kind == "restart" and a.rule == "probe-dead-restart"
+    assert e.restarts_used == 1
+    # without the probe verdict the same bundle is a genuine eviction
+    a2 = e.decide(d, exit_code=0)
+    assert a2.kind == "resume" and e.restarts_used == 1
+
+
+# -- exit disposition ---------------------------------------------------------
+
+def test_exit_disposition_from_bundle_roundtrip(tmp_path):
+    d = {"reason": "SDCError", "error_type": "SDCError",
+         "flagged_step": 7, "hosts": [1, 2], "quarantine_delta": [2],
+         "quarantine": {"2": {"step": 7}},
+         "resumable": {"tier0": 6, "tier1": 4, "tier2": None},
+         "preempted": False, "process_index": 0, "world_size": 4}
+    parsed = ExitDisposition.from_bundle({"exit_disposition": d})
+    assert parsed.error_type == "SDCError"
+    assert parsed.hosts == [1, 2]
+    assert parsed.quarantine_delta == [2]
+    assert parsed.newest_resumable() == 6
+    assert ExitDisposition.from_bundle({"reason": "x"}) is None
+
+
+def _model():
+    return get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      intermediate_size=64, dtype=jnp.float32)
+
+
+def _batches(n, seed=None):
+    rng = np.random.default_rng(CHAOS_SEED if seed is None else seed)
+    return [{"input_ids": rng.integers(0, 64, size=(8, 16)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def _trainer(**res_kwargs):
+    import optax
+    cfg = ta.Config(resilience=ta.ResilienceConfig(**res_kwargs),
+                    obs=ta.ObsConfig(enabled=True))
+    tr, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3))
+    return tr
+
+
+def test_fit_sdc_abort_emits_exit_disposition(tmp_path):
+    """The satellite contract: the bundle's exit_disposition names the
+    error type, the flagged step, the newest resumable step per tier,
+    and the quarantine delta — machine-parseable by the policy
+    engine's reader, end to end."""
+    ck = str(tmp_path / "run")
+    tr = _trainer(sdc_recompute_interval_steps=1)
+    since = time.time()
+    with pytest.raises(SDCError):
+        with ChaosPlan(seed=CHAOS_SEED).flip_bits(host=0, at=3,
+                                                  where="recompute"):
+            tr.fit(_batches(6), max_steps=6, log_every=1,
+                   checkpoint_dir=ck, checkpoint_every=2)
+    b = json.load(open(flight.recorder.last_dump_path))
+    d = b["exit_disposition"]
+    assert d["reason"] == "SDCError"
+    assert d["error_type"] == "SDCError"
+    assert d["flagged_step"] == 3
+    assert d["hosts"] == [0]
+    assert d["quarantine_delta"] == [0]
+    assert d["resumable"]["tier1"] == 2      # newest durable < flagged
+    assert d["resumable"]["tier0"] is None   # tiered off
+    assert d["preempted"] is False
+    # the supervisor-side reader finds and parses the same bundle
+    parsed = read_exit_disposition(ck, since)
+    assert parsed is not None and parsed.error_type == "SDCError"
+    assert parsed.flagged_step == 3 and parsed.newest_resumable() == 2
+
+
+def test_fit_preemption_emits_exit_disposition(tmp_path):
+    ck = str(tmp_path / "ck")
+    tr = _trainer()
+    since = time.time()
+    tr.fit(ChaosLoader(_batches(8), preempt_after_step=3), max_steps=8,
+           log_every=1, checkpoint_dir=ck, checkpoint_every=100)
+    b = json.load(open(flight.recorder.last_dump_path))
+    d = b["exit_disposition"]
+    assert d["reason"] == "preemption" and d["preempted"] is True
+    assert d["error_type"] is None
+    assert d["flagged_step"] == 4            # the emergency-saved step
+    assert d["resumable"]["tier1"] == 4      # ... which IS resumable
+    parsed = read_exit_disposition(ck, since)
+    assert parsed is not None and parsed.preempted
+    # the policy engine disambiguates preemption from crash
+    a = PolicyEngine(RestartPolicy(), 1).decide(parsed, exit_code=0)
+    assert a.kind == "resume"
+
+
+# -- probe client -------------------------------------------------------------
+
+def test_probe_healthz_against_live_server():
+    srv = server.start(port=0)
+    c = ProbeClient(srv.url, timeout_s=5.0, retries=0)
+    r = c.healthz()
+    assert r.status == "ok" and r.reachable
+    assert r.pid == os.getpid()              # restart-identity field
+    server.register_health("x", lambda: ("degraded", "busy"))
+    assert c.healthz().status == "degraded"
+    server.register_health("y", lambda: ("unhealthy", "dead device"))
+    assert c.healthz().status == "unhealthy"   # HTTP 503 is an answer
+    counters.inc("supervisor_restarts", 3)
+    assert c.counter("supervisor_restarts") == 3.0
+
+
+def test_probe_unreachable_threshold_and_recovery():
+    with socket.socket() as s:                 # a port nobody serves
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    c = ProbeClient(f"http://127.0.0.1:{dead_port}", timeout_s=0.2,
+                    retries=0, sleep=lambda _: None)
+    pr = WorkerProber(c, unreachable_threshold=3)
+    assert pr.observe().status == "unreachable"
+    assert pr.verdict() == "alive"             # 1 sample is noise
+    pr.observe()
+    assert pr.verdict() == "alive"
+    pr.observe()
+    assert pr.verdict() == "dead"              # 3 consecutive = corpse
+    # recovery resets the streak
+    srv = server.start(port=0)
+    pr.client = ProbeClient(srv.url, timeout_s=5.0, retries=0)
+    pr.observe()
+    assert pr.verdict() == "alive"
+    assert pr.consecutive_unreachable == 0
+
+
+def test_probe_unhealthy_threshold_degraded_stays_alive():
+    srv = server.start(port=0)
+    state = {"s": "degraded"}
+    server.register_health("w", lambda: (state["s"], "r"))
+    pr = WorkerProber(ProbeClient(srv.url, timeout_s=5.0, retries=0),
+                      unhealthy_threshold=2)
+    # degraded is NOT death — a GC pause/busy scrape must never kill
+    for _ in range(5):
+        pr.observe()
+        assert pr.verdict() == "alive"
+    state["s"] = "unhealthy"
+    pr.observe()
+    assert pr.verdict() == "alive"
+    pr.observe()
+    assert pr.verdict() == "unhealthy"
+
+
+def test_probe_ever_reachable_flag():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    pr = WorkerProber(ProbeClient(f"http://127.0.0.1:{dead_port}",
+                                  timeout_s=0.2, retries=0,
+                                  sleep=lambda _: None))
+    pr.observe()
+    assert pr.ever_reachable is False     # never answered yet
+    srv = server.start(port=0)
+    pr.client = ProbeClient(srv.url, timeout_s=5.0, retries=0)
+    pr.observe()
+    assert pr.ever_reachable is True
+
+
+def test_probe_retry_backoff_jitter_bounds():
+    slept = []
+    c = ProbeClient("http://127.0.0.1:1", timeout_s=0.05, retries=3,
+                    backoff_s=0.1, backoff_multiplier=2.0,
+                    max_backoff_s=0.3, jitter=0.5,
+                    rng=_SeqRng([0.0, 1.0, 0.5]),
+                    sleep=slept.append)
+    r = c.healthz()
+    assert r.status == "unreachable"
+    assert len(slept) == 3                   # retries, no sleep after last
+    assert slept[0] == pytest.approx(0.1 * 0.5)    # rng 0.0 -> -50%
+    assert slept[1] == pytest.approx(0.2 * 1.5)    # rng 1.0 -> +50%
+    assert slept[2] == pytest.approx(0.3)          # capped, rng 0.5
+    for d in slept:
+        assert 0.0 <= d <= 0.3 * 1.5
+
+
+# -- worker handle / disposition reader ---------------------------------------
+
+def test_worker_handle_exit_code_and_log(tmp_path):
+    h = WorkerHandle(0, [sys.executable, "-c",
+                         "print('hello'); raise SystemExit(3)"],
+                     log_path=str(tmp_path / "w.log"))
+    h.start()
+    assert h.wait(30.0) == 3
+    h.close()
+    assert "hello" in h.tail()
+
+
+def test_worker_handle_terminate_escalates_to_kill(tmp_path):
+    h = WorkerHandle(0, [sys.executable, "-c",
+                         "import signal, time\n"
+                         "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                         "print('armed', flush=True)\n"
+                         "time.sleep(120)"],
+                     log_path=str(tmp_path / "w.log"))
+    h.start()
+    deadline = time.time() + 30
+    while "armed" not in h.tail() and time.time() < deadline:
+        time.sleep(0.05)
+    rc = h.terminate(grace_s=0.3)
+    assert rc is not None and rc != 0        # SIGKILL'd
+    assert not h.running()
+    h.close()
+
+
+def test_read_exit_disposition_newest_since(tmp_path):
+    d = str(tmp_path)
+    old = {"exit_disposition": {"reason": "HangError",
+                                "error_type": "HangError"}}
+    new = {"exit_disposition": {"reason": "SDCError",
+                                "error_type": "SDCError", "hosts": [1]}}
+    json.dump(old, open(os.path.join(d, "flight_2.json"), "w"))
+    os.utime(os.path.join(d, "flight_2.json"), (1.0, 1.0))
+    since = time.time() - 5
+    json.dump(new, open(os.path.join(d, "flight_5.json"), "w"))
+    got = read_exit_disposition(d, since)
+    assert got is not None and got.error_type == "SDCError"
+    # nothing newer than `since` -> None (stale bundles never re-fire)
+    assert read_exit_disposition(d, time.time() + 60) is None
+    # a bundle without the block is skipped
+    json.dump({"reason": "x"},
+              open(os.path.join(d, "flight_9.json"), "w"))
+    assert read_exit_disposition(d, since).error_type == "SDCError"
+
+
+def test_read_exit_disposition_error_outranks_newer_preemption(tmp_path):
+    """When one worker aborts with a typed error and its SIGTERMed
+    peers write NEWER preemption bundles, the error decides — a
+    failure must never be misread as a scheduler eviction."""
+    d = str(tmp_path)
+    since = time.time() - 5
+    json.dump({"exit_disposition": {"reason": "SDCError",
+                                    "error_type": "SDCError",
+                                    "hosts": [1]}},
+              open(os.path.join(d, "flight_3.json"), "w"))
+    time.sleep(0.02)
+    json.dump({"exit_disposition": {"reason": "preemption",
+                                    "preempted": True}},
+              open(os.path.join(d, "flight_4.json"), "w"))
+    got = read_exit_disposition(d, since)
+    assert got.error_type == "SDCError"
+    # with only preemption bundles, preemption is the verdict
+    os.remove(os.path.join(d, "flight_3.json"))
+    assert read_exit_disposition(d, since).preempted is True
+
+
+def test_probe_pid_mismatch_is_stale_endpoint():
+    srv = server.start(port=0)
+    pr = WorkerProber(ProbeClient(srv.url, timeout_s=5.0, retries=0),
+                      unreachable_threshold=2,
+                      expect_pid=os.getpid() + 1)   # not our pid
+    r = pr.observe()
+    assert r.status == "unreachable" and "stale endpoint" in r.error
+    assert pr.ever_reachable is False
+    pr.observe()
+    assert pr.verdict() == "dead"
+    # matching pid is this worker answering
+    pr2 = WorkerProber(ProbeClient(srv.url, timeout_s=5.0, retries=0),
+                       expect_pid=os.getpid())
+    assert pr2.observe().status == "ok"
+
+
+def test_supervisor_sdc_reoccurrence_counts_as_crash_restart(tmp_path):
+    sup = Supervisor(_spec(tmp_path, "raise SystemExit(0)"),
+                     RestartPolicy())
+    from torchacc_tpu.supervisor import Action
+    sup._account(Action("restart", "sdc-reoccurred-excluded"))
+    assert counters.get("supervisor_crash_restarts") == 1
+    assert counters.get("supervisor_restarts") == 1
+
+
+def test_render_argv_unknown_placeholder_raises():
+    assert render_argv(["a", "{host}"], {"host": 2}) == ["a", "2"]
+    with pytest.raises(ValueError):
+        render_argv(["{wrold}"], {"world": 2})
+
+
+def test_valid_steps_matches_commit_marker_rule(tmp_path):
+    os.makedirs(tmp_path / "2")
+    os.makedirs(tmp_path / "4")
+    open(tmp_path / "2" / "_MANIFEST", "w").write("{}")
+    assert valid_steps(str(tmp_path)) == [2]   # 4 has no marker
+
+
+# -- the daemon loop (real subprocess workers, no jax) ------------------------
+
+def _spec(tmp_path, script, world=1, **kw):
+    """Workers are `python -c script` with argv [incarnation, world,
+    run_dir, host] — tiny, jax-free, millisecond-fast."""
+    kw.setdefault("exit_grace_s", 1.0)
+    kw.setdefault("term_grace_s", 2.0)
+    return WorkerSpec(
+        run_dir=str(tmp_path), world_size=world,
+        argv=[sys.executable, "-c", script, "{incarnation}", "{world}",
+              "{run_dir}", "{host}"],
+        **kw)
+
+
+def test_supervisor_clean_run_completes(tmp_path):
+    sup = Supervisor(_spec(tmp_path, "raise SystemExit(0)"),
+                     RestartPolicy(max_restarts=1),
+                     poll_interval_s=0.02)
+    rep = sup.run()
+    assert rep["status"] == "completed"
+    assert rep["incarnations"] == 1
+    assert rep["decisions"][0]["rule"] == "clean-exit"
+
+
+_CRASH = "raise SystemExit(1)"
+
+
+def test_supervisor_crash_loop_gives_up_with_final_bundle(tmp_path):
+    slept = []
+    sup = Supervisor(
+        _spec(tmp_path, _CRASH),
+        RestartPolicy(max_restarts=2, backoff_initial_s=0.05,
+                      backoff_multiplier=2.0, backoff_jitter=0.0),
+        poll_interval_s=0.02,
+        sleep=lambda s: slept.append(s))
+    rep = sup.run()
+    assert rep["status"] == "gave_up"
+    assert rep["incarnations"] == 3          # initial + 2 restarts
+    assert rep["restarts_used"] == 2
+    # the backoff schedule was actually slept (injected fake clock)
+    backoffs = [s for s in slept if s >= 0.05]
+    assert backoffs == [0.05, 0.1]
+    # the terminal artefact: a final flight bundle naming the reason
+    path = rep["final_bundle"]
+    assert path is not None and os.path.basename(path) == \
+        "flight_giveup.json"
+    b = json.load(open(path))
+    assert b["reason"] == "supervisor_give_up"
+    assert "budget exhausted" in b["extra"]["reason"]
+    assert len(b["extra"]["decisions"]) == 3
+    assert b["context"]["supervisor"]["max_restarts"] == 2
+    # give-up/restart counters ride /metrics (prometheus text)
+    text = server.prometheus_text()
+    assert "torchacc_supervisor_giveups_total 1" in text
+    assert "torchacc_supervisor_restarts_total 2" in text
+    assert "torchacc_supervisor_crash_restarts_total 2" in text
+
+
+_PREEMPT = """
+import json, sys
+inc, world, run = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+if inc == 0:
+    json.dump({"exit_disposition": {"reason": "preemption",
+                                    "preempted": True,
+                                    "flagged_step": 4,
+                                    "resumable": {"tier1": 4}}},
+              open(run + "/flight_4.json", "w"))
+raise SystemExit(0)
+"""
+
+
+def test_supervisor_preemption_wait_and_resume(tmp_path):
+    sup = Supervisor(_spec(tmp_path, _PREEMPT),
+                     RestartPolicy(max_restarts=0,
+                                   preempt_resume_delay_s=0.05),
+                     poll_interval_s=0.02)
+    rep = sup.run()
+    assert rep["status"] == "completed"
+    assert rep["incarnations"] == 2
+    assert rep["decisions"][0]["rule"] == "preempt-resume"
+    assert rep["restarts_used"] == 0         # resume never burns budget
+    assert counters.get("supervisor_preempt_resumes") == 1
+
+
+_SDC = """
+import json, sys
+inc, world, run, host = (int(sys.argv[1]), int(sys.argv[2]),
+                         sys.argv[3], int(sys.argv[4]))
+if inc == 0:
+    if host == 0:
+        json.dump({"exit_disposition": {
+            "reason": "SDCError", "error_type": "SDCError",
+            "flagged_step": 3, "hosts": [1],
+            "quarantine_delta": [1], "resumable": {"tier1": 2}}},
+            open(run + "/flight_3.json", "w"))
+    raise SystemExit(1)
+# the restarted pod must be the SHRUNKEN world
+raise SystemExit(0 if world == 1 else 9)
+"""
+
+
+def test_supervisor_sdc_restart_excludes_and_shrinks(tmp_path):
+    sup = Supervisor(_spec(tmp_path, _SDC, world=2),
+                     RestartPolicy(max_restarts=2),
+                     poll_interval_s=0.02)
+    rep = sup.run()
+    assert rep["status"] == "completed"
+    assert rep["excluded"] == [1]
+    assert rep["world"] == 1
+    assert rep["decisions"][0]["rule"] == "sdc-exclude"
+    assert rep["decisions"][0]["error_type"] == "SDCError"
+    assert rep["decisions"][0]["hosts"] == [1]
+    assert counters.get("supervisor_exclusions") == 1
+    assert counters.get("supervisor_restarts") == 1
+
+
+class _FakeProber:
+    """Scripted prober: 'alive' for the first N observations, then the
+    terminal verdict — the probe-sensing channel without HTTP."""
+
+    def __init__(self, alive_for, then="dead"):
+        self.n = 0
+        self.alive_for = alive_for
+        self.then = then
+        self.last = None
+        self.consecutive_unreachable = 3
+        self.consecutive_unhealthy = 0
+
+    def observe(self):
+        self.n += 1
+        return None
+
+    def verdict(self):
+        return "alive" if self.n <= self.alive_for else self.then
+
+
+_HANG_THEN_OK = """
+import sys, time
+inc = int(sys.argv[1])
+if inc == 0:
+    time.sleep(120)
+raise SystemExit(0)
+"""
+
+
+def test_supervisor_probe_dead_kills_and_restarts(tmp_path):
+    spec = _spec(tmp_path, _HANG_THEN_OK, probe=True,
+                 probe_interval_s=0.05)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=2),
+                     poll_interval_s=0.02,
+                     prober_factory=lambda h, p: _FakeProber(2))
+    rep = sup.run()
+    assert rep["status"] == "completed"
+    assert rep["decisions"][0]["rule"] == "probe-dead-restart"
+    assert rep["decisions"][0]["probe_verdict"] == "dead"
+    assert counters.get("supervisor_probe_kills") == 1
+    assert counters.get("supervisor_hang_restarts") == 1
+
+
+def test_supervisor_probe_startup_grace_holds_fire(tmp_path):
+    """A worker that has NEVER answered its endpoint is not killed
+    inside the startup grace window — jax import + compile can take
+    minutes before the server binds."""
+
+    class _NeverReachable(_FakeProber):
+        def __init__(self):
+            super().__init__(0)          # verdict 'dead' immediately
+            self.ever_reachable = False
+
+    spec = _spec(tmp_path,
+                 "import time; time.sleep(0.6); raise SystemExit(0)",
+                 probe=True, probe_interval_s=0.05, probe_grace_s=30.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=0),
+                     poll_interval_s=0.02,
+                     prober_factory=lambda h, p: _NeverReachable())
+    rep = sup.run()
+    assert rep["status"] == "completed"       # never probe-killed
+    assert counters.get("supervisor_probe_kills") == 0
+
+
+def test_supervisor_incarnation_deadline_is_hang(tmp_path):
+    spec = _spec(tmp_path, _HANG_THEN_OK, incarnation_timeout_s=1.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=2),
+                     poll_interval_s=0.02)
+    rep = sup.run()
+    assert rep["status"] == "completed"
+    assert rep["decisions"][0]["rule"] == "probe-dead-restart"
+
+
+def test_cli_supervise_completed_and_giveup(tmp_path, capsys):
+    from torchacc_tpu.checkpoint.cli import main as cli_main
+    ok = cli_main(["supervise", "--run-dir", str(tmp_path / "a"),
+                   "--max-restarts", "1", "--", sys.executable, "-c",
+                   "raise SystemExit(0)"])
+    assert ok == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["status"] == "completed"
+    bad = cli_main(["supervise", "--run-dir", str(tmp_path / "b"),
+                    "--max-restarts", "0", "--backoff-initial-s",
+                    "0.01", "--", sys.executable, "-c",
+                    "raise SystemExit(1)"])
+    assert bad == 3
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["status"] == "gave_up"
+    assert os.path.exists(tmp_path / "b" / "flight_giveup.json")
+
+
+# -- serve drain (the serving-side half of preemption) ------------------------
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def tiny_serve():
+    cfg = get_preset(
+        "llama-tiny", dtype=jnp.float32, num_layers=1, hidden_size=32,
+        num_heads=2, num_kv_heads=2, intermediate_size=64,
+        vocab_size=VOCAB, max_seq_len=128)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _engine(tiny_serve, **kw):
+    model, params = tiny_serve
+    base = dict(block_size=8, num_blocks=64, max_slots=2,
+                prefill_chunk=8, decode_depth=2)
+    base.update(kw)
+    return ServeEngine(model, params,
+                       ta.Config(serve=ta.ServeConfig(**base)))
+
+
+def test_serve_drain_finishes_inflight_reports_unserved(tiny_serve):
+    eng = _engine(tiny_serve)
+    rng = np.random.default_rng(CHAOS_SEED)
+    rids = [eng.submit(Request(
+        prompt_ids=rng.integers(1, VOCAB, size=6).tolist(),
+        max_new_tokens=8)) for _ in range(5)]
+    # let admission fill the 2 slots, then drain mid-flight
+    eng.step()
+    report0 = eng.drain_report()
+    assert len(report0["in_flight"]) == 2
+    eng.begin_drain("test")
+    eng.run()
+    rep = eng.drain_report()
+    # every admitted request finished; the queued ones never started
+    assert sorted(rep["in_flight"]) == []
+    assert set(rep["unserved"]) == set(rids) - set(report0["in_flight"])
+    assert len(rep["unserved"]) == 3
+    for rid in report0["in_flight"]:
+        assert len(eng.result(rid).tokens) == 8
+    for rid in rep["unserved"]:
+        with pytest.raises(RuntimeError):
+            eng.result(rid)
+    # admission stays stopped: more steps never admit the queue
+    for _ in range(3):
+        assert eng.step() is False
+    assert set(eng.unserved_ids()) == set(rep["unserved"])
+    assert counters.get("serve_requests_unserved") == 3
+    # a second run() on the drained engine must not re-count the
+    # already-reported unserved ids
+    eng.run()
+    assert counters.get("serve_requests_unserved") == 3
+
+
+def test_serve_drain_on_preemption_signal(tiny_serve):
+    eng = _engine(tiny_serve)
+    rng = np.random.default_rng(CHAOS_SEED + 1)
+    rids = [eng.submit(Request(
+        prompt_ids=rng.integers(1, VOCAB, size=6).tolist(),
+        max_new_tokens=4)) for _ in range(4)]
+    try:
+        request_preemption("test eviction")
+        eng.run()                      # drains instead of serving all
+        rep = eng.drain_report()
+        assert rep["draining"] is True
+        assert 0 < len(rep["unserved"]) <= 4
+        assert rep["completed"] + len(rep["unserved"]) == 4
+    finally:
+        clear_preemption()
+    assert counters.get("serve_drains") == 1
+    assert rids
+
+
+def test_serve_drain_off_serves_everything(tiny_serve):
+    eng = _engine(tiny_serve, drain_on_preempt=False)
+    rng = np.random.default_rng(CHAOS_SEED + 2)
+    rids = [eng.submit(Request(
+        prompt_ids=rng.integers(1, VOCAB, size=6).tolist(),
+        max_new_tokens=4)) for _ in range(4)]
+    try:
+        request_preemption("ignored")
+        eng.run()
+    finally:
+        clear_preemption()
+    for rid in rids:
+        assert len(eng.result(rid).tokens) == 4
+    assert eng.drain_report()["draining"] is False

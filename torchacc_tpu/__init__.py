@@ -35,6 +35,7 @@ from torchacc_tpu.config import (
     PerfConfig,
     PPConfig,
     ResilienceConfig,
+    ServeConfig,
     SPConfig,
     TPConfig,
 )
@@ -56,6 +57,7 @@ __all__ = [
     "ObsConfig",
     "PerfConfig",
     "ResilienceConfig",
+    "ServeConfig",
     "accelerate",
     "errors",
     "logger",

@@ -235,6 +235,67 @@ def main(argv=None) -> int:
         p.add_argument("--json", action="store_true",
                        help="machine-readable output for diffing")
         return _cmd_replay(p.parse_args(argv[1:]))
+    if argv and argv[0] == "supervise":
+        p = argparse.ArgumentParser(
+            prog="consolidate_and_reshard_ckpts supervise",
+            description="Run the supervisor daemon: launch + monitor "
+                        "training workers, sense failure (exit "
+                        "disposition / healthz probes / flight "
+                        "bundles), and apply the restart policy "
+                        "(docs/resilience.md 'Supervisor').  Worker "
+                        "argv follows '--'; placeholders {host} "
+                        "{world} {incarnation} {run_dir} {coord_port} "
+                        "{obs_port} are substituted per launch.  "
+                        "Exit code: 0 run completed, 3 terminal "
+                        "give-up (see flight_giveup.json).")
+        p.add_argument("--run-dir", required=True,
+                       help="shared run directory (checkpoints, "
+                            "quarantine file, flight bundles)")
+        p.add_argument("--world", type=int, default=1,
+                       help="initial worker count (one process per "
+                            "host on the local fixture)")
+        p.add_argument("--max-restarts", type=int, default=8,
+                       help="total restart budget (preemption resumes "
+                            "are free); exhausted -> give up")
+        p.add_argument("--backoff-initial-s", type=float, default=1.0)
+        p.add_argument("--backoff-max-s", type=float, default=60.0)
+        p.add_argument("--backoff-jitter", type=float, default=0.25)
+        p.add_argument("--min-world", type=int, default=1,
+                       help="never shrink the pod below this many "
+                            "hosts — give up instead")
+        p.add_argument("--probe", action="store_true",
+                       help="poll each worker's /healthz (workers "
+                            "must serve it on the {obs_port} passed "
+                            "to them)")
+        p.add_argument("--incarnation-timeout-s", type=float,
+                       default=None,
+                       help="kill + restart an incarnation older than "
+                            "this (last-resort hang detector)")
+        p.add_argument("--exit-grace-s", type=float, default=15.0,
+                       help="window for peer workers to follow a "
+                            "failed one out before SIGTERM")
+        p.add_argument("--obs-port", type=int, default=None,
+                       help="serve the supervisor's own /metrics "
+                            "(supervisor_* counters) here")
+        p.add_argument("--env", action="append", default=[],
+                       metavar="KEY=VALUE",
+                       help="extra worker environment (repeatable; "
+                            "values may use the same placeholders)")
+        if "--" not in argv:
+            print("error: worker argv required after '--'",
+                  file=sys.stderr)
+            return 2
+        split = argv.index("--")
+        args = p.parse_args(argv[1:split])
+        args.worker_argv = argv[split + 1:]
+        if not args.worker_argv:
+            print("error: worker argv required after '--'",
+                  file=sys.stderr)
+            return 2
+        # deliberately jax-free: the daemon must run on a host that
+        # never initialises a device backend
+        from torchacc_tpu.supervisor.daemon import main_from_args
+        return main_from_args(args)
     if argv and argv[0] == "inspect":
         p = argparse.ArgumentParser(
             prog="consolidate_and_reshard_ckpts inspect",

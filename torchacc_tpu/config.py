@@ -479,6 +479,14 @@ class ServeConfig:
     max_new_tokens: int = 128
     # bound on the admission queue; submit() raises when full
     max_queue: int = 4096
+    # graceful drain on preemption (docs/serving.md "Graceful drain"):
+    # engine.run() watches the SIGTERM preemption flag
+    # (resilience/preemption.py) and, once set, stops admission,
+    # finishes every in-flight decode (an admitted request always
+    # finishes — the whole-reservation guarantee) and reports the
+    # queued-but-unserved request ids for resubmission elsewhere.
+    # Off: run() ignores preemption entirely (pre-PR-13 behaviour).
+    drain_on_preempt: bool = True
 
     def validate(self) -> None:
         _check(self.block_size >= 1, "serve.block_size must be >= 1")

@@ -122,10 +122,20 @@ class FlightRecorder:
     def dump(self, reason: str, *, step: Optional[int] = None,
              error: Optional[BaseException] = None,
              dump_dir: Optional[str] = None,
-             extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+             extra: Optional[Dict[str, Any]] = None,
+             disposition: Optional[Dict[str, Any]] = None,
+             filename: Optional[str] = None) -> Optional[str]:
         """Write the postmortem bundle; returns its path (None when no
         dump dir is configured or the write failed — a failing dump
-        must never mask the abort it documents)."""
+        must never mask the abort it documents).
+
+        ``disposition``: the strict-JSON ``exit_disposition`` block
+        (error type, flagged step, newest resumable step per tier,
+        quarantine delta — built by ``FitObs``) — the field the
+        supervisor's policy engine parses instead of scraping logs.
+        ``filename`` overrides the ``flight_<step>.json`` default (the
+        supervisor's terminal give-up bundle must never collide with a
+        worker's abort bundle for the same step)."""
         from torchacc_tpu.obs import tracing
         from torchacc_tpu.utils.metrics import counters
         d = dump_dir or self.dump_dir
@@ -164,11 +174,14 @@ class FlightRecorder:
             }
         if extra:
             bundle["extra"] = json_safe(extra)
+        if disposition is not None:
+            bundle["exit_disposition"] = json_safe(disposition)
         try:
             os.makedirs(d, exist_ok=True)
             path = os.path.join(
-                d, f"flight_{step if step is not None else 'unknown'}"
-                   f".json")
+                d, filename if filename is not None else
+                f"flight_{step if step is not None else 'unknown'}"
+                f".json")
             tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
             with open(tmp, "w") as f:
                 # strict JSON by construction: everything above went

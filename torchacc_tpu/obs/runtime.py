@@ -91,6 +91,12 @@ class FitObs:
                 "config", trainer.config.to_dict())
             flight.recorder.set_context("run_dir", run_dir)
         t = trainer
+        # quarantine baseline at session open: the exit disposition
+        # reports the DELTA (hosts quarantined during THIS run) — the
+        # field the supervisor's exclusion rule acts on, distinct from
+        # hosts an earlier incident already removed
+        from torchacc_tpu.resilience.sdc import read_quarantined_hosts
+        self._quarantine_at_start = set(read_quarantined_hosts(run_dir))
         # registered callables are remembered so close() removes ONLY
         # them: if a newer session replaced a name (last owner wins),
         # this session's close must not delete the replacement
@@ -186,19 +192,53 @@ class FitObs:
         from torchacc_tpu.resilience.sdc import read_quarantined_hosts
         return {"quarantine": read_quarantined_hosts(self.run_dir)}
 
+    def _disposition(self, reason: str,
+                     err: Optional[BaseException] = None,
+                     step: Optional[int] = None) -> dict:
+        """The strict-JSON ``exit_disposition`` block — the machine
+        contract the supervisor's policy engine parses (mirrored by
+        ``supervisor.policy.ExitDisposition.from_bundle``): typed
+        error, flagged step, newest resumable step per tier, and the
+        quarantine delta this run contributed."""
+        from torchacc_tpu.resilience.coordination import (
+            process_count,
+            process_index,
+        )
+        from torchacc_tpu.resilience.sdc import read_quarantined_hosts
+        q = read_quarantined_hosts(self.run_dir)
+        tiers_fn = getattr(self.trainer, "resumable_tiers", None)
+        tiers = tiers_fn() if callable(tiers_fn) else {}
+        flagged = step if step is not None else getattr(err, "step", None)
+        return {
+            "reason": reason,
+            "error_type": type(err).__name__ if err is not None else None,
+            "flagged_step": flagged,
+            "hosts": list(getattr(err, "hosts", None) or []),
+            "resumable": tiers,
+            "quarantine": {str(k): v for k, v in q.items()},
+            "quarantine_delta": sorted(
+                set(q) - self._quarantine_at_start),
+            "preempted": reason == "preemption",
+            "process_index": process_index(),
+            "world_size": process_count(),
+        }
+
     def on_abort(self, err: BaseException) -> Optional[str]:
-        """Typed-error exit: write the postmortem bundle."""
+        """Typed-error exit: write the postmortem bundle (with the
+        exit-disposition block the supervisor acts on)."""
         if not self.cfg.flight_recorder:
             return None
         return flight.recorder.dump(
             type(err).__name__, error=err,
-            extra=self._quarantine_context())
+            extra=self._quarantine_context(),
+            disposition=self._disposition(type(err).__name__, err=err))
 
     def on_preempt(self, step: int) -> Optional[str]:
         if not self.cfg.flight_recorder:
             return None
         return flight.recorder.dump(
-            "preemption", step=step, extra=self._quarantine_context())
+            "preemption", step=step, extra=self._quarantine_context(),
+            disposition=self._disposition("preemption", step=step))
 
     def close(self) -> None:
         for name, fn in self._gauges.items():

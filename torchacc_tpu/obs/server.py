@@ -96,7 +96,14 @@ def clear_registries() -> None:
 def health() -> Dict[str, object]:
     """Aggregate health: worst status over providers, with per-check
     detail.  A provider that raises reports ``degraded`` (a broken
-    check is itself a degradation, but must not fabricate an abort)."""
+    check is itself a degradation, but must not fabricate an abort).
+
+    ``pid``/``time`` ride every response as the answering process's
+    identity: a supervisor that restarts a worker onto the same port
+    can tell the fresh process from a stale one it is about to
+    replace (supervisor/probe.py reads ``pid``)."""
+    import os
+    import time as _time
     with _reg_lock:
         providers = dict(_health)
     checks: Dict[str, Dict[str, Optional[str]]] = {}
@@ -111,7 +118,8 @@ def health() -> Dict[str, object]:
         checks[name] = {"status": status, "reason": reason}
         if _STATUS_RANK[status] > _STATUS_RANK[worst]:
             worst = status
-    return {"status": worst, "checks": checks}
+    return {"status": worst, "checks": checks,
+            "pid": os.getpid(), "time": _time.time()}
 
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")

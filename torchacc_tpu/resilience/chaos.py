@@ -69,6 +69,7 @@ class _Rule:
     rate: float = 0.0              # ... plus with this seeded probability
     exc: Callable[[str], BaseException] = OSError
     sleep_s: float = 0.0           # > 0: hang (sleep) instead of raising
+    after: int = 0                 # skip this many hits before injecting
     raised: int = 0
     hits: int = 0
 
@@ -95,18 +96,26 @@ class ChaosPlan:
         self._rng = random.Random(self.seed)
 
     def fail(self, point: str, *, times: int = 1, rate: float = 0.0,
+             after: int = 0,
              exc: Callable[[str], BaseException] = OSError) -> "ChaosPlan":
-        self._rules[point] = _Rule(times=times, rate=rate, exc=exc)
+        """``after``: let the first ``after`` hits of ``point`` pass
+        clean before the ``times`` deterministic injections start —
+        "crash on the Nth step", not just "crash immediately" (the
+        supervisor chaos gate schedules faults mid-run with it)."""
+        self._rules[point] = _Rule(times=times, rate=rate, exc=exc,
+                                   after=after)
         return self
 
     def hang(self, point: str, *, seconds: float,
-             times: int = 1) -> "ChaosPlan":
-        """Make the first ``times`` hits of ``point`` SLEEP ``seconds``
-        instead of raising — a deterministic mid-step/mid-fetch hang for
-        exercising the watchdog (resilience/watchdog.py) past its
-        deadline.  The sleep returns normally: what the run does about
-        the stall is entirely the watchdog's decision."""
-        self._rules[point] = _Rule(times=times, sleep_s=seconds)
+             times: int = 1, after: int = 0) -> "ChaosPlan":
+        """Make the first ``times`` hits of ``point`` (past the clean
+        ``after`` prefix) SLEEP ``seconds`` instead of raising — a
+        deterministic mid-step/mid-fetch hang for exercising the
+        watchdog (resilience/watchdog.py) past its deadline.  The
+        sleep returns normally: what the run does about the stall is
+        entirely the watchdog's decision."""
+        self._rules[point] = _Rule(times=times, sleep_s=seconds,
+                                   after=after)
         return self
 
     def hit(self, point: str, ctx: Dict[str, Any]) -> None:
@@ -114,6 +123,8 @@ class ChaosPlan:
         if rule is None:
             return
         rule.hits += 1
+        if rule.hits <= rule.after:
+            return
         inject = (rule.raised < rule.times
                   or (rule.rate > 0.0 and self._rng.random() < rule.rate))
         if inject:

@@ -143,6 +143,14 @@ class ServeEngine:
         self._queue: "collections.deque[Sequence]" = collections.deque()
         self._all: Dict[int, Sequence] = {}
         self._next_id = 0
+        # graceful drain (docs/serving.md "Graceful drain"): once set,
+        # admission stops; in-flight decodes finish; queued requests
+        # are reported unserved — the serving half of preemption.
+        # _drain_reported keeps the unserved accounting one-shot: a
+        # second run() on a drained engine must not re-count the same
+        # ids into serve_requests_unserved
+        self._draining = False
+        self._drain_reported = False
         self._metrics = open_metrics(metrics_dir)
         self._completed = 0
         self._agg = self._fresh_agg()
@@ -304,6 +312,9 @@ class ServeEngine:
         failure, so attempting it IS the fit check (and the only one
         that sees prefix-cache hits, which shrink the fresh-block
         need)."""
+        if self._draining:
+            # drain: the queue is frozen — nothing new enters a slot
+            return
         if not self._queue or self.scheduler.free_slot() is None:
             # at capacity: don't copy/sort the (possibly thousands
             # deep) queue on the per-token hot loop when nothing can
@@ -353,18 +364,40 @@ class ServeEngine:
         self._drain_events()
         # scheduler.busy() == False already implies the ring drained
         # (an empty slot table with entries in flight is impossible:
-        # eviction only happens at resolution), so nothing to flush
+        # eviction only happens at resolution), so nothing to flush.
+        # Draining: queued requests will never admit — only in-flight
+        # work counts as "work left"
+        if self._draining:
+            return self.scheduler.busy()
         return bool(self._queue) or self.scheduler.busy()
 
     def run(self, max_iters: int = 1_000_000) -> None:
-        """Drive until every submitted request completed."""
+        """Drive until every submitted request completed — or, after a
+        preemption signal (SIGTERM) with ``serve.drain_on_preempt``,
+        until the in-flight decodes finish (queued requests stay
+        unserved and are reported; docs/serving.md "Graceful drain")."""
+        watch_preempt = self.config.serve.drain_on_preempt
+        if watch_preempt:
+            from torchacc_tpu.resilience.preemption import (
+                install_preemption_handler,
+            )
+            install_preemption_handler()
         idle = 0
         for _ in range(max_iters):
+            if watch_preempt and not self._draining:
+                from torchacc_tpu.resilience.preemption import (
+                    preemption_requested,
+                )
+                if preemption_requested():
+                    self.begin_drain("preemption signal")
             if not self.step():
+                if self._draining:
+                    self._log_drain_report()
                 return
             # defensive no-progress detection: queued work that can
             # never admit while nothing is running is a config error
-            if (self._queue and not self.scheduler.busy()):
+            if (self._queue and not self.scheduler.busy()
+                    and not self._draining):
                 idle += 1
                 if idle > 3:
                     raise RuntimeError(
@@ -374,6 +407,61 @@ class ServeEngine:
             else:
                 idle = 0
         raise RuntimeError(f"run() exceeded {max_iters} iterations")
+
+    # -- graceful drain ------------------------------------------------------
+
+    def begin_drain(self, reason: str = "") -> None:
+        """Stop admission NOW; in-flight decodes run to completion
+        (an admitted request always finishes — the whole-reservation
+        guarantee), queued requests stay queued and are reported
+        unserved.  Idempotent.  The serving-side half of preemption:
+        the supervisor's SIGTERM grace window finishes what the users
+        are already waiting on, never starts new work."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_reported = False
+        counters.inc("serve_drains")
+        logger.warning(
+            f"serve engine draining"
+            + (f" ({reason})" if reason else "")
+            + f": admission stopped with {len(self._queue)} queued, "
+            f"{sum(s is not None for s in self.scheduler.slot_seq)} "
+            "in flight — in-flight decodes will finish")
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def unserved_ids(self) -> List[int]:
+        """Request ids admitted to the QUEUE but never to a decode
+        slot (drain report; empty while not draining unless callers
+        inspect mid-flight)."""
+        return [s.sid for s in self._queue]
+
+    def drain_report(self) -> Dict[str, Any]:
+        """The machine-readable drain summary a supervisor (or the
+        operator restarting the pod) consumes: what finished, what
+        never started — resubmit the unserved ids elsewhere."""
+        return {
+            "draining": self._draining,
+            "completed": self._completed,
+            "in_flight": sorted(
+                s.sid for s in self.scheduler.slot_seq if s is not None),
+            "unserved": self.unserved_ids(),
+        }
+
+    def _log_drain_report(self) -> None:
+        if self._drain_reported:
+            return
+        self._drain_reported = True
+        r = self.drain_report()
+        counters.inc("serve_requests_unserved", len(r["unserved"]))
+        logger.warning(
+            f"serve drain complete: {r['completed']} request(s) "
+            f"finished, {len(r['unserved'])} never admitted "
+            f"(unserved ids: {r['unserved']}) — resubmit them on the "
+            "replacement pod")
 
     def generate(self, requests: List[Request]) -> List[RequestResult]:
         """Convenience batch API: submit everything, run to completion,

@@ -1,0 +1,40 @@
+"""Supervisor daemon: the automated driver closing the fault-tolerance
+loop (sense -> decide -> restart -> rejoin).  See supervisor/daemon.py
+for the architecture and docs/resilience.md "Supervisor" for the
+policy table and tuning knobs."""
+
+from torchacc_tpu.supervisor.daemon import Supervisor, WorkerSpec, free_port
+from torchacc_tpu.supervisor.policy import (
+    Action,
+    ExitDisposition,
+    PolicyEngine,
+    RestartPolicy,
+)
+from torchacc_tpu.supervisor.probe import (
+    ProbeClient,
+    ProbeResult,
+    WorkerProber,
+)
+from torchacc_tpu.supervisor.worker import (
+    WorkerHandle,
+    newest_valid_step,
+    read_exit_disposition,
+    valid_steps,
+)
+
+__all__ = [
+    "Action",
+    "ExitDisposition",
+    "PolicyEngine",
+    "ProbeClient",
+    "ProbeResult",
+    "RestartPolicy",
+    "Supervisor",
+    "WorkerHandle",
+    "WorkerProber",
+    "WorkerSpec",
+    "free_port",
+    "newest_valid_step",
+    "read_exit_disposition",
+    "valid_steps",
+]

@@ -1,0 +1,462 @@
+"""The supervisor daemon: sense -> decide -> restart -> rejoin.
+
+ROADMAP #3(b): every recovery primitive existed (typed errors, SDC
+quarantine, elastic resume, tiered RAM/peer restore, the telemetry
+probes) but a ``SDCError`` still ended with a human restarting the
+job.  :class:`Supervisor` is the missing driver — it owns the worker
+processes end-to-end:
+
+- **launch**: one subprocess per host (the local fixture; the same
+  loop is the per-pod unit in production), argv rendered from a
+  template with ``{host}/{world}/{incarnation}/{run_dir}/{coord_port}/
+  {obs_port}`` placeholders, a fresh coordinator port per incarnation;
+- **sense** through three channels: worker exit disposition (the
+  strict-JSON ``exit_disposition`` block of the flight bundle —
+  obs/flight.py), ``/healthz`` polling with retry/backoff and a
+  consecutive-failure threshold (supervisor/probe.py: a degraded
+  endpoint is NOT a dead worker), and a per-incarnation wall-clock
+  deadline as the last-resort hang detector;
+- **decide** via the declarative policy engine (supervisor/policy.py):
+  SDC/quarantine -> restart excluding the named host(s) with elastic
+  shrink, hang -> kill + restart the same world, preemption ->
+  wait-and-resume, anything else -> bounded jittered crash-loop
+  backoff with a restart budget and a terminal give-up;
+- **restart into rejoin**: the relaunched workers run
+  ``fit(resume='auto')`` which picks the newest valid tier pod-wide
+  (PR 9) — including a replaced host rejoining from a healthy peer's
+  tier-0 RAM snapshot, zero storage reads.
+
+Observability: every decision is logged with the typed error and the
+policy rule that produced it, the
+``supervisor_restarts/_exclusions/_giveups/...`` counters ride
+``/metrics`` (utils.metrics counters surface automatically as
+``torchacc_*_total``; pass ``obs_port`` to serve them from the daemon
+itself), and a terminal give-up writes ``flight_giveup.json`` — a
+final flight bundle naming the reason, the decision history, and the
+last worker log tail.
+
+No jax anywhere in the supervisor modules themselves: the daemon
+judges runs whose processes are all dead, from the filesystem and HTTP
+alone, and never initialises a device backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchacc_tpu.supervisor.policy import (
+    Action,
+    ExitDisposition,
+    PolicyEngine,
+    RestartPolicy,
+)
+from torchacc_tpu.supervisor.probe import ProbeClient, WorkerProber
+from torchacc_tpu.supervisor.worker import (
+    WorkerHandle,
+    newest_valid_step,
+    read_exit_disposition,
+    render_argv,
+    render_template,
+)
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import counters
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class WorkerSpec:
+    """What to run and where (docs/resilience.md "Supervisor")."""
+
+    run_dir: str
+    world_size: int
+    #: argv template; placeholders: {host} {world} {incarnation}
+    #: {run_dir} {coord_port} {obs_port}
+    argv: List[str]
+    #: extra environment for every worker (values templated too)
+    env: Dict[str, str] = field(default_factory=dict)
+    #: per-incarnation worker logs land here (default:
+    #: <run_dir>/supervisor_logs)
+    log_dir: Optional[str] = None
+    #: probe workers over HTTP: each worker gets a fresh local port via
+    #: the {obs_port} placeholder and is polled at probe_interval_s.
+    #: Off (False): sensing is exit-disposition + deadline only.
+    probe: bool = False
+    probe_interval_s: float = 2.0
+    probe_timeout_s: float = 2.0
+    #: consecutive unreachable/unhealthy observations before the worker
+    #: is declared dead/hung (never a single-sample conclusion)
+    probe_unreachable_threshold: int = 3
+    probe_unhealthy_threshold: int = 3
+    #: startup grace: a worker that has NEVER answered is not declared
+    #: dead inside this window after launch (jax import + compile can
+    #: take minutes before the telemetry endpoint binds)
+    probe_grace_s: float = 120.0
+    #: grace for the OTHER workers to exit on their own after one
+    #: fails (pod-wide typed errors raise everywhere), before SIGTERM
+    exit_grace_s: float = 15.0
+    #: SIGTERM->SIGKILL escalation window when stopping a worker
+    term_grace_s: float = 10.0
+    #: last-resort hang detector: an incarnation older than this is
+    #: killed and treated like a probe-dead worker.  None = no deadline.
+    incarnation_timeout_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        if not self.argv:
+            raise ValueError("worker argv template is empty")
+        if self.log_dir is None:
+            self.log_dir = os.path.join(self.run_dir, "supervisor_logs")
+
+
+class Supervisor:
+    """Own a supervised run to completion or terminal give-up."""
+
+    def __init__(self, spec: WorkerSpec,
+                 policy: Optional[RestartPolicy] = None, *,
+                 poll_interval_s: float = 0.25,
+                 obs_port: Optional[int] = None,
+                 rng=None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 prober_factory: Optional[
+                     Callable[[int, int], WorkerProber]] = None):
+        self.spec = spec
+        self.policy = policy if policy is not None else RestartPolicy()
+        self.engine = PolicyEngine(self.policy, spec.world_size, rng=rng)
+        self.poll_interval_s = float(poll_interval_s)
+        self._sleep = sleep
+        self._prober_factory = (prober_factory if prober_factory
+                                is not None else self._default_prober)
+        self.decisions: List[Dict[str, Any]] = []
+        self.incarnation = 0
+        self._last_durable = newest_valid_step(spec.run_dir)
+        self._handles: List[WorkerHandle] = []
+        self.final_bundle_path: Optional[str] = None
+        if obs_port is not None:
+            # the daemon's own /metrics endpoint: the supervisor_*
+            # counters ride it automatically (torchacc_*_total)
+            from torchacc_tpu.obs import server as obs_server
+            try:
+                obs_server.start(port=obs_port)
+            except OSError as e:
+                logger.warning(
+                    f"supervisor: telemetry port {obs_port} busy ({e}); "
+                    "continuing without /metrics")
+
+    # -- workers -------------------------------------------------------------
+
+    def _default_prober(self, host: int, port: int) -> WorkerProber:
+        s = self.spec
+        return WorkerProber(
+            ProbeClient(f"http://127.0.0.1:{port}",
+                        timeout_s=s.probe_timeout_s),
+            unreachable_threshold=s.probe_unreachable_threshold,
+            unhealthy_threshold=s.probe_unhealthy_threshold,
+            name=f"host{host}")
+
+    def _launch(self) -> Tuple[List[WorkerHandle],
+                               List[Optional[WorkerProber]]]:
+        s = self.spec
+        world = self.engine.world
+        coord_port = free_port()
+        handles, probers = [], []
+        for host in range(world):
+            obs_port = free_port() if s.probe else 0
+            mapping = {"host": host, "world": world,
+                       "incarnation": self.incarnation,
+                       "run_dir": s.run_dir, "coord_port": coord_port,
+                       "obs_port": obs_port}
+            argv = render_argv(s.argv, mapping)
+            env = {k: render_template(str(v), mapping)
+                   for k, v in (s.env or {}).items()}
+            log = os.path.join(
+                s.log_dir, f"inc{self.incarnation}_host{host}.log")
+            handle = WorkerHandle(host, argv, env=env,
+                                  log_path=log).start()
+            handles.append(handle)
+            if s.probe:
+                pr = self._prober_factory(host, obs_port)
+                # restart identity: /healthz answers carrying another
+                # pid are a stale process on a reused port, not this
+                # worker (WorkerProber.expect_pid)
+                if hasattr(pr, "expect_pid"):
+                    pr.expect_pid = handle.pid
+                probers.append(pr)
+            else:
+                probers.append(None)
+        return handles, probers
+
+    def _stop_all(self, handles: List[WorkerHandle]) -> None:
+        for h in handles:
+            if h.running():
+                h.terminate(self.spec.term_grace_s)
+        for h in handles:
+            h.close()
+
+    # -- sensing -------------------------------------------------------------
+
+    def _watch(self, handles: List[WorkerHandle],
+               probers: List[Optional[WorkerProber]]
+               ) -> Tuple[Optional[int], Optional[str]]:
+        """Block until the incarnation resolves.  Returns
+        ``(exit_code, probe_verdict)``: exit_code is 0 only when every
+        worker exited 0, the first nonzero code when one failed, and
+        None when the supervisor killed the workers (probe verdict /
+        deadline names why)."""
+        s = self.spec
+        t0 = time.monotonic()
+        first_exit_at: Optional[float] = None
+        next_probe = t0
+        while True:
+            codes = [h.poll() for h in handles]
+            exited = [c for c in codes if c is not None]
+            nonzero = [c for c in exited if c != 0]
+            if len(exited) == len(handles):
+                return (0 if not nonzero else nonzero[0]), None
+            if exited and first_exit_at is None:
+                first_exit_at = time.monotonic()
+            if nonzero and first_exit_at is not None \
+                    and time.monotonic() - first_exit_at > s.exit_grace_s:
+                # one worker failed and the rest did not follow it out
+                # within the grace — stop them; the failure verdict is
+                # the nonzero code + whatever bundle was written
+                logger.warning(
+                    "supervisor: worker failure did not propagate "
+                    f"pod-wide within {s.exit_grace_s:.0f}s — "
+                    "stopping the stragglers")
+                self._stop_all(handles)
+                return nonzero[0], None
+            if not nonzero and first_exit_at is not None \
+                    and time.monotonic() - first_exit_at > s.exit_grace_s:
+                # clean exits that never completed pod-wide: the
+                # stragglers are wedged (e.g. stuck in a collective
+                # their peer already left)
+                logger.warning(
+                    "supervisor: partial clean exit — stragglers "
+                    f"still running after {s.exit_grace_s:.0f}s; "
+                    "killing and treating as hung")
+                self._stop_all(handles)
+                return None, "dead"
+            if s.incarnation_timeout_s is not None \
+                    and time.monotonic() - t0 > s.incarnation_timeout_s:
+                logger.warning(
+                    f"supervisor: incarnation {self.incarnation} "
+                    f"exceeded {s.incarnation_timeout_s:.0f}s — "
+                    "killing (deadline hang detector)")
+                self._stop_all(handles)
+                return None, "dead"
+            if s.probe and time.monotonic() >= next_probe:
+                next_probe = time.monotonic() + s.probe_interval_s
+                for h, pr in zip(handles, probers):
+                    if pr is None or not h.running():
+                        continue
+                    pr.observe()
+                    if (not getattr(pr, "ever_reachable", True)
+                            and time.monotonic() - t0
+                            < s.probe_grace_s):
+                        # still starting up: no endpoint yet is not
+                        # death — the exit/deadline channels still
+                        # cover a worker that dies while starting
+                        continue
+                    v = pr.verdict()
+                    if v != "alive":
+                        logger.warning(
+                            f"supervisor: probe declares worker "
+                            f"host={h.host} {v} "
+                            f"(last={pr.last.status if pr.last else '?'}"
+                            f", consecutive unreachable="
+                            f"{pr.consecutive_unreachable} unhealthy="
+                            f"{pr.consecutive_unhealthy}) — killing "
+                            "the incarnation")
+                        counters.inc("supervisor_probe_kills")
+                        self._stop_all(handles)
+                        return None, v
+            self._sleep(self.poll_interval_s)
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        """Drive to completion.  Returns the report dict:
+        ``{"status": "completed"|"gave_up", "incarnations": N,
+        "excluded": [...], "world": W, "decisions": [...],
+        "final_bundle": path|None}``."""
+        s = self.spec
+        os.makedirs(s.run_dir, exist_ok=True)
+        try:
+            while True:
+                since = time.time()
+                handles, probers = self._launch()
+                self._handles = handles
+                try:
+                    exit_code, probe_verdict = self._watch(handles,
+                                                           probers)
+                finally:
+                    self._stop_all(handles)
+                disposition = read_exit_disposition(s.run_dir, since)
+                newest = newest_valid_step(s.run_dir)
+                if newest > self._last_durable:
+                    # durable progress since the last failure: the
+                    # crash-loop streak resets (policy.note_progress)
+                    self._last_durable = newest
+                    self.engine.note_progress()
+                action = self.engine.decide(disposition,
+                                            exit_code=exit_code,
+                                            probe_verdict=probe_verdict)
+                self._record(action, disposition, exit_code,
+                             probe_verdict)
+                if action.kind == "done":
+                    logger.info(
+                        f"supervisor: run complete after "
+                        f"{self.incarnation + 1} incarnation(s), "
+                        f"newest durable step {newest}")
+                    return self._report("completed")
+                if action.kind == "give_up":
+                    self.final_bundle_path = self._write_giveup(
+                        action, disposition, handles)
+                    logger.error(
+                        f"supervisor: TERMINAL give-up "
+                        f"[{action.rule}]: {action.reason} — final "
+                        f"bundle {self.final_bundle_path}")
+                    counters.inc("supervisor_giveups")
+                    return self._report("gave_up")
+                self._account(action)
+                if action.delay_s > 0:
+                    logger.info(
+                        f"supervisor: waiting {action.delay_s:.2f}s "
+                        f"before relaunch [{action.rule}]")
+                    self._sleep(action.delay_s)
+                self.incarnation += 1
+        finally:
+            self._stop_all(self._handles)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _account(self, action: Action) -> None:
+        if action.kind in ("restart", "restart_excluding"):
+            counters.inc("supervisor_restarts")
+        if action.kind == "restart_excluding":
+            counters.inc("supervisor_exclusions", len(action.hosts))
+        if action.rule in ("hang-restart", "probe-dead-restart"):
+            counters.inc("supervisor_hang_restarts")
+        if action.rule in ("crash-backoff", "sdc-reoccurred-excluded"):
+            counters.inc("supervisor_crash_restarts")
+        if action.kind == "resume":
+            counters.inc("supervisor_preempt_resumes")
+
+    def _record(self, action: Action,
+                disposition: Optional[ExitDisposition],
+                exit_code: Optional[int],
+                probe_verdict: Optional[str]) -> None:
+        d = disposition
+        entry = {
+            "incarnation": self.incarnation,
+            "rule": action.rule,
+            "action": action.kind,
+            "hosts": list(action.hosts),
+            "delay_s": round(action.delay_s, 3),
+            "reason": action.reason,
+            "exit_code": exit_code,
+            "probe_verdict": probe_verdict,
+            "error_type": d.error_type if d else None,
+            "flagged_step": d.flagged_step if d else None,
+            "resumable": dict(d.resumable) if d else {},
+            "world_after": self.engine.world,
+            "restarts_used": self.engine.restarts_used,
+        }
+        self.decisions.append(entry)
+        # the acceptance contract: EVERY decision is logged with the
+        # typed error and the policy rule that produced it
+        logger.warning(
+            f"supervisor decision [{action.rule}] "
+            f"error={d.error_type if d else None} "
+            f"step={d.flagged_step if d else None} "
+            f"exit_code={exit_code} probe={probe_verdict} "
+            f"-> {action.kind}"
+            + (f" exclude={list(action.hosts)}" if action.hosts else "")
+            + (f" delay={action.delay_s:.2f}s" if action.delay_s else "")
+            + f" (world={self.engine.world}, "
+              f"budget {self.engine.restarts_used}"
+              f"/{self.policy.max_restarts}): {action.reason}")
+
+    def _report(self, status: str) -> Dict[str, Any]:
+        return {
+            "status": status,
+            "incarnations": self.incarnation + 1,
+            "excluded": sorted(self.engine.excluded),
+            "world": self.engine.world,
+            "restarts_used": self.engine.restarts_used,
+            "newest_durable_step": self._last_durable,
+            "decisions": list(self.decisions),
+            "final_bundle": self.final_bundle_path,
+        }
+
+    def _write_giveup(self, action: Action,
+                      disposition: Optional[ExitDisposition],
+                      handles: List[WorkerHandle]) -> Optional[str]:
+        """The terminal artefact: a final flight bundle naming the
+        give-up reason, the decision history, and the last worker log
+        tail — everything the paged human needs in one file."""
+        from torchacc_tpu.obs.flight import FlightRecorder
+        rec = FlightRecorder(capacity=8)
+        rec.set_context("supervisor", {
+            "world_size": self.spec.world_size,
+            "excluded": sorted(self.engine.excluded),
+            "restarts_used": self.engine.restarts_used,
+            "max_restarts": self.policy.max_restarts,
+            "incarnations": self.incarnation + 1,
+        })
+        step = disposition.flagged_step if disposition else None
+        return rec.dump(
+            "supervisor_give_up", step=step,
+            dump_dir=self.spec.run_dir, filename="flight_giveup.json",
+            extra={
+                "rule": action.rule,
+                "reason": action.reason,
+                "decisions": self.decisions,
+                "last_disposition": (disposition.__dict__
+                                     if disposition else None),
+                "worker_log_tail": {h.host: h.tail()
+                                    for h in handles},
+            })
+
+
+def main_from_args(args) -> int:
+    """The ``supervise`` CLI subcommand body (checkpoint/cli.py owns
+    arg parsing; this stays jax-free).  Exit codes: 0 completed,
+    3 gave up."""
+    policy = RestartPolicy(
+        max_restarts=args.max_restarts,
+        backoff_initial_s=args.backoff_initial_s,
+        backoff_max_s=args.backoff_max_s,
+        backoff_jitter=args.backoff_jitter,
+        min_world=args.min_world,
+    )
+    env = {}
+    for kv in args.env or []:
+        if "=" not in kv:
+            raise SystemExit(f"--env expects KEY=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env[k] = v
+    spec = WorkerSpec(
+        run_dir=args.run_dir,
+        world_size=args.world,
+        argv=list(args.worker_argv),
+        env=env,
+        probe=args.probe,
+        incarnation_timeout_s=args.incarnation_timeout_s,
+        exit_grace_s=args.exit_grace_s,
+    )
+    sup = Supervisor(spec, policy, obs_port=args.obs_port)
+    report = sup.run()
+    print(json.dumps(report, indent=2))
+    return 0 if report["status"] == "completed" else 3

@@ -1,0 +1,198 @@
+"""Deterministic training worker for the supervisor chaos gate.
+
+``python -m torchacc_tpu.supervisor.fixture --run-dir D --world N
+--host I ...`` is the worker the supervisor launches in
+``make supervisor-smoke`` and the daemon tests: a tiny llama model on
+CPU (1 emulated device per process, dp = world) training a
+world-size-INDEPENDENT synthetic stream (global batch keyed by the
+step index, each host feeding its dp shard), with per-step SDC
+digests, tiered checkpointing, elastic resume, and the full telemetry
+plane (flight bundles + optional /healthz endpoint) armed — i.e. the
+production worker wiring, scaled down to seconds.
+
+Faults are ChaosPlan-driven from ``--chaos`` (strict JSON), applied
+only when ``--incarnation`` matches ``--chaos-incarnation`` (-1 =
+every incarnation), so the *supervisor* decides which incarnation is
+faulty simply by passing ``{incarnation}`` through:
+
+- ``{"flip": {"host": 1, "at": 3}}`` — SDC bit-flip on that host's
+  digest region at absolute step 3 -> SDCError naming the host,
+  quarantine record, abort;
+- ``{"hang": {"after": 2, "seconds": 4}}`` — the 3rd dispatched step
+  of this run sleeps 4s; with the armed 1s watchdog deadline and
+  ``abort_on_hang`` the run exits with HangError;
+- ``{"crash": {"after": 1}}`` — the 2nd dispatched step raises
+  CheckpointError (the unrecoverable-crash-loop stand-in);
+- ``{"preempt": {"after": 3}}`` — programmatic SIGTERM-equivalent
+  after 3 batches -> emergency save + clean return, disposition
+  reason "preemption".
+
+Exit code 0 = ran to --max-steps (or a handled preemption); 1 = typed
+framework error (the flight bundle carries the exit_disposition the
+supervisor acts on); 2 = bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+
+# effective only when this module is the FIRST torchacc/jax import of
+# the process (python -m re-imports the package first); the supervisor
+# passes the same settings via the worker env, which always works
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="torchacc_tpu.supervisor.fixture",
+        description="deterministic chaos-driven training worker "
+                    "(supervisor smoke/test fixture)")
+    p.add_argument("--run-dir", required=True)
+    p.add_argument("--world", type=int, default=1)
+    p.add_argument("--host", type=int, default=0)
+    p.add_argument("--coord-port", type=int, default=0,
+                   help="jax.distributed coordinator port (world > 1)")
+    p.add_argument("--obs-port", type=int, default=0,
+                   help="serve /metrics + /healthz here (0 = no server)")
+    p.add_argument("--incarnation", type=int, default=0)
+    p.add_argument("--max-steps", type=int, default=8)
+    p.add_argument("--checkpoint-every", type=int, default=2)
+    p.add_argument("--chaos", default="",
+                   help="strict-JSON fault spec (see module docstring)")
+    p.add_argument("--chaos-incarnation", type=int, default=0,
+                   help="apply --chaos only on this incarnation "
+                        "(-1 = every incarnation)")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def _global_batches(args, mesh, n):
+    """World-size-independent stream: the GLOBAL batch for step i is a
+    pure function of (seed, i), each host feeds its dp row shard — so
+    a dp=2 prefix resumed at dp=1 sees the identical token stream
+    (the PR 3 elastic-resume equivalence this gate leans on)."""
+    import numpy as np
+    rows, seq, vocab = 4, 16, 64
+    local_rows = rows // args.world
+    if args.world > 1:
+        from jax.experimental import multihost_utils
+        from jax.sharding import PartitionSpec as PS
+    for i in range(n):
+        rng = np.random.default_rng(args.seed * 100_003 + i)
+        g = rng.integers(0, vocab, (rows, seq)).astype(np.int32)
+        if args.world == 1:
+            yield {"input_ids": g}
+            continue
+        local = g[args.host * local_rows:(args.host + 1) * local_rows]
+        arr = multihost_utils.host_local_array_to_global_array(
+            local, mesh, PS(("dp", "fsdp"), ("sp", "spu")))
+        yield {"input_ids": arr}
+
+
+def main(argv=None) -> int:
+    args = _parse(sys.argv[1:] if argv is None else list(argv))
+    try:
+        chaos = json.loads(args.chaos) if args.chaos else {}
+    except ValueError as e:
+        print(f"fixture: bad --chaos JSON: {e}", file=sys.stderr)
+        return 2
+    apply_chaos = (args.chaos_incarnation < 0
+                   or args.incarnation == args.chaos_incarnation)
+    chaos = chaos if apply_chaos else {}
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if args.world > 1:
+        from torchacc_tpu.parallel.distributed import initialize_distributed
+        initialize_distributed(
+            coordinator_address=f"localhost:{args.coord_port}",
+            num_processes=args.world, process_id=args.host)
+    import jax.numpy as jnp
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.errors import TorchAccTPUError
+    from torchacc_tpu.models import get_preset
+    from torchacc_tpu.resilience import ChaosLoader, ChaosPlan
+    from torchacc_tpu.supervisor.worker import newest_valid_step
+    from torchacc_tpu.train import accelerate
+
+    hang = chaos.get("hang")
+    res = ta.ResilienceConfig(
+        sdc_check_interval_steps=1,
+        elastic_resume=True,
+        tiered_checkpointing=True,
+        refuse_quarantined=True,
+        step_deadline_s=(float(hang.get("deadline", 1.0)) if hang
+                         else None),
+        abort_on_hang=bool(hang),
+    )
+    obs = ta.ObsConfig(enabled=True,
+                       http_port=(args.obs_port or None))
+    cfg = ta.Config(
+        dist=ta.DistConfig(dp=ta.DPConfig(size=args.world)),
+        resilience=res, obs=obs,
+        perf=ta.PerfConfig(dispatch_depth=2))
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=1, num_heads=2, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.float32)
+    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
+    trainer.init()
+
+    plan = ChaosPlan(seed=args.seed)
+    armed = False
+    if "flip" in chaos:
+        f = chaos["flip"]
+        plan.flip_bits(host=int(f["host"]), at=int(f["at"]))
+        armed = True
+    if hang:
+        plan.hang("trainer.step", seconds=float(hang["seconds"]),
+                  after=int(hang.get("after", 0)))
+        armed = True
+    if "crash" in chaos:
+        from torchacc_tpu.errors import CheckpointError
+        plan.fail("trainer.step", times=1,
+                  after=int(chaos["crash"].get("after", 0)),
+                  exc=CheckpointError)
+        armed = True
+
+    loader = _global_batches(args, trainer.mesh, args.max_steps)
+    if "preempt" in chaos:
+        loader = ChaosLoader(
+            loader, preempt_after_step=int(chaos["preempt"]["after"]))
+
+    # machine-checkable resume expectation for the smoke driver: the
+    # newest commit-marked step BEFORE this incarnation restores
+    print(f"SUPERVISOR_RESUME_CANDIDATE="
+          f"{newest_valid_step(args.run_dir)}", flush=True)
+    ctx = plan if armed else contextlib.nullcontext()
+    try:
+        with ctx:
+            history = trainer.fit(
+                loader, checkpoint_dir=args.run_dir,
+                checkpoint_every=args.checkpoint_every,
+                max_steps=args.max_steps, log_every=1,
+                resume="auto")
+    except TorchAccTPUError as e:
+        # the flight bundle (exit_disposition included) is already on
+        # disk — the supervisor reads THAT, not this line
+        print(f"SUPERVISOR_ABORT type={type(e).__name__}: {e}",
+              flush=True)
+        return 1
+    for r in history:
+        print("SUPERVISOR_REC "
+              + json.dumps({"step": r["step"], "loss": r["loss"]}),
+              flush=True)
+    print(f"SUPERVISOR_DONE world={args.world} host={args.host} "
+          f"incarnation={args.incarnation}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

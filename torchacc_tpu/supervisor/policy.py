@@ -1,0 +1,303 @@
+"""Declarative restart-policy engine: typed error -> supervisor action.
+
+The sensing layers (worker exit disposition, ``/healthz`` probes, the
+flight-recorder bundle) answer *what happened*; this module answers
+*what to do about it*, deterministically, so every decision the daemon
+takes can be named in a log line and unit-tested with a seeded RNG —
+no wall clock anywhere in the engine (delays are *returned*, the daemon
+sleeps them).
+
+The rule table (docs/resilience.md "Supervisor"):
+
+=====================  =============================================
+observation            action
+=====================  =============================================
+preemption bundle      wait ``preempt_resume_delay_s``, resume same
+                       world (never consumes restart budget — the
+                       scheduler evicted us, nothing is broken)
+clean exit (rc 0)      done
+SDCError /             restart EXCLUDING the named + newly
+QuarantinedHostError   quarantined host(s); elastic shrink (PR 3)
+                       handles the smaller world.  Idempotent: a host
+                       already excluded is never excluded twice, and
+                       an SDC abort naming only already-excluded
+                       hosts falls through to crash-loop backoff
+                       (something else is wrong)
+HangError / probe      kill what is left, restart the SAME world —
+declares worker dead   a wedged device clears with a process restart,
+                       the topology is healthy
+anything else          bounded crash-loop: jittered exponential
+(CheckpointError,      backoff, ``max_restarts`` total budget,
+unknown crash)         terminal give-up with a final flight bundle
+=====================  =============================================
+
+Every restart except a preemption resume consumes one unit of the
+``max_restarts`` budget, so no failure mode — not even alternating
+ones — can spin the pod forever.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+#: error types whose remediation is "restart excluding the named hosts"
+_EXCLUDE_ERRORS = ("SDCError", "QuarantinedHostError")
+#: error types whose remediation is "kill + restart the same world"
+_HANG_ERRORS = ("HangError",)
+
+
+@dataclass
+class ExitDisposition:
+    """The machine-readable summary of why a worker stopped — parsed
+    from the ``exit_disposition`` block of a flight-recorder bundle
+    (obs/flight.py), never scraped from logs."""
+
+    reason: str = "unknown"
+    error_type: Optional[str] = None
+    flagged_step: Optional[int] = None
+    #: suspect host ids carried by the typed error (SDCError.hosts ...)
+    hosts: List[int] = field(default_factory=list)
+    #: hosts quarantined DURING the aborted run (vs its start)
+    quarantine_delta: List[int] = field(default_factory=list)
+    #: full quarantine file contents at dump time ({host: record})
+    quarantine: Dict[str, Any] = field(default_factory=dict)
+    #: newest resumable step per tier ({"tier0": 4, "tier1": 2, ...};
+    #: None = that tier holds nothing)
+    resumable: Dict[str, Optional[int]] = field(default_factory=dict)
+    preempted: bool = False
+    process_index: Optional[int] = None
+    world_size: Optional[int] = None
+    #: path of the bundle this was parsed from (logging only)
+    bundle_path: Optional[str] = None
+
+    @classmethod
+    def from_bundle(cls, bundle: Dict[str, Any],
+                    path: Optional[str] = None
+                    ) -> Optional["ExitDisposition"]:
+        """Parse a flight bundle dict; None when it carries no
+        disposition block (pre-PR-13 bundle, or a mid-run dump)."""
+        d = bundle.get("exit_disposition")
+        if not isinstance(d, dict):
+            return None
+        return cls(
+            reason=str(d.get("reason", "unknown")),
+            error_type=d.get("error_type"),
+            flagged_step=d.get("flagged_step"),
+            hosts=[int(h) for h in (d.get("hosts") or [])],
+            quarantine_delta=[int(h)
+                              for h in (d.get("quarantine_delta") or [])],
+            quarantine=dict(d.get("quarantine") or {}),
+            resumable=dict(d.get("resumable") or {}),
+            preempted=bool(d.get("preempted", False)),
+            process_index=d.get("process_index"),
+            world_size=d.get("world_size"),
+            bundle_path=path,
+        )
+
+    def newest_resumable(self) -> Optional[int]:
+        steps = [s for s in self.resumable.values() if s is not None]
+        return max(steps) if steps else None
+
+
+@dataclass(frozen=True)
+class Action:
+    """One supervisor decision.  ``rule`` names the policy row that
+    produced it — every decision log line and report entry carries it,
+    so an operator can always answer "why did it do that"."""
+
+    kind: str                     # done|resume|restart|restart_excluding|give_up
+    rule: str
+    hosts: Tuple[int, ...] = ()   # restart_excluding: the NEW exclusions
+    delay_s: float = 0.0
+    reason: str = ""
+
+
+@dataclass
+class RestartPolicy:
+    """The tuning knobs (docs/resilience.md "Supervisor" table)."""
+
+    #: total restart budget for the run — every restart except a
+    #: preemption resume consumes one; exhausted -> terminal give-up
+    max_restarts: int = 8
+    #: crash-loop backoff: delay = min(initial * mult^(streak-1), max),
+    #: jittered by +/- ``backoff_jitter`` (fraction)
+    backoff_initial_s: float = 1.0
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 60.0
+    backoff_jitter: float = 0.25
+    #: delay before an SDC-exclusion or hang restart (these are
+    #: "productive" restarts — default immediate)
+    restart_delay_s: float = 0.0
+    #: delay before resuming after a preemption bundle (give the
+    #: scheduler's eviction a moment to settle)
+    preempt_resume_delay_s: float = 0.0
+    #: never shrink the pod below this many hosts — an exclusion that
+    #: would leave fewer gives up instead (the incident needs a human)
+    min_world: int = 1
+
+    def validate(self) -> None:
+        if self.max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        if self.backoff_initial_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff delays must be >= 0")
+        if self.backoff_max_s < self.backoff_initial_s:
+            raise ValueError("backoff_max_s must be >= backoff_initial_s")
+        if self.backoff_multiplier < 1.0:
+            raise ValueError("backoff_multiplier must be >= 1.0")
+        if not 0.0 <= self.backoff_jitter < 1.0:
+            raise ValueError("backoff_jitter must be in [0, 1)")
+        if self.min_world < 1:
+            raise ValueError("min_world must be >= 1")
+
+
+class PolicyEngine:
+    """Stateful decision engine for ONE supervised run: tracks the
+    exclusion set, the consumed restart budget, and the consecutive
+    crash streak that drives the backoff curve.
+
+    Pure host logic — the only nondeterminism is the injected ``rng``
+    (jitter), so tests pin it."""
+
+    def __init__(self, policy: RestartPolicy, world_size: int, *,
+                 rng: Optional[random.Random] = None):
+        policy.validate()
+        if world_size < policy.min_world:
+            raise ValueError(
+                f"world_size {world_size} below min_world "
+                f"{policy.min_world}")
+        self.policy = policy
+        self.world_size = int(world_size)
+        self.excluded: set = set()
+        self.restarts_used = 0
+        self.crash_streak = 0
+        self._rng = rng if rng is not None else random.Random(0)
+
+    # -- state ---------------------------------------------------------------
+
+    @property
+    def world(self) -> int:
+        """The CURRENT world size (initial minus exclusions)."""
+        return self.world_size - len(self.excluded)
+
+    def note_progress(self) -> None:
+        """The run made durable progress (a new commit-marked step)
+        since the last failure — the crash streak resets so the next
+        unrelated failure backs off from the start of the curve, not
+        from where an old incident left it."""
+        self.crash_streak = 0
+
+    # -- the decision --------------------------------------------------------
+
+    def decide(self, disposition: Optional[ExitDisposition], *,
+               exit_code: Optional[int] = None,
+               probe_verdict: Optional[str] = None) -> Action:
+        """Map one incarnation's outcome to an action.
+
+        ``disposition``: the newest exit-disposition bundle written
+        during the incarnation (None = the worker left no postmortem).
+        ``exit_code``: the aggregate worker exit code (0 only when
+        every worker exited 0; None = workers were killed by the
+        supervisor).  ``probe_verdict``: 'dead'/'unhealthy' when the
+        probe layer — not the exit — triggered the decision."""
+        d = disposition
+        # 1. preemption is a planned exit: resume, budget untouched.
+        # Guarded on probe_verdict: when the SUPERVISOR killed the
+        # incarnation (probe-dead / deadline), its own SIGTERM made the
+        # workers write preemption bundles — mistaking that for a
+        # scheduler eviction would resume budget-free forever and mask
+        # the real failure
+        if d is not None and (d.preempted or d.reason == "preemption") \
+                and probe_verdict is None:
+            return Action("resume", "preempt-resume",
+                          delay_s=self.policy.preempt_resume_delay_s,
+                          reason="preemption bundle — waiting out the "
+                                 "eviction, then resuming")
+        # 2. clean completion
+        if exit_code == 0 and probe_verdict is None:
+            return Action("done", "clean-exit",
+                          reason="all workers exited 0 with no "
+                                 "abort disposition")
+        etype = d.error_type if d is not None else None
+        # 3. confirmed-bad-hardware: restart excluding the named hosts
+        if etype in _EXCLUDE_ERRORS:
+            want = set(d.hosts) | set(d.quarantine_delta)
+            fresh = tuple(sorted(want - self.excluded))
+            if fresh:
+                if self.world - len(fresh) < self.policy.min_world:
+                    return self._give_up(
+                        "sdc-exclude",
+                        f"{etype} names host(s) {sorted(want)} but "
+                        f"excluding them would shrink the pod below "
+                        f"min_world={self.policy.min_world}")
+                budget = self._consume_budget("sdc-exclude", etype)
+                if budget is not None:
+                    return budget
+                self.excluded.update(fresh)
+                self.crash_streak = 0
+                return Action(
+                    "restart_excluding", "sdc-exclude", hosts=fresh,
+                    delay_s=self.policy.restart_delay_s,
+                    reason=f"{etype} at step {d.flagged_step}: "
+                           f"excluding host(s) {list(fresh)}, elastic "
+                           f"shrink to world={self.world}")
+            # idempotence: the named hosts are ALREADY excluded — a
+            # recurrence means the exclusion did not fix it; treat as
+            # an ordinary crash so the backoff/budget bound applies
+            return self._crash("sdc-reoccurred-excluded",
+                               f"{etype} names only already-excluded "
+                               f"host(s) {sorted(want)}")
+        # 4. hang (typed, or sensed by the probe layer): same world
+        if etype in _HANG_ERRORS or probe_verdict in ("dead", "unhealthy"):
+            rule = ("hang-restart" if etype in _HANG_ERRORS
+                    else "probe-dead-restart")
+            budget = self._consume_budget(rule, etype or probe_verdict)
+            if budget is not None:
+                return budget
+            self.crash_streak = 0
+            why = (f"{etype} at step {d.flagged_step}" if d is not None
+                   and etype else f"probe verdict {probe_verdict!r}")
+            return Action("restart", rule,
+                          delay_s=self.policy.restart_delay_s,
+                          reason=f"{why}: kill + restart the same "
+                                 f"world ({self.world})")
+        # 5. everything else: bounded crash loop
+        return self._crash(
+            "crash-backoff",
+            f"{etype or 'unknown crash'} "
+            f"(exit_code={exit_code}, no further diagnosis)")
+
+    # -- helpers -------------------------------------------------------------
+
+    def _crash(self, rule: str, why: str) -> Action:
+        self.crash_streak += 1
+        budget = self._consume_budget(rule, why)
+        if budget is not None:
+            return budget
+        p = self.policy
+        base = min(p.backoff_initial_s
+                   * (p.backoff_multiplier ** (self.crash_streak - 1)),
+                   p.backoff_max_s)
+        # jitter in [-j, +j] of the base delay, never negative
+        delay = base * (1.0 + p.backoff_jitter
+                        * (2.0 * self._rng.random() - 1.0))
+        return Action("restart", rule, delay_s=max(delay, 0.0),
+                      reason=f"{why}: crash #{self.crash_streak} in a "
+                             f"row, backoff {delay:.2f}s "
+                             f"({self.restarts_used}/{p.max_restarts} "
+                             "restarts used)")
+
+    def _consume_budget(self, rule: str, why) -> Optional[Action]:
+        """Spend one restart; the give-up Action when the budget is
+        already gone (the caller returns it verbatim)."""
+        if self.restarts_used >= self.policy.max_restarts:
+            return self._give_up(
+                rule, f"restart budget exhausted "
+                      f"({self.policy.max_restarts}) — last failure: "
+                      f"{why}")
+        self.restarts_used += 1
+        return None
+
+    def _give_up(self, rule: str, reason: str) -> Action:
+        return Action("give_up", rule, reason=reason)

@@ -1,0 +1,213 @@
+"""Hardened probe client for the worker telemetry endpoint.
+
+The supervisor's second sensing channel: poll each worker's
+``/healthz`` (obs/server.py) and read ``/metrics`` counters without
+ever misclassifying a GC pause, a busy scrape, or a slow compile as
+death.  Three layers of hardening:
+
+- every HTTP request is **timeout-bounded** (a wedged endpoint costs
+  ``timeout_s``, never a supervisor hang);
+- a failed request retries with **jittered exponential backoff**
+  inside the call (transient refusals — the worker is mid-exec() — do
+  not surface at all);
+- the caller-facing verdict flips to ``dead``/``unhealthy`` only after
+  ``unreachable_threshold`` / ``unhealthy_threshold`` **consecutive**
+  bad observations (:class:`WorkerProber`) — one slow scrape is noise,
+  five in a row is a corpse.
+
+Stdlib-only (urllib), no jax anywhere: the supervisor daemon must run
+on a host that has never initialised a device."""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from torchacc_tpu.utils.logger import logger
+
+
+@dataclass
+class ProbeResult:
+    """One observation of a worker endpoint."""
+
+    status: str                       # ok|degraded|unhealthy|unreachable
+    checks: Dict[str, Any] = field(default_factory=dict)
+    pid: Optional[int] = None         # serving process (restart identity)
+    latency_s: float = 0.0
+    error: Optional[str] = None
+
+    @property
+    def reachable(self) -> bool:
+        return self.status != "unreachable"
+
+
+class ProbeClient:
+    """Timeout-bounded ``/healthz`` / ``/metrics`` reader with
+    in-call jittered retry.  ``sleep``/``rng`` are injectable so the
+    backoff schedule is testable without wall time."""
+
+    def __init__(self, base_url: str, *, timeout_s: float = 2.0,
+                 retries: int = 2, backoff_s: float = 0.2,
+                 backoff_multiplier: float = 2.0,
+                 max_backoff_s: float = 2.0, jitter: float = 0.5,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+
+    # -- raw fetch with retry ------------------------------------------------
+
+    def _delay(self, attempt: int) -> float:
+        base = min(self.backoff_s * (self.backoff_multiplier ** attempt),
+                   self.max_backoff_s)
+        return max(base * (1.0 + self.jitter
+                           * (2.0 * self._rng.random() - 1.0)), 0.0)
+
+    def _fetch(self, path: str):
+        """(status_code, body) with bounded retries; raises the last
+        error when every attempt failed."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                with urllib.request.urlopen(self.base_url + path,
+                                            timeout=self.timeout_s) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                # an HTTP status IS an answer (503 = unhealthy), never
+                # a retry case
+                return e.code, e.read().decode()
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                last = e
+                if attempt < self.retries:
+                    self._sleep(self._delay(attempt))
+        raise last if last is not None else OSError("unreachable")
+
+    # -- typed probes --------------------------------------------------------
+
+    def healthz(self) -> ProbeResult:
+        t0 = time.monotonic()
+        try:
+            code, body = self._fetch("/healthz")
+        except Exception as e:  # noqa: BLE001 - verdict, not crash
+            return ProbeResult("unreachable",
+                               latency_s=time.monotonic() - t0,
+                               error=repr(e))
+        latency = time.monotonic() - t0
+        try:
+            h = json.loads(body)
+            status = h.get("status", "unreachable")
+            if status not in ("ok", "degraded", "unhealthy"):
+                status = "unreachable"
+            return ProbeResult(status, checks=h.get("checks", {}),
+                               pid=h.get("pid"), latency_s=latency)
+        except ValueError:
+            return ProbeResult("unreachable", latency_s=latency,
+                               error=f"unparseable /healthz "
+                                     f"(HTTP {code})")
+
+    def metrics_text(self) -> Optional[str]:
+        try:
+            code, body = self._fetch("/metrics")
+        except Exception:  # noqa: BLE001
+            return None
+        return body if code == 200 else None
+
+    def counter(self, name: str) -> Optional[float]:
+        """One ``torchacc_<name>_total`` sample from ``/metrics``
+        (None when the endpoint or the series is missing)."""
+        text = self.metrics_text()
+        if text is None:
+            return None
+        want = f"torchacc_{name}_total "
+        for line in text.splitlines():
+            if line.startswith(want):
+                try:
+                    return float(line.rsplit(" ", 1)[1])
+                except ValueError:
+                    return None
+        return None
+
+
+class WorkerProber:
+    """Consecutive-failure accounting over a :class:`ProbeClient`.
+
+    ``verdict()`` answers ``alive`` until ``unreachable_threshold``
+    consecutive unreachable observations (-> ``dead``) or
+    ``unhealthy_threshold`` consecutive unhealthy ones
+    (-> ``unhealthy``); any reachable non-unhealthy observation resets
+    both streaks.  Degraded keeps the worker alive — a degraded
+    endpoint is NOT a dead worker (issue: never misclassify a GC pause
+    or busy scrape as death)."""
+
+    def __init__(self, client: ProbeClient, *,
+                 unreachable_threshold: int = 3,
+                 unhealthy_threshold: int = 3,
+                 expect_pid: Optional[int] = None,
+                 name: str = "worker"):
+        if unreachable_threshold < 1 or unhealthy_threshold < 1:
+            raise ValueError("thresholds must be >= 1")
+        self.client = client
+        self.unreachable_threshold = int(unreachable_threshold)
+        self.unhealthy_threshold = int(unhealthy_threshold)
+        #: the launched worker's OS pid: an answering endpoint whose
+        #: ``/healthz`` ``pid`` differs is a STALE process on a reused
+        #: port (the previous incarnation still unwinding), counted as
+        #: unreachable — never as this worker's health
+        self.expect_pid = expect_pid
+        self.name = name
+        self.consecutive_unreachable = 0
+        self.consecutive_unhealthy = 0
+        #: has this worker EVER answered?  A worker that is still
+        #: starting up (importing jax, compiling) has no endpoint yet —
+        #: the daemon holds unreachable verdicts inside its startup
+        #: grace window until the first successful answer
+        self.ever_reachable = False
+        self.last: Optional[ProbeResult] = None
+
+    def observe(self) -> ProbeResult:
+        r = self.client.healthz()
+        if (r.reachable and self.expect_pid is not None
+                and r.pid is not None and r.pid != self.expect_pid):
+            r = ProbeResult(
+                "unreachable", latency_s=r.latency_s,
+                error=f"stale endpoint: answering pid {r.pid} != "
+                      f"launched worker pid {self.expect_pid}")
+        self.last = r
+        if r.reachable:
+            self.ever_reachable = True
+        if r.status == "unreachable":
+            self.consecutive_unreachable += 1
+            self.consecutive_unhealthy = 0
+        elif r.status == "unhealthy":
+            self.consecutive_unhealthy += 1
+            self.consecutive_unreachable = 0
+        else:
+            if self.consecutive_unreachable or self.consecutive_unhealthy:
+                logger.info(
+                    f"probe {self.name}: recovered to {r.status} after "
+                    f"{self.consecutive_unreachable} unreachable / "
+                    f"{self.consecutive_unhealthy} unhealthy")
+            self.consecutive_unreachable = 0
+            self.consecutive_unhealthy = 0
+        return r
+
+    def verdict(self) -> str:
+        """'alive' | 'dead' | 'unhealthy' — thresholded, never a
+        single-sample conclusion."""
+        if self.consecutive_unreachable >= self.unreachable_threshold:
+            return "dead"
+        if self.consecutive_unhealthy >= self.unhealthy_threshold:
+            return "unhealthy"
+        return "alive"

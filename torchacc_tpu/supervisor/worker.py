@@ -1,0 +1,230 @@
+"""Worker-process lifecycle: spawn, watch, stop, read the postmortem.
+
+A :class:`WorkerHandle` owns ONE training worker subprocess (one per
+host on the local fixture; the per-pod unit in production).  The
+supervisor never parses worker stdout — sensing goes through the three
+machine channels (exit code, ``/healthz``, the flight bundle); stdout
+is only *captured* to a per-incarnation log file so a human can read
+it after the fact.
+
+The disposition reader and the commit-marker scan are here too: both
+are pure-filesystem (no orbax, no jax, no collectives) because the
+supervisor must be able to judge a run whose processes are all dead.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from torchacc_tpu.supervisor.policy import ExitDisposition
+from torchacc_tpu.utils.logger import logger
+
+#: the checkpoint commit marker (one home for the rule is
+#: checkpoint/io.py MANIFEST; duplicated here as a literal because the
+#: supervisor must not import the orbax-backed checkpoint stack)
+MANIFEST = "_MANIFEST"
+
+
+def valid_steps(directory: Optional[str]) -> List[int]:
+    """Commit-marked checkpoint steps, straight off the filesystem —
+    the same judgement ``TieredCheckpointManager._fs_valid_steps``
+    makes (a step dir whose ``_MANIFEST`` exists), importable without
+    jax/orbax."""
+    if not directory:
+        return []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(
+        int(n) for n in names
+        if n.isdigit() and os.path.exists(
+            os.path.join(directory, n, MANIFEST)))
+
+
+def newest_valid_step(directory: Optional[str]) -> int:
+    """-1 when nothing is durable yet."""
+    return max(valid_steps(directory), default=-1)
+
+
+def read_exit_disposition(run_dir: str, since: float
+                          ) -> Optional[ExitDisposition]:
+    """The decisive ``exit_disposition`` among the ``flight_*.json``
+    bundles written at or after ``since`` (wall time).
+
+    In a multi-host run every process dumps a bundle into the shared
+    run dir.  **Error-typed bundles outrank preemption bundles**: when
+    one worker aborts with a typed error, its healthy peers are
+    SIGTERMed out (by the pod's preemption sync, or by the supervisor's
+    straggler stop) and write *newer* preemption bundles — acting on
+    those would misread the incarnation's failure as a scheduler
+    eviction.  Within each class the newest wins (typed verdicts are
+    deterministic pod-wide).  A bundle older than ``since`` belongs to
+    a previous incarnation and is never re-acted on."""
+    try:
+        names = os.listdir(run_dir)
+    except OSError:
+        return None
+    candidates: List[Tuple[float, str]] = []
+    for n in names:
+        if not (n.startswith("flight_") and n.endswith(".json")):
+            continue
+        p = os.path.join(run_dir, n)
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            continue
+        # small grace: atomic-rename mtimes can predate `since` taken
+        # from a different clock read by a scheduler tick
+        if mtime >= since - 0.05:
+            candidates.append((mtime, p))
+    newest_plain: Optional[ExitDisposition] = None
+    for _, p in sorted(candidates, reverse=True):
+        try:
+            with open(p) as f:
+                bundle = json.load(f)
+        except (OSError, ValueError):
+            continue
+        d = ExitDisposition.from_bundle(bundle, path=p)
+        if d is None:
+            continue
+        if d.error_type is not None:
+            return d                     # newest ERROR bundle decides
+        if newest_plain is None:
+            newest_plain = d
+    return newest_plain
+
+
+class WorkerHandle:
+    """One worker subprocess: spawn, poll, escalate-stop."""
+
+    def __init__(self, host: int, argv: List[str], *,
+                 env: Optional[Dict[str, str]] = None,
+                 log_path: Optional[str] = None):
+        self.host = int(host)
+        self.argv = list(argv)
+        self.env = dict(env) if env is not None else None
+        self.log_path = log_path
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+        self.started_at: Optional[float] = None
+
+    def start(self) -> "WorkerHandle":
+        if self.proc is not None:
+            raise RuntimeError(f"worker {self.host} already started")
+        stdout = subprocess.DEVNULL
+        if self.log_path:
+            os.makedirs(os.path.dirname(self.log_path) or ".",
+                        exist_ok=True)
+            self._log_f = open(self.log_path, "ab")
+            stdout = self._log_f
+        env = dict(os.environ)
+        if self.env:
+            env.update(self.env)
+        self.started_at = time.time()
+        self.proc = subprocess.Popen(
+            self.argv, stdout=stdout, stderr=subprocess.STDOUT, env=env)
+        logger.info(
+            f"supervisor: launched worker host={self.host} "
+            f"pid={self.proc.pid}"
+            + (f" log={self.log_path}" if self.log_path else ""))
+        return self
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def poll(self) -> Optional[int]:
+        """Exit code, or None while running."""
+        if self.proc is None:
+            return None
+        return self.proc.poll()
+
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[int]:
+        if self.proc is None:
+            return None
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def terminate(self, grace_s: float = 5.0) -> Optional[int]:
+        """SIGTERM, wait up to ``grace_s`` (a preemption-aware worker
+        uses the window for its emergency save), then SIGKILL.
+        Returns the exit code."""
+        if self.proc is None or self.proc.poll() is not None:
+            return self.poll()
+        logger.info(
+            f"supervisor: SIGTERM worker host={self.host} "
+            f"pid={self.proc.pid} (grace {grace_s:.1f}s)")
+        try:
+            self.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+        rc = self.wait(grace_s)
+        if rc is None:
+            logger.warning(
+                f"supervisor: worker host={self.host} ignored SIGTERM "
+                f"for {grace_s:.1f}s — SIGKILL")
+            self.kill()
+            rc = self.wait(10.0)
+        return rc
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self._log_f is not None:
+            try:
+                self._log_f.close()
+            except OSError:
+                pass
+            self._log_f = None
+
+    def tail(self, n_bytes: int = 4000) -> str:
+        """Last bytes of the captured log (give-up bundles embed it so
+        the terminal artefact is self-contained)."""
+        if not self.log_path:
+            return ""
+        try:
+            with open(self.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(size - n_bytes, 0))
+                return f.read().decode("utf-8", "replace")
+        except OSError:
+            return ""
+
+
+def render_template(s: str, mapping: Dict[str, Any]) -> str:
+    """Substitute ``{host}``/``{world}``/... placeholders in ONE argv
+    element or env value.  Plain string replacement of the KNOWN keys
+    only — the string may legitimately be full of braces (a ``python
+    -c`` script body, a JSON chaos spec), so ``str.format`` would
+    misparse it.  A string that is nothing but an unrecognised
+    ``{word}`` token raises — a typo'd template must fail at launch,
+    not spawn a worker with a literal ``{wrold}``."""
+    import re
+    for k, v in mapping.items():
+        s = s.replace("{" + k + "}", str(v))
+    if re.fullmatch(r"\{\w+\}", s):
+        raise ValueError(
+            f"unknown placeholder in worker template element {s!r} "
+            f"(have: {sorted(mapping)})")
+    return s
+
+
+def render_argv(template: List[str], mapping: Dict[str, Any]) -> List[str]:
+    return [render_template(a, mapping) for a in template]

@@ -178,6 +178,9 @@ class Trainer:
                         or res.sdc_recompute_interval_steps is not None)
         self._sdc_monitor = None
         self._sdc_run_dir: Optional[str] = None
+        # the last fit's checkpoint dir: resumable_tiers() scans it for
+        # the exit disposition even after the abort closed the manager
+        self._last_checkpoint_dir: Optional[str] = None
         # dispatch pipelining (perf.dispatch_depth, module docstring):
         # the ring buffer of in-flight steps, the host-side mirror of
         # state.step (no per-step device fetch to learn the index), and
@@ -1071,6 +1074,28 @@ class Trainer:
         self._tiered_cache = (key, mgr)
         return mgr
 
+    def resumable_tiers(self) -> Dict[str, Optional[int]]:
+        """Newest resumable checkpoint step per tier — the field the
+        supervisor's exit disposition carries (obs/runtime.py): tier 0
+        = this process's verdicted host-RAM snapshots (survive an
+        in-process refit, die with the process), tier 1 = commit-marked
+        steps in the last checkpoint dir, tier 2 = the mirror.  None =
+        that tier holds nothing; all-filesystem except tier 0, so it
+        answers even after an abort closed the managers."""
+        from torchacc_tpu.checkpoint.tiered import TieredCheckpointManager
+        tiers: Dict[str, Optional[int]] = {
+            "tier0": None, "tier1": None, "tier2": None}
+        if self._tiered_cache is not None:
+            ram = self._tiered_cache[1]._ram_steps()
+            tiers["tier0"] = max(ram) if ram else None
+        fs = TieredCheckpointManager._fs_valid_steps(
+            self._last_checkpoint_dir)
+        tiers["tier1"] = max(fs) if fs else None
+        mirror = TieredCheckpointManager._fs_valid_steps(
+            self.config.resilience.tiered_mirror_dir)
+        tiers["tier2"] = max(mirror) if mirror else None
+        return tiers
+
     # -- train -> serve handoff ---------------------------------------------
     def serving_shardings(self, mesh: Optional[Mesh] = None) -> Any:
         """NamedSharding tree of the SERVING layout for ``state.params``:
@@ -1227,6 +1252,7 @@ class Trainer:
         res_cfg = self.config.resilience
         mgr = None
         tiered = None
+        self._last_checkpoint_dir = checkpoint_dir
         if checkpoint_dir is not None:
             if res_cfg.tiered_checkpointing:
                 # zero-stall tiered saves (checkpoint/tiered.py): the
