@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke obs-smoke supervisor-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -88,6 +88,17 @@ obs-smoke:
 supervisor-smoke:
 	JAX_PLATFORMS=cpu python scripts/supervisor_smoke.py
 
+# fleet-observability gate (docs/observability.md "Fleet view"): a
+# 2-process supervised run with an injected SDC flip must yield ONE
+# aggregated scrape from the daemon's obs port — Prometheus-parseable
+# with per-host labels, BOTH hosts' merged step_time_ms histogram, a
+# goodput breakdown whose buckets sum to wall clock within 5%, and
+# restart downtime attributed to the sdc-exclude policy rule — plus a
+# serve request whose trace id appears on every span of its lifecycle
+# in the exported Chrome-trace timeline
+fleet-smoke:
+	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -103,11 +114,12 @@ chaos:
 			tests/test_quant.py \
 			tests/test_handoff.py tests/test_tiered.py \
 			tests/test_obs.py tests/test_profiling.py \
-			tests/test_supervisor.py \
+			tests/test_supervisor.py tests/test_fleet.py \
 			-m "not slow" \
 			-q || exit 1; \
 	done
 	$(MAKE) supervisor-smoke
+	$(MAKE) fleet-smoke
 
 # multi-host robustness proof: 2-process jax.distributed fixtures
 # (cross-host resume consensus with divergent quarantine, preemption

@@ -1115,6 +1115,23 @@ def _bench_obs(args, wd: Watchdog, devs) -> int:
         if final_health["status"] != "ok":
             return fail(f"/healthz did not answer ok after fit "
                         f"({final_health})", "healthz")
+        # goodput breakdown for the leg-2 fit (obs/goodput.py): the
+        # buckets must sum to the fit wall clock — the same invariant
+        # `make fleet-smoke` gates pod-wide, checked here per-process
+        # on every PR (generous tolerance: this fit hosts an injected
+        # 1s hang whose tail is unlapped)
+        from torchacc_tpu.obs.goodput import (
+            check_sum as _gp_check,
+            summary_from_counters as _gp_summary,
+        )
+        goodput = _gp_summary(counters.snapshot())
+        gp_ok, gp_gap = _gp_check(goodput, tolerance=0.10)
+        if goodput["wall_ms"] <= 0 or not gp_ok:
+            return fail(
+                f"goodput buckets diverge from wall clock "
+                f"(wall {goodput['wall_ms']:.0f}ms, attributed "
+                f"{goodput['attributed_ms']:.0f}ms, gap {gp_gap:.1%})",
+                "goodput")
 
         # ---- leg 3: serve wave + one-timeline trace export --------------
         wd.stage("obs_serve", args.compile_budget)
@@ -1129,8 +1146,8 @@ def _bench_obs(args, wd: Watchdog, devs) -> int:
         engine = ServeEngine(smodel, sparams, scfg)
         prompts = [rng.integers(1, mc.vocab_size, size=n).tolist()
                    for n in (6, 12, 20, 9)]
-        engine.generate([Request(prompt_ids=p, max_new_tokens=8)
-                         for p in prompts])
+        serve_results = engine.generate(
+            [Request(prompt_ids=p, max_new_tokens=8) for p in prompts])
         with urllib.request.urlopen(srv.url + "/metrics",
                                     timeout=5) as r:
             serve_metrics = parse_prometheus(r.read().decode())
@@ -1155,6 +1172,21 @@ def _bench_obs(args, wd: Watchdog, devs) -> int:
         span_counts = {c: sum(1 for e in doc["traceEvents"]
                               if e.get("ph") == "X" and e.get("cat") == c)
                        for c in sorted(c for c in cats if c)}
+        # per-request trace ids (docs/observability.md "Per-request
+        # serve traces"): every served request's id must be findable in
+        # the exported timeline
+        for rr in serve_results:
+            if not rr.trace_id:
+                return fail("RequestResult carries no trace id", "trace")
+            if not any(
+                    e.get("args", {}).get("trace") == rr.trace_id
+                    or (e.get("args", {}).get("traces")
+                        and rr.trace_id in e["args"]["traces"])
+                    for e in doc["traceEvents"]):
+                return fail(
+                    f"trace id {rr.trace_id} of request "
+                    f"{rr.request_id} missing from the exported "
+                    f"timeline", "trace")
 
         # ---- leg 4: SDC abort -> flight bundle --------------------------
         wd.stage("obs_flight", args.compile_budget)
@@ -1201,6 +1233,11 @@ def _bench_obs(args, wd: Watchdog, devs) -> int:
                 "healthz_statuses_seen": sorted(set(statuses)),
                 "healthz_final": final_health["status"],
                 "metrics_parse_ok": True,
+                "goodput_fraction": round(goodput["goodput_fraction"], 4),
+                "goodput_wall_ms": round(goodput["wall_ms"], 1),
+                "goodput_buckets_ms": {k: round(v, 1) for k, v in
+                                       goodput["buckets"].items()},
+                "goodput_sum_gap": round(gp_gap, 4),
                 "trace_span_counts": span_counts,
                 "flight_bundle": os.path.basename(bundle_path),
                 "flight_step": bundle["step"],

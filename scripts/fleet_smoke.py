@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""`make fleet-smoke`: the end-to-end gate for pod-wide observability
+(docs/observability.md "Fleet view").
+
+Two legs, zero human intervention, all on CPU:
+
+1. **Supervised 2-process run with an injected SDC flip**, observed
+   entirely through the supervisor daemon's single pane of glass:
+   ChaosPlan flips bits on host 1 at step 3 -> SDCError -> the
+   supervisor excludes host 1 and the shrunken pod resumes and
+   finishes.  The gate then takes ONE aggregated scrape from the
+   daemon's obs port and asserts:
+
+   - ``/metrics`` parses as Prometheus text and carries per-host
+     labeled gauges (``torchacc_fleet_*{host="H"}``), summed worker
+     counters, and the MERGED ``step_time_ms`` histogram with BOTH
+     hosts' observations (``/fleet`` names each host's contribution);
+   - the worker goodput breakdown (aggregated ``goodput_*_ms``
+     counters) sums to wall clock within 5%;
+   - the supervisor's own downtime ledger attributes restart downtime
+     to the ``sdc-exclude`` policy rule (``down:sdc-exclude`` bucket +
+     ``supervisor_goodput_down_sdc_exclude_ms`` counter) and ALSO sums
+     to its wall clock within 5%;
+   - ``/fleet`` serves the strict-JSON decision history (rule, error
+     type, timestamp) and the satellite gauges
+     (``supervisor_uptime_s``, incarnation, per-host excluded/alive)
+     ride ``/metrics``;
+   - the daemon's ``/healthz`` carries the fleet straggler check.
+
+2. **Per-request serve trace ids**: a tiny in-process engine under
+   tracing serves two requests; request 0's ``trace_id`` must appear
+   on EVERY span of its lifecycle (queue -> admit -> prefill ->
+   decode -> deliver) and in the exported Chrome-trace timeline.
+
+FAILS (exit 1) unless every assertion holds.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from torchacc_tpu.obs.aggregate import parse_prometheus  # noqa: E402
+from torchacc_tpu.obs.goodput import (  # noqa: E402
+    check_sum,
+    summary_from_counters,
+)
+from torchacc_tpu.supervisor import (  # noqa: E402
+    RestartPolicy,
+    Supervisor,
+    WorkerSpec,
+    free_port,
+)
+
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+FIXTURE = [sys.executable, "-m", "torchacc_tpu.supervisor.fixture"]
+
+
+def check(ok, msg):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {msg}", flush=True)
+    if not ok:
+        raise SystemExit(f"fleet-smoke FAILED: {msg}")
+
+
+def get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def leg_fleet(tmp):
+    print("== leg 1: 2-process SDC chaos run -> one aggregated scrape "
+          "==", flush=True)
+    run_dir = os.path.join(tmp, "fleet")
+    obs_port = free_port()
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=2,
+        argv=FIXTURE + [
+            "--run-dir", "{run_dir}", "--world", "{world}",
+            "--host", "{host}", "--coord-port", "{coord_port}",
+            "--obs-port", "{obs_port}", "--incarnation", "{incarnation}",
+            "--max-steps", "7", "--checkpoint-every", "2",
+            "--chaos", json.dumps({"flip": {"host": 1, "at": 3}}),
+            "--chaos-incarnation", "0",
+            # hold each worker's endpoint open briefly so the fleet
+            # scraper's final window catches the run's last series
+            "--linger-s", "2.0",
+        ],
+        env=WORKER_ENV, exit_grace_s=120.0, incarnation_timeout_s=600.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=3),
+                     obs_port=obs_port, fleet_poll_interval_s=0.4)
+    t0 = time.time()
+    rep = sup.run()
+    print(f"  supervised run: {rep['status']}, excluded "
+          f"{rep['excluded']}, {time.time() - t0:.0f}s", flush=True)
+    check(rep["status"] == "completed" and rep["excluded"] == [1],
+          "SDC incident recovered unattended (host 1 excluded)")
+
+    # ---- ONE aggregated scrape --------------------------------------------
+    text = get(f"http://127.0.0.1:{obs_port}/metrics")
+    counters, gauges, hists = parse_prometheus(text)
+    check("fleet_step_time_ms" in hists,
+          "aggregated /metrics carries the merged step_time_ms "
+          "histogram")
+    merged = hists["fleet_step_time_ms"]
+    check(merged.count >= 9,
+          f"merged histogram holds both incarnations' steps "
+          f"(count {merged.count} >= 9)")
+    check('{host="' in text,
+          "aggregated /metrics carries per-host labeled series")
+    check("torchacc_fleet_host_excluded{host=\"1\"} 1" in text,
+          "per-host excluded gauge names host 1")
+    check("torchacc_supervisor_uptime_s" in text
+          and "torchacc_supervisor_incarnation" in text,
+          "supervisor uptime/incarnation gauges ride /metrics")
+    check(counters.get("supervisor_exclusions", 0) >= 1,
+          "supervisor exclusion counter on the same scrape")
+    check(counters.get("supervisor_goodput_down_sdc_exclude_ms", 0) > 0,
+          "restart downtime counter attributed to the sdc-exclude rule")
+
+    # ---- /fleet: per-host contributions, decisions, goodput ---------------
+    fleet = json.loads(get(f"http://127.0.0.1:{obs_port}/fleet"))
+    hosts = fleet["hosts"]
+    check(hosts.get("0", {}).get("step_time_count", 0) > 0
+          and hosts.get("1", {}).get("step_time_count", 0) > 0,
+          f"both hosts contributed step_time_ms observations "
+          f"(host0 {hosts.get('0', {}).get('step_time_count')}, "
+          f"host1 {hosts.get('1', {}).get('step_time_count')})")
+    dec = fleet.get("decisions", [])
+    check(dec and dec[0]["rule"] == "sdc-exclude"
+          and dec[0]["error_type"] == "SDCError"
+          and isinstance(dec[0].get("time"), float),
+          "decision history under /fleet names rule + error type + "
+          "timestamp")
+    gw = fleet["goodput_workers"]
+    ok, gap = check_sum(gw, tolerance=0.05)
+    check(ok and gw["wall_ms"] > 0,
+          f"worker goodput buckets sum to wall clock within 5% "
+          f"(gap {gap * 100:.1f}%, fraction "
+          f"{gw['goodput_fraction']:.2f})")
+    gs = fleet["goodput_supervisor"]
+    ok, gap = check_sum(gs, tolerance=0.05)
+    check(ok, f"supervisor active/downtime ledger sums to wall clock "
+              f"within 5% (gap {gap * 100:.1f}%)")
+    check(gs["buckets"].get("down:sdc-exclude", 0) > 0,
+          f"supervisor ledger attributes downtime to sdc-exclude "
+          f"({gs['buckets']})")
+    # the counter-reconstructed view must agree with the sums the
+    # aggregator computed (the wire round trip holds end to end; the
+    # scrape-side names carry the fleet_ prefix)
+    gw2 = summary_from_counters(counters, prefix="fleet_goodput_")
+    check(abs(gw2["wall_ms"] - gw["wall_ms"]) < 1e-6,
+          "prometheus round trip of goodput counters matches /fleet")
+
+    # ---- daemon /healthz carries the straggler check ----------------------
+    hz = json.loads(get(f"http://127.0.0.1:{obs_port}/healthz"))
+    check("fleet_straggler" in hz.get("checks", {}),
+          f"daemon /healthz includes the fleet straggler check "
+          f"({hz['checks'].get('fleet_straggler')})")
+
+
+def leg_serve_trace(tmp):
+    print("== leg 2: per-request trace ids through the serve path ==",
+          flush=True)
+    import jax
+    import jax.numpy as jnp
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import get_preset
+    from torchacc_tpu.models.transformer import TransformerLM
+    from torchacc_tpu.obs import tracing
+    from torchacc_tpu.obs.runtime import apply_config
+    from torchacc_tpu.serve.engine import Request, ServeEngine
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=1, num_heads=2, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.float32)
+    model = TransformerLM(mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = ta.Config(
+        obs=ta.ObsConfig(enabled=True),
+        serve=ta.ServeConfig(block_size=4, num_blocks=64, max_slots=4,
+                             prefill_chunk=8, decode_depth=2))
+    apply_config(cfg.obs)
+    tracing.clear()
+    eng = ServeEngine(model, params, cfg)
+    rids = [eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4)),
+            eng.submit(Request(prompt_ids=[4, 5], max_new_tokens=3))]
+    eng.run()
+    r0 = eng.result(rids[0])
+    tid = r0.trace_id
+    check(bool(tid), f"RequestResult carries a trace id ({tid!r})")
+
+    def carries(attrs):
+        return (attrs.get("trace") == tid
+                or (attrs.get("traces") and tid in attrs["traces"]))
+
+    names = sorted({s["name"] for s in tracing.snapshot()
+                    if carries(s["attrs"])})
+    lifecycle = ["serve/admit", "serve/decode", "serve/deliver",
+                 "serve/prefill", "serve/queue"]
+    check(all(n in names for n in lifecycle),
+          f"trace id on every lifecycle span ({names})")
+    trace_path = os.path.join(tmp, "fleet_serve_trace.json")
+    doc = tracing.export_chrome_trace(trace_path)
+    with open(trace_path) as f:
+        reread = json.load(f)          # the export is valid JSON
+    hits = [e for e in reread["traceEvents"] if carries(e.get("args", {}))]
+    check(len(hits) >= len(lifecycle) and len(doc["traceEvents"]) > 0,
+          f"trace id present in the exported Chrome-trace timeline "
+          f"({len(hits)} events)")
+    eng.close()
+
+
+def main() -> int:
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="fleet_smoke_") as tmp:
+        leg_fleet(tmp)
+        leg_serve_trace(tmp)
+    print(f"fleet-smoke PASSED in {time.time() - t0:.0f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,733 @@
+"""Fleet observability tests (obs/aggregate.py, obs/goodput.py,
+per-request serve trace ids; docs/observability.md "Fleet view").
+
+The contracts under test:
+
+- histogram WIRE round-trip: serialize -> parse -> merge equals
+  merged-in-process for empty/partial/Inf-bucket cases — the
+  aggregation path must neither invent nor drop observations;
+- ``parse_prometheus`` inverts the server's exposition (counters,
+  gauges, histograms) and survives garbage lines;
+- the fleet aggregator sums counters, labels gauges per-host, merges
+  histograms, folds a dying incarnation's totals into a monotonic
+  base (an excluded host's contribution stays visible), serves a
+  strict-JSON ``/fleet`` view, and feeds the drift detector from
+  step-time histogram deltas;
+- the drift detector flags ONLY sustained drift, names the slow host,
+  recovers, and never flags a uniform fleet;
+- the goodput ledger's buckets sum to wall clock (the fleet-smoke
+  invariant), publish as monotonic counters, and reconstruct through
+  ``summary_from_counters``;
+- a fit with obs on exports the goodput breakdown (counters + gauge +
+  flight bundle) and obs off exports nothing;
+- a serve request's trace id rides EVERY span of its lifecycle and
+  surfaces in ``RequestResult``;
+- the supervisor's decision records carry timestamps and the per-host
+  alive/excluded gauges render.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchacc_tpu as ta
+from torchacc_tpu.models import TransformerLM, get_preset
+from torchacc_tpu.obs import flight, hist, server, tracing
+from torchacc_tpu.obs.aggregate import (
+    DriftDetector,
+    FleetAggregator,
+    parse_prometheus,
+)
+from torchacc_tpu.obs.goodput import (
+    GoodputLedger,
+    check_sum,
+    summary_from_counters,
+)
+from torchacc_tpu.obs.hist import Histogram
+from torchacc_tpu.train import accelerate
+from torchacc_tpu.utils.metrics import counters
+
+pytestmark = pytest.mark.fleet
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    counters.reset()
+    tracing.configure(enabled=False)
+    tracing.clear()
+    hist.configure(enabled=False)
+    hist.reset()
+    server.stop()
+    server.clear_registries()
+    flight.recorder.clear()
+    yield
+    counters.reset()
+    tracing.configure(enabled=False)
+    tracing.clear()
+    hist.configure(enabled=False)
+    hist.reset()
+    server.stop()
+    server.clear_registries()
+    flight.recorder.clear()
+
+
+# -- histogram wire round-trip (the aggregation transport) --------------------
+
+
+def _via_wire(h: Histogram, name="torchacc_x") -> Histogram:
+    """Serialize to Prometheus text, parse back — the exact path a
+    fleet scrape takes."""
+    text = "\n".join([f"# TYPE {name} histogram"]
+                     + h.prometheus_lines(name))
+    _, _, hs = parse_prometheus(text)
+    assert "x" in hs, text
+    return hs["x"]
+
+
+@pytest.mark.parametrize("values_a,values_b", [
+    ([], [0.3, 7.0]),                         # empty vs partial
+    ([0.1, 0.1, 55.0], []),                   # partial vs empty
+    ([0.07, 3.0], [1e9, 2e9]),                # partial vs +Inf bucket
+    ([1e12], [0.05, 0.4, 2.2, 1e10]),         # Inf-heavy both sides
+])
+def test_wire_round_trip_merge_equals_in_process(values_a, values_b):
+    ha, hb = Histogram(), Histogram()
+    for v in values_a:
+        ha.observe(v)
+    for v in values_b:
+        hb.observe(v)
+    in_process = Histogram.from_wire(ha.to_wire()).merge(hb)
+    over_wire = _via_wire(ha).merge(_via_wire(hb))
+    # the observable state is identical: counts, count, sum — and
+    # therefore the re-serialized exposition
+    assert over_wire.counts == in_process.counts
+    assert over_wire.count == in_process.count
+    assert over_wire.sum == pytest.approx(in_process.sum, rel=1e-9)
+    assert (over_wire.prometheus_lines("m")
+            == in_process.prometheus_lines("m"))
+
+
+def test_wire_round_trip_parsed_merges_with_in_process():
+    # %g-printed bounds snap back onto the canonical ladder, so a
+    # parsed histogram merges with a live registry one
+    h = Histogram()
+    h.observe(0.42)
+    live = Histogram()
+    live.observe(3.3)
+    merged = _via_wire(h).merge(live)
+    assert merged.count == 2
+
+
+def test_to_wire_from_wire_exact():
+    h = Histogram()
+    for v in [0.06, 5.5, 123.0, 4e9]:
+        h.observe(v)
+    r = Histogram.from_wire(h.to_wire())
+    assert r.counts == h.counts and r.count == h.count
+    assert r.sum == h.sum and r.min == h.min and r.max == h.max
+
+
+def test_from_wire_rejects_invented_observations():
+    h = Histogram()
+    h.observe(1.0)
+    w = h.to_wire()
+    w["count"] = 7                           # claims more than buckets
+    with pytest.raises(ValueError, match="invent nor drop"):
+        Histogram.from_wire(w)
+
+
+def test_wire_sum_keeps_full_precision():
+    # regression: %g on _sum quantized long-run totals to 6 significant
+    # digits, turning the drift detector's window-delta means into
+    # noise — the wire must round-trip the float exactly
+    h = Histogram()
+    h.sum = 1234567890.125                   # past %g resolution
+    h.count = 1
+    h.counts[0] = 1
+    assert _via_wire(h).sum == h.sum
+
+
+def test_from_cumulative_rejects_decreasing():
+    with pytest.raises(ValueError, match="non-decreasing"):
+        Histogram.from_cumulative([1.0, 2.0], [3, 2], 3, 1.0)
+    with pytest.raises(ValueError, match="below the last"):
+        Histogram.from_cumulative([1.0, 2.0], [1, 3], 2, 1.0)
+
+
+# -- exposition parser --------------------------------------------------------
+
+
+def test_parse_prometheus_inverts_server_output():
+    counters.inc("steps", 5)
+    hist.configure(enabled=True)
+    hist.observe("step_time_ms", 12.0)
+    server.register_gauge("train_host_step", lambda: 9.0)
+    c, g, hs = parse_prometheus(server.prometheus_text())
+    assert c["steps"] == 5.0
+    assert g["train_host_step"] == 9.0
+    assert hs["step_time_ms"].count == 1
+    assert hs["step_time_ms"].sum == pytest.approx(12.0)
+
+
+def test_parse_prometheus_survives_garbage():
+    c, g, hs = parse_prometheus(
+        "not a metric line\n# HELP x y\ntorchacc_ok_total nan_oops\n"
+        "torchacc_half_total\n\n# TYPE torchacc_n_total counter\n"
+        "torchacc_n_total 2\n")
+    assert c == {"n": 2.0} and g == {} and hs == {}
+
+
+# -- drift detector -----------------------------------------------------------
+
+
+def test_drift_uniform_fleet_never_flags():
+    d = DriftDetector(factor=1.5, patience=2)
+    for _ in range(20):
+        d.observe_round({0: 10.0, 1: 10.4, 2: 9.8})
+    assert d.health() == ("ok", None)
+
+
+def test_drift_flags_sustained_straggler_and_recovers():
+    d = DriftDetector(factor=1.5, patience=3)
+    for _ in range(5):
+        d.observe_round({0: 10.0, 1: 10.0, 2: 10.0})
+    for i in range(2):                       # below patience: no flag
+        d.observe_round({0: 10.0, 1: 10.0, 2: 45.0})
+        assert d.health()[0] == "ok"
+    d.observe_round({0: 10.0, 1: 10.0, 2: 45.0})
+    status, reason = d.health()
+    assert status == "degraded" and "host 2" in reason
+    assert 2 in d.flagged()
+    d.observe_round({0: 10.0, 1: 10.0, 2: 10.5})
+    assert d.health() == ("ok", None)
+
+
+def test_drift_blip_does_not_flag():
+    d = DriftDetector(factor=1.5, patience=3)
+    for _ in range(5):
+        d.observe_round({0: 10.0, 1: 10.0})
+    d.observe_round({0: 10.0, 1: 60.0})
+    d.observe_round({0: 10.0, 1: 10.0})      # streak reset
+    d.observe_round({0: 10.0, 1: 60.0})
+    d.observe_round({0: 10.0, 1: 60.0})
+    assert d.health()[0] == "ok"             # never 3 in a row
+
+
+def test_drift_single_host_own_baseline():
+    d = DriftDetector(factor=2.0, patience=2, min_rounds=3)
+    for _ in range(4):
+        d.observe_round({0: 10.0})
+    d.observe_round({0: 50.0})
+    d.observe_round({0: 50.0})
+    status, reason = d.health()
+    assert status == "degraded" and "host 0" in reason
+    d.forget(0)
+    assert d.health() == ("ok", None)
+
+
+def test_drift_startup_transient_not_flagged_multihost():
+    # regression: the min_rounds warm-up must gate the PEERS path too —
+    # a host whose first windows are slow (compile/restore tail landing
+    # in step()) is starting up, not drifting
+    d = DriftDetector(factor=1.5, patience=2, min_rounds=4)
+    for _ in range(3):                       # slow from the first round
+        d.observe_round({0: 10.0, 1: 60.0})
+        assert d.health()[0] == "ok"
+    # past the warm-up, SUSTAINED slowness still flags
+    for _ in range(3):
+        d.observe_round({0: 10.0, 1: 60.0})
+    status, reason = d.health()
+    assert status == "degraded" and "host 1" in reason
+
+
+def test_drift_baseline_does_not_chase_drift():
+    d = DriftDetector(factor=1.5, patience=1, min_rounds=1)
+    for _ in range(4):
+        d.observe_round({0: 10.0, 1: 10.0})
+    base_before = d.baselines()[1]
+    for _ in range(10):
+        d.observe_round({0: 10.0, 1: 100.0})
+    assert d.baselines()[1] == base_before   # frozen while drifting
+    assert 1 in d.flagged()
+
+
+# -- goodput ledger -----------------------------------------------------------
+
+
+def test_ledger_buckets_sum_to_wall():
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0])
+    led.start()
+    t[0] = 1.0
+    led.lap("init_restore")
+    t[0] = 4.0
+    led.lap("step")
+    t[0] = 4.5
+    led.lap("checkpoint")
+    s = led.summary()
+    assert s["buckets"] == {"checkpoint": 0.5, "init_restore": 1.0,
+                            "step": 3.0}
+    ok, gap = check_sum(s)
+    assert ok and gap == 0.0
+    assert s["wall_s"] == 4.5 and s["unattributed_s"] == 0.0
+
+
+def test_ledger_productive_subtracts_host_blocked():
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0])
+    led.start()
+    t[0] = 10.0
+    led.lap("step")
+    led.sub_add("host_blocked", 4.0)
+    s = led.summary()
+    assert s["productive_s"] == 6.0
+    assert s["goodput_fraction"] == pytest.approx(0.6)
+    # sub meters never count toward the sum invariant
+    assert s["attributed_s"] == 10.0
+
+
+def test_ledger_supervisor_shape_active_is_productive():
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0])
+    led.start()
+    t[0] = 8.0
+    led.lap("active")
+    t[0] = 10.0
+    led.lap("down:sdc-exclude")
+    s = led.summary()
+    assert s["productive_s"] == 8.0
+    assert s["buckets"]["down:sdc-exclude"] == 2.0
+
+
+def test_ledger_publish_monotonic_and_reconstructs():
+    class C:
+        def __init__(self):
+            self.d = {}
+
+        def inc(self, n, k=1):
+            self.d[n] = self.d.get(n, 0) + k
+
+    t = [0.0]
+    led = GoodputLedger(clock=lambda: t[0])
+    led.start()
+    t[0] = 2.0
+    led.lap("step")
+    led.sub_add("host_blocked", 0.5)
+    c = C()
+    led.publish(c)
+    first = dict(c.d)
+    led.publish(c)                           # no double count
+    assert c.d == first
+    t[0] = 3.0
+    led.lap("down:crash-backoff")            # '-' sanitised to '_'
+    led.publish(c)
+    assert c.d["goodput_down_crash_backoff_ms"] == 1000
+    s = summary_from_counters(c.d)
+    assert s["buckets"]["step"] == 2000
+    assert s["sub"]["host_blocked"] == 500
+    assert s["productive_ms"] == 1500
+    ok, _ = check_sum(s)
+    assert ok
+
+
+def test_ledger_before_start_is_noop():
+    led = GoodputLedger()
+    assert led.lap("step") == 0.0
+    assert led.summary()["wall_s"] == 0.0
+    ok, _ = check_sum(led.summary())
+    assert ok                                # empty passes trivially
+
+
+# -- fleet aggregator ---------------------------------------------------------
+
+
+def _worker_payloads(step_hists):
+    """Fake per-host /metrics + /healthz bodies."""
+    out = {}
+    for host, h in step_hists.items():
+        lines = [f"# TYPE torchacc_steps_total counter",
+                 f"torchacc_steps_total {5 * (host + 1)}",
+                 f"# TYPE torchacc_train_host_step gauge",
+                 f"torchacc_train_host_step {3 + host}",
+                 "# TYPE torchacc_step_time_ms histogram"]
+        lines += h.prometheus_lines("torchacc_step_time_ms")
+        out[host] = {
+            "/metrics": "\n".join(lines) + "\n",
+            "/healthz": json.dumps({"status": "ok", "checks": {},
+                                    "pid": 100 + host}),
+        }
+    return out
+
+
+def _agg_with(payloads, **kwargs):
+    def fetch(url, timeout):
+        host = int(url.split("host")[1].split("/")[0])
+        path = "/" + url.rsplit("/", 1)[1]
+        body = payloads[host].get(path)
+        if body is None:
+            raise OSError("down")
+        return body
+
+    agg = FleetAggregator(fetch=fetch, **kwargs)
+    agg.set_workers({h: f"http://host{h}" for h in payloads})
+    return agg
+
+
+def test_aggregator_sums_labels_and_merges():
+    h0, h1 = Histogram(), Histogram()
+    for v in [1.0, 2.0]:
+        h0.observe(v)
+    h1.observe(9.0)
+    agg = _agg_with(_worker_payloads({0: h0, 1: h1}))
+    agg.scrape_once()
+    text = agg.prometheus_text()
+    c, g, hs = parse_prometheus(text)        # the aggregate re-parses
+    assert c["fleet_steps"] == 15.0          # summed counters
+    assert hs["fleet_step_time_ms"].count == 3
+    assert hs["fleet_step_time_ms"].sum == pytest.approx(12.0)
+    assert 'torchacc_fleet_train_host_step{host="0"} 3' in text
+    assert 'torchacc_fleet_train_host_step{host="1"} 4' in text
+    fj = agg.fleet_json()
+    assert fj["hosts"]["0"]["pid"] == 100 and fj["hosts"]["1"]["up"]
+    assert fj["hosts"]["1"]["step"] == 4.0
+    # /fleet is strict JSON end to end
+    json.loads(json.dumps(flight.json_safe(fj), allow_nan=False))
+
+
+def test_aggregator_rollover_keeps_excluded_hosts_contribution():
+    h0, h1 = Histogram(), Histogram()
+    h0.observe(1.0)
+    h1.observe(9.0)
+    payloads = _worker_payloads({0: h0, 1: h1})
+    agg = _agg_with(payloads)
+    agg.scrape_once()
+    # incarnation 1: host 1 excluded, host 0 relaunched (fresh counters)
+    h0b = Histogram()
+    h0b.observe(2.0)
+    fresh = _worker_payloads({0: h0b})
+    payloads.clear()
+    payloads.update(fresh)
+    agg.set_workers({0: "http://host0"}, incarnation=1)
+    agg.scrape_once()
+    merged = agg.merged_histogram("step_time_ms")
+    # host0 inc0 + host1 inc0 (folded) + host0 inc1
+    assert merged.count == 3
+    assert merged.sum == pytest.approx(12.0)
+    assert agg.aggregated_counters()["steps"] == 20.0  # 5 + 10 + 5
+    fj = agg.fleet_json()
+    assert fj["hosts"]["1"]["present"] is False
+    assert fj["hosts"]["1"]["step_time_count"] == 1
+    assert fj["incarnation"] == 1
+
+
+def test_aggregator_dead_worker_keeps_last_good():
+    h0 = Histogram()
+    h0.observe(1.0)
+    payloads = _worker_payloads({0: h0})
+    agg = _agg_with(payloads)
+    agg.scrape_once()
+    payloads[0] = {}                         # endpoint died
+    agg.scrape_once()
+    fj = agg.fleet_json()
+    assert fj["hosts"]["0"]["up"] is False
+    assert fj["hosts"]["0"]["error"] is not None
+    assert agg.merged_histogram("step_time_ms").count == 1
+
+
+def test_aggregator_feeds_drift_from_scrape_deltas():
+    drift = DriftDetector(factor=1.5, patience=2, min_rounds=1)
+    h0, h1 = Histogram(), Histogram()
+    payloads = _worker_payloads({0: h0, 1: h1})
+    agg = _agg_with(payloads, drift=drift)
+
+    def advance(mean0, mean1):
+        h0.observe(mean0)
+        h1.observe(mean1)
+        payloads.update(_worker_payloads({0: h0, 1: h1}))
+        agg.scrape_once()
+
+    for _ in range(4):
+        advance(10.0, 10.0)
+    assert drift.health()[0] == "ok"
+    advance(10.0, 80.0)
+    advance(10.0, 80.0)
+    status, reason = drift.health()
+    assert status == "degraded" and "host 1" in reason
+    assert "host 1" in agg.fleet_json()["drift"]["reason"]
+
+
+def test_aggregator_goodput_rollup():
+    lines = ("# TYPE torchacc_goodput_wall_ms_total counter\n"
+             "torchacc_goodput_wall_ms_total 1000\n"
+             "# TYPE torchacc_goodput_step_ms_total counter\n"
+             "torchacc_goodput_step_ms_total 950\n")
+    payloads = {0: {"/metrics": lines,
+                    "/healthz": json.dumps({"status": "ok"})},
+                1: {"/metrics": lines,
+                    "/healthz": json.dumps({"status": "ok"})}}
+    agg = _agg_with(payloads)
+    agg.scrape_once()
+    gw = agg.fleet_json()["goodput_workers"]
+    assert gw["wall_ms"] == 2000.0 and gw["buckets"]["step"] == 1900.0
+    ok, _ = check_sum(gw)
+    assert ok
+
+
+def test_aggregator_context_contributes_and_degrades():
+    payloads = {0: {"/metrics": "", "/healthz": json.dumps(
+        {"status": "ok"})}}
+    agg = _agg_with(payloads, context=lambda: {"supervisor": {"w": 2}})
+    assert agg.fleet_json()["supervisor"] == {"w": 2}
+
+    def boom():
+        raise RuntimeError("nope")
+
+    agg2 = _agg_with(payloads, context=boom)
+    assert "context_error" in agg2.fleet_json()
+
+
+# -- server provider seams ----------------------------------------------------
+
+
+def test_server_text_provider_appends_and_isolates_breakage():
+    server.register_text("extra", lambda: "# TYPE x gauge\nx 1")
+
+    def broken():
+        raise RuntimeError("boom")
+
+    server.register_text("broken", broken)
+    text = server.prometheus_text()
+    assert "x 1" in text
+    server.unregister_text("extra")
+    assert "x 1" not in server.prometheus_text()
+
+
+def test_server_json_route_served_and_reserved_paths_refused():
+    with pytest.raises(ValueError):
+        server.register_json("/metrics", dict)
+    with pytest.raises(ValueError):
+        server.register_json("fleet", dict)
+    server.register_json("/fleet", lambda: {"v": float("nan")})
+    srv = server.start(port=0)
+    import urllib.request
+    with urllib.request.urlopen(f"{srv.url}/fleet", timeout=10) as r:
+        body = json.loads(r.read().decode())
+    assert body == {"v": None}               # json_safe applied
+    server.unregister_json("/fleet")
+    import urllib.error
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(f"{srv.url}/fleet", timeout=10)
+
+
+# -- trainer e2e --------------------------------------------------------------
+
+
+def _model():
+    return get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      intermediate_size=64, dtype=jnp.float32)
+
+
+def _batches(n, seed=None):
+    rng = np.random.default_rng(CHAOS_SEED if seed is None else seed)
+    return [{"input_ids": rng.integers(0, 64, size=(8, 16)).astype(
+        np.int32)} for _ in range(n)]
+
+
+def _trainer(obs=None, **res_kwargs):
+    import optax
+    cfg = ta.Config(resilience=ta.ResilienceConfig(**res_kwargs),
+                    obs=obs or ta.ObsConfig())
+    tr, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3))
+    return tr
+
+
+def test_fit_exports_goodput_breakdown(tmp_path):
+    tr = _trainer(obs=ta.ObsConfig(enabled=True))
+    tr.fit(_batches(5), max_steps=5, log_every=1,
+           checkpoint_dir=str(tmp_path / "ck"), checkpoint_every=2)
+    snap = counters.snapshot()
+    assert snap.get("goodput_step_ms", 0) > 0
+    assert "goodput_wall_ms" in snap and "goodput_checkpoint_ms" in snap
+    s = summary_from_counters(snap)
+    ok, gap = check_sum(s, tolerance=0.05)
+    assert ok, f"buckets diverge from wall clock by {gap:.1%}"
+    assert 0.0 < s["goodput_fraction"] <= 1.0
+
+
+def test_fit_obs_off_exports_no_goodput():
+    tr = _trainer()
+    tr.fit(_batches(3), max_steps=3, log_every=1)
+    assert not any(k.startswith("goodput_")
+                   for k in counters.snapshot())
+
+
+def test_abort_bundle_carries_goodput(tmp_path):
+    from torchacc_tpu.errors import AnomalyError
+    from torchacc_tpu.resilience import ChaosLoader, chaos_loss
+    import optax
+    cfg = ta.Config(
+        resilience=ta.ResilienceConfig(nan_guard=True,
+                                       max_consecutive_anomalies=2),
+        obs=ta.ObsConfig(enabled=True))
+    tr, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3),
+                       loss=chaos_loss())
+    with pytest.raises(AnomalyError):
+        tr.fit(ChaosLoader(_batches(8), nan_loss_steps={2, 3, 4, 5}),
+               max_steps=8, log_every=1,
+               metrics_dir=str(tmp_path / "run"))
+    b = json.load(open(flight.recorder.last_dump_path))
+    g = b["extra"]["goodput"]
+    assert g["wall_s"] > 0 and "step" in g["buckets"]
+    ok, _ = check_sum(g, tolerance=0.25)     # abort tail is unlapped
+    assert ok or g["unattributed_s"] < 1.0
+
+
+def test_fit_goodput_gauge_registered_then_released(tmp_path):
+    tr = _trainer(obs=ta.ObsConfig(enabled=True))
+    seen = {}
+
+    class Probe:
+        def __iter__(self):
+            for i, b in enumerate(_batches(4)):
+                if i == 3:
+                    seen["text"] = server.prometheus_text()
+                yield b
+
+    tr.fit(Probe(), max_steps=4, log_every=1)
+    assert "torchacc_goodput_fraction" in seen["text"]
+    assert "torchacc_goodput_fraction" not in server.prometheus_text()
+
+
+# -- per-request serve trace ids ----------------------------------------------
+
+
+def _engine(obs_enabled=True):
+    from torchacc_tpu.obs.runtime import apply_config
+    from torchacc_tpu.serve.engine import ServeEngine
+    mc = _model()
+    model = TransformerLM(mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    cfg = ta.Config(
+        obs=ta.ObsConfig(enabled=obs_enabled),
+        serve=ta.ServeConfig(block_size=4, num_blocks=64, max_slots=4,
+                             prefill_chunk=8, decode_depth=2))
+    if obs_enabled:
+        apply_config(cfg.obs)
+    return ServeEngine(model, params, cfg)
+
+
+def _spans_carrying(tid):
+    out = {}
+    for s in tracing.snapshot():
+        a = s["attrs"]
+        if a.get("trace") == tid or (a.get("traces")
+                                     and tid in a["traces"]):
+            out.setdefault(s["name"], 0)
+            out[s["name"]] += 1
+    return out
+
+
+def test_trace_id_on_every_lifecycle_span():
+    from torchacc_tpu.serve.engine import Request
+    eng = _engine()
+    rids = [eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4)),
+            eng.submit(Request(prompt_ids=[4, 5], max_new_tokens=3))]
+    eng.run()
+    for rid in rids:
+        r = eng.result(rid)
+        assert r.trace_id
+        names = _spans_carrying(r.trace_id)
+        for want in ("serve/queue", "serve/admit", "serve/prefill",
+                     "serve/decode", "serve/deliver"):
+            assert want in names, (r.trace_id, names)
+    r0, r1 = eng.result(rids[0]), eng.result(rids[1])
+    assert r0.trace_id != r1.trace_id
+    # and the ids survive the chrome export
+    doc = tracing.export_chrome_trace()
+    hits = [e for e in doc["traceEvents"]
+            if e.get("args", {}).get("trace") == r0.trace_id
+            or (e.get("args", {}).get("traces")
+                and r0.trace_id in e["args"]["traces"])]
+    assert len(hits) >= 5
+    eng.close()
+
+
+def test_caller_supplied_trace_id_propagates():
+    from torchacc_tpu.serve.engine import Request
+    eng = _engine()
+    rid = eng.submit(Request(prompt_ids=[1, 2], max_new_tokens=2,
+                             trace_id="upstream-abc"))
+    eng.run()
+    assert eng.result(rid).trace_id == "upstream-abc"
+    assert _spans_carrying("upstream-abc")
+    eng.close()
+
+
+def test_trace_ids_unique_across_colocated_engines():
+    # regression: two engines in one process (bench's control-engine
+    # pattern) share the tracing ring — per-engine request ids restart
+    # at 0, so the trace id must come from a process-global sequence
+    from torchacc_tpu.serve.engine import Request
+    eng_a = _engine()
+    eng_b = _engine()
+    ra = eng_a.submit(Request(prompt_ids=[1, 2], max_new_tokens=2))
+    rb = eng_b.submit(Request(prompt_ids=[1, 2], max_new_tokens=2))
+    eng_a.run()
+    eng_b.run()
+    assert (eng_a.result(ra).trace_id
+            != eng_b.result(rb).trace_id)
+    eng_a.close()
+    eng_b.close()
+
+
+def test_trace_id_assigned_even_with_tracing_off():
+    from torchacc_tpu.serve.engine import Request
+    eng = _engine(obs_enabled=False)
+    rid = eng.submit(Request(prompt_ids=[1, 2], max_new_tokens=2))
+    eng.run()
+    assert eng.result(rid).trace_id        # the id is part of the API
+    assert tracing.snapshot() == []        # but nothing recorded
+    eng.close()
+
+
+# -- supervisor satellites ----------------------------------------------------
+
+
+def test_supervisor_decisions_carry_timestamps(tmp_path):
+    from torchacc_tpu.supervisor import (
+        Action,
+        RestartPolicy,
+        Supervisor,
+        WorkerSpec,
+    )
+    spec = WorkerSpec(run_dir=str(tmp_path), world_size=2,
+                      argv=["true"])
+    sup = Supervisor(spec, RestartPolicy())
+    sup._record(Action("restart_excluding", "sdc-exclude", hosts=(1,),
+                       reason="test"), None, 1, None)
+    d = sup.decisions[0]
+    assert isinstance(d["time"], float) and d["rule"] == "sdc-exclude"
+    json.dumps(d, allow_nan=False)           # strict JSON
+
+
+def test_supervisor_hosts_prom_text_names_excluded(tmp_path):
+    from torchacc_tpu.supervisor import (
+        RestartPolicy,
+        Supervisor,
+        WorkerSpec,
+    )
+    spec = WorkerSpec(run_dir=str(tmp_path), world_size=3,
+                      argv=["true"])
+    sup = Supervisor(spec, RestartPolicy())
+    sup.engine.excluded.add(2)
+    text = sup._hosts_prom_text()
+    assert 'torchacc_fleet_host_excluded{host="2"} 1' in text
+    assert 'torchacc_fleet_host_excluded{host="0"} 0' in text

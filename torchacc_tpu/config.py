@@ -539,6 +539,13 @@ class ObsConfig:
     # preemption.
     flight_recorder: bool = True
     flight_capacity: int = 256
+    # goodput/badput wall-clock ledger (obs/goodput.py): partitions
+    # each fit's wall time into productive step time vs badput buckets
+    # (data wait, checkpoint, drain...), published as goodput_*_ms
+    # counters + the goodput_fraction gauge and summarized in flight
+    # bundles and the supervisor's /fleet view.  Only consulted while
+    # enabled.
+    goodput: bool = True
     # where bundles land; None = the fit's checkpoint_dir or
     # metrics_dir (in that order)
     flight_dir: Optional[str] = None

@@ -1,37 +1,55 @@
 """Unified telemetry plane (docs/observability.md).
 
-One package, four seams, all host-side and all zero-cost when
+One package, six seams, all host-side and all zero-cost when
 ``ObsConfig.enabled`` is off (the fit trajectory is bitwise unchanged
 either way — nothing here touches device programs):
 
 - :mod:`~torchacc_tpu.obs.tracing` — nestable ``span()`` context
   managers recorded into a bounded ring, exported as Chrome-trace /
   Perfetto JSON on the same timeline viewers open ``jax.profiler``
-  traces with;
+  traces with; serve spans carry per-request trace ids end to end;
 - :mod:`~torchacc_tpu.obs.hist` — fixed log-bucket streaming
   histograms (mergeable, p50/p95/p99) for step time, host/save blocked
-  time, serve TTFT and inter-token gaps;
+  time, serve TTFT and inter-token gaps, with a wire round-trip
+  (``to_wire``/``from_wire``/``from_cumulative``) for cross-host
+  aggregation;
 - :mod:`~torchacc_tpu.obs.server` — opt-in stdlib HTTP endpoint:
-  ``/metrics`` in Prometheus text (counters + gauges + histograms) and
-  ``/healthz`` (ok/degraded/unhealthy from watchdog heartbeat age,
-  consecutive guard anomalies, SDC/quarantine state) — the probe the
-  ROADMAP #3(b) supervisor daemon consumes;
+  ``/metrics`` in Prometheus text (counters + gauges + histograms +
+  registered text blocks) and ``/healthz`` (ok/degraded/unhealthy from
+  watchdog heartbeat age, consecutive guard anomalies, SDC/quarantine
+  state) — the probe the supervisor daemon consumes — plus registered
+  JSON routes (the daemon's ``/fleet``);
 - :mod:`~torchacc_tpu.obs.flight` — a crash flight recorder: ring of
   recent step records + counter deltas + span completions, dumped as
-  ``flight_<step>.json`` by every typed-error abort and preemption.
+  ``flight_<step>.json`` by every typed-error abort and preemption;
+- :mod:`~torchacc_tpu.obs.goodput` — wall-clock goodput/badput ledger
+  partitioning run time into productive step time vs badput buckets
+  (data wait, checkpoint, restart downtime by policy rule), published
+  as counters and summarized in flight bundles and ``/fleet``;
+- :mod:`~torchacc_tpu.obs.aggregate` — the supervisor-side fleet
+  scraper: every worker's ``/metrics`` + ``/healthz`` folded into ONE
+  aggregated scrape (summed counters, per-host gauges, bucket-merged
+  histograms) + the ``/fleet`` JSON view + the step-time straggler/
+  drift detector.
 
 ``Config.obs`` (:class:`~torchacc_tpu.config.ObsConfig`) is the
 switch; ``Trainer.fit`` and ``ServeEngine`` wire themselves through
 :mod:`~torchacc_tpu.obs.runtime` when it is enabled.
 """
 
-from torchacc_tpu.obs import flight, hist, tracing
+from torchacc_tpu.obs import flight, goodput, hist, tracing
+from torchacc_tpu.obs.aggregate import DriftDetector, FleetAggregator
+from torchacc_tpu.obs.goodput import GoodputLedger
 from torchacc_tpu.obs.tracing import record_span, span
 
 __all__ = [
     "flight",
+    "goodput",
     "hist",
     "tracing",
     "span",
     "record_span",
+    "DriftDetector",
+    "FleetAggregator",
+    "GoodputLedger",
 ]

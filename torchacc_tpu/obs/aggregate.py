@@ -1,0 +1,579 @@
+"""Fleet aggregation: every worker's telemetry in ONE scrape.
+
+PR 12 gave each process its own ``/metrics`` + ``/healthz``; PR 13 put
+a supervisor daemon in front of the workers.  What was still missing is
+the pod-level view: a Prometheus server had to scrape N ephemeral
+worker ports (which move every incarnation), nobody summed the
+counters or merged the histograms, and "which host is slow" had no
+machine answer.  This module is the supervisor-side close:
+
+- :class:`FleetAggregator` polls every worker's ``/metrics`` +
+  ``/healthz`` on a background thread, **sums counters**, keeps
+  **gauges per-host** (labeled series), **bucket-merges histograms**
+  (the ``obs/hist.py`` merge semantics over the Prometheus-text wire —
+  :meth:`Histogram.from_cumulative` parses, :meth:`Histogram.merge`
+  folds), and accumulates across incarnations: when the daemon
+  relaunches workers, the dying incarnation's last-seen totals fold
+  into a per-host base so restarts never reset the fleet series (and
+  an excluded host's contribution stays visible).
+- The aggregate is served from the DAEMON's telemetry port through the
+  ``obs/server.py`` provider seams: :meth:`prometheus_text` registers
+  as a text block on ``/metrics`` (series under the ``fleet_`` prefix:
+  ``torchacc_fleet_<name>_total`` summed counters,
+  ``torchacc_fleet_<name>{host="H"}`` per-host gauges,
+  ``torchacc_fleet_<name>`` merged histograms, plus
+  ``torchacc_fleet_host_up/_alive/_excluded/...`` meta) and
+  :meth:`fleet_json` as the ``/fleet`` JSON route (per-host health,
+  step, heartbeat age, incarnation, the supervisor's decision history
+  and goodput ledger — whatever the daemon's ``context`` callable
+  contributes).
+- :class:`DriftDetector` is the straggler sensor: a rolling per-host
+  baseline over the ``step_time_ms`` histogram deltas each scrape
+  window; a host whose window mean exceeds ``factor`` x the median of
+  its peers' baselines for ``patience`` consecutive windows flips the
+  daemon's ``/healthz`` to **degraded naming the slow host** — the
+  sensing half of a future straggler-eviction policy (the supervisor
+  does NOT act on it yet; docs/observability.md "Fleet view").
+
+Stdlib-only (urllib + threading), no jax anywhere: like the rest of
+the supervisor stack this must run on a host that never initialised a
+device backend.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchacc_tpu.obs.hist import Histogram
+from torchacc_tpu.utils.logger import logger
+
+_PROM_PREFIX = "torchacc_"
+
+#: the histogram the drift detector baselines on
+_STEP_HIST = "step_time_ms"
+
+
+def _logical(name: str, *, counter: bool = False) -> str:
+    """Strip the exporter's ``torchacc_`` prefix (and ``_total`` suffix
+    for counters) so parsed series use the same logical names the
+    in-process registries use."""
+    if name.startswith(_PROM_PREFIX):
+        name = name[len(_PROM_PREFIX):]
+    if counter and name.endswith("_total"):
+        name = name[:-len("_total")]
+    return name
+
+
+def parse_prometheus(text: str) -> Tuple[Dict[str, float],
+                                         Dict[str, float],
+                                         Dict[str, Histogram]]:
+    """Parse one worker's ``/metrics`` exposition (the exact format
+    ``obs/server.prometheus_text`` emits) into ``(counters, gauges,
+    histograms)`` keyed by logical name.  Labeled series other than
+    histogram ``le`` buckets are skipped (workers emit none); unknown
+    lines are ignored, never fatal — a half-written scrape must not
+    take the aggregator down."""
+    kinds: Dict[str, str] = {}
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hraw: Dict[str, Dict[str, Any]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) == 4 and parts[1] == "TYPE":
+                kinds[parts[2]] = parts[3]
+            continue
+        if " " not in line:
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        name, labels = key, {}
+        if "{" in key:
+            name, rest = key.split("{", 1)
+            for part in rest.rstrip("}").split(","):
+                if "=" in part:
+                    lk, lv = part.split("=", 1)
+                    labels[lk.strip()] = lv.strip().strip('"')
+        base = None
+        for suf, fld in (("_bucket", "bucket"), ("_sum", "sum"),
+                         ("_count", "count")):
+            if name.endswith(suf) \
+                    and kinds.get(name[:-len(suf)]) == "histogram":
+                base, fieldname = name[:-len(suf)], fld
+                break
+        if base is not None:
+            d = hraw.setdefault(base, {"buckets": [], "sum": 0.0,
+                                       "count": 0})
+            if fieldname == "bucket":
+                le = labels.get("le")
+                if le is None:
+                    continue
+                bound = float("inf") if le == "+Inf" else float(le)
+                d["buckets"].append((bound, v))
+            elif fieldname == "sum":
+                d["sum"] = v
+            else:
+                d["count"] = int(v)
+            continue
+        if labels:
+            continue                     # labeled scalar: not ours
+        kind = kinds.get(name)
+        if kind == "counter" or (kind is None and name.endswith("_total")):
+            counters[_logical(name, counter=True)] = v
+        elif kind == "gauge":
+            gauges[_logical(name)] = v
+    hists: Dict[str, Histogram] = {}
+    for base, d in hraw.items():
+        finite = sorted((b, c) for b, c in d["buckets"]
+                        if b != float("inf"))
+        if not finite:
+            continue
+        try:
+            hists[_logical(base)] = Histogram.from_cumulative(
+                [b for b, _ in finite], [int(c) for _, c in finite],
+                d["count"], d["sum"])
+        except ValueError as e:
+            logger.debug(f"unparseable histogram {base}: {e}")
+    return counters, gauges, hists
+
+
+# -- straggler / drift detection ----------------------------------------------
+
+
+class DriftDetector:
+    """Rolling per-host step-time baseline; names sustained stragglers.
+
+    Fed once per scrape round with each host's window-mean step time
+    (:meth:`observe_round`); a host drifts when its window mean exceeds
+    ``factor`` x the median of its PEERS' baselines (own EWMA baseline
+    as the single-host fallback) by at least ``min_delta_ms``.  The
+    ``min_rounds`` warm-up gates BOTH sides: the observed host needs
+    that many windows behind it (a restore/compile tail landing in
+    early step windows is startup, not drift) and only peers past it
+    contribute baselines to the reference.  ``patience`` consecutive
+    drifting windows flag the host; any clean window clears it.  A
+    flagged host's baseline stops updating (the baseline must not chase
+    the drift it measures); it resumes once the host recovers.
+
+    Pure host arithmetic with injectable inputs — fully unit-testable
+    without sockets or clocks (tests/test_fleet.py)."""
+
+    def __init__(self, *, factor: float = 1.5, patience: int = 3,
+                 min_rounds: int = 4, alpha: float = 0.3,
+                 min_delta_ms: float = 1.0):
+        if factor <= 1.0:
+            raise ValueError("drift factor must be > 1.0")
+        if patience < 1 or min_rounds < 1:
+            raise ValueError("patience and min_rounds must be >= 1")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.min_rounds = int(min_rounds)
+        self.alpha = float(alpha)
+        self.min_delta_ms = float(min_delta_ms)
+        self._lock = threading.Lock()
+        self._baseline: Dict[int, float] = {}
+        self._rounds: Dict[int, int] = {}
+        self._streak: Dict[int, int] = {}
+        self._flagged: Dict[int, str] = {}
+
+    def observe_round(self, means_ms: Dict[int, float]) -> None:
+        """One scrape round: ``{host: window mean step time (ms)}``
+        (hosts with no completed steps this window are simply absent —
+        absence is not drift; the probe layer owns liveness)."""
+        with self._lock:
+            for host, m in means_ms.items():
+                m = float(m)
+                base = self._baseline.get(host)
+                # warm-up gate on BOTH sides: the observed host needs
+                # min_rounds windows behind it (a restore/compile tail
+                # landing in early step() windows is not drift), and a
+                # peer baseline formed from fewer windows is too noisy
+                # to serve as the reference
+                warm = self._rounds.get(host, 0) >= self.min_rounds
+                peers = [b for h, b in self._baseline.items()
+                         if h != host
+                         and self._rounds.get(h, 0) >= self.min_rounds]
+                if warm and peers:
+                    ref = statistics.median(peers)
+                elif warm and base is not None:
+                    ref = base
+                else:
+                    ref = None
+                drifting = (ref is not None
+                            and m > self.factor * ref
+                            and (m - ref) > self.min_delta_ms)
+                if drifting:
+                    self._streak[host] = self._streak.get(host, 0) + 1
+                    if self._streak[host] >= self.patience:
+                        self._flagged[host] = (
+                            f"host {host} step time {m:.1f}ms is "
+                            f"{m / max(ref, 1e-9):.1f}x the fleet "
+                            f"baseline {ref:.1f}ms for "
+                            f"{self._streak[host]} consecutive windows")
+                else:
+                    self._streak[host] = 0
+                    self._flagged.pop(host, None)
+                    # baseline learns only from clean windows
+                    self._baseline[host] = (
+                        m if base is None
+                        else self.alpha * m + (1.0 - self.alpha) * base)
+                self._rounds[host] = self._rounds.get(host, 0) + 1
+
+    def forget(self, host: int) -> None:
+        """Drop a host's state (it left the fleet — excluded or
+        replaced; a successor reusing the index starts fresh)."""
+        with self._lock:
+            for d in (self._baseline, self._rounds, self._streak,
+                      self._flagged):
+                d.pop(host, None)
+
+    def flagged(self) -> Dict[int, str]:
+        with self._lock:
+            return dict(self._flagged)
+
+    def health(self) -> Tuple[str, Optional[str]]:
+        """``obs/server.register_health`` provider: degraded naming the
+        slow host(s) on sustained drift, never unhealthy — a straggler
+        still makes progress; killing it is a policy decision this
+        detector only *informs*."""
+        f = self.flagged()
+        if not f:
+            return "ok", None
+        return "degraded", "; ".join(f[h] for h in sorted(f))
+
+    def baselines(self) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._baseline)
+
+
+# -- the aggregator -----------------------------------------------------------
+
+
+@dataclass
+class _HostState:
+    """Latest scrape + per-incarnation accumulation for one host."""
+
+    url: str
+    up: bool = False
+    ever_up: bool = False
+    error: Optional[str] = None
+    health: Dict[str, Any] = field(default_factory=dict)
+    counters: Dict[str, float] = field(default_factory=dict)
+    gauges: Dict[str, float] = field(default_factory=dict)
+    hists: Dict[str, Histogram] = field(default_factory=dict)
+    last_ok_t: Optional[float] = None
+
+
+class FleetAggregator:
+    """Poll the workers, fold the fleet view (module docstring).
+
+    ``context``: optional callable returning extra strict-JSON keys for
+    ``/fleet`` (the daemon passes its supervisor/decisions/goodput
+    block).  ``fetch``: injectable ``(url, timeout_s) -> str`` for
+    tests (default urllib)."""
+
+    def __init__(self, *, poll_interval_s: float = 2.0,
+                 timeout_s: float = 2.0,
+                 drift: Optional[DriftDetector] = None,
+                 context: Optional[Callable[[], Dict[str, Any]]] = None,
+                 fetch: Optional[Callable[[str, float], str]] = None):
+        self.poll_interval_s = float(poll_interval_s)
+        self.timeout_s = float(timeout_s)
+        self.drift = drift
+        self._context = context
+        self._fetch = fetch if fetch is not None else self._http_fetch
+        self._lock = threading.Lock()
+        self._cur: Dict[int, _HostState] = {}
+        self._base_counters: Dict[int, Dict[str, float]] = {}
+        self._base_hists: Dict[int, Dict[str, Histogram]] = {}
+        self._prev_step_stats: Dict[int, Tuple[int, float]] = {}
+        self.incarnation = 0
+        self._scrapes = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- worker membership ----------------------------------------------------
+
+    def set_workers(self, workers: Dict[int, str],
+                    incarnation: int = 0) -> None:
+        """Point the scraper at a fresh incarnation's endpoints.  The
+        previous incarnation's last-seen totals fold into the per-host
+        base first, so counters/histograms stay monotonic across
+        restarts and a host that left the fleet (excluded) keeps its
+        accumulated contribution in the merged view."""
+        with self._lock:
+            for host, st in self._cur.items():
+                self._fold_locked(host, st)
+            self._cur = {int(h): _HostState(url=u.rstrip("/"))
+                         for h, u in workers.items()}
+            self.incarnation = int(incarnation)
+
+    def _fold_locked(self, host: int, st: _HostState) -> None:
+        bc = self._base_counters.setdefault(host, {})
+        for k, v in st.counters.items():
+            bc[k] = bc.get(k, 0.0) + v
+        bh = self._base_hists.setdefault(host, {})
+        for k, h in st.hists.items():
+            if k in bh and bh[k].bounds == h.bounds:
+                bh[k].merge(h)
+            else:
+                bh[k] = h
+        st.counters = {}
+        st.hists = {}
+
+    # -- scraping -------------------------------------------------------------
+
+    @staticmethod
+    def _http_fetch(url: str, timeout_s: float) -> str:
+        with urllib.request.urlopen(url, timeout=timeout_s) as r:
+            return r.read().decode()
+
+    def scrape_once(self) -> None:
+        """Poll every worker once (the poller thread body; tests call
+        it directly).  A failed fetch marks the host down but keeps its
+        last-good series — a dying worker's final contribution is not
+        discarded just because it stopped answering."""
+        with self._lock:
+            targets = list(self._cur.items())
+        now = time.monotonic()
+        for host, st in targets:
+            try:
+                body = self._fetch(st.url + "/healthz", self.timeout_s)
+                h = json.loads(body)
+                text = self._fetch(st.url + "/metrics", self.timeout_s)
+                c, g, hi = parse_prometheus(text)
+            except (urllib.error.URLError, OSError, TimeoutError,
+                    ValueError) as e:
+                with self._lock:
+                    st.up = False
+                    st.error = repr(e)
+                continue
+            with self._lock:
+                st.up = True
+                st.ever_up = True
+                st.error = None
+                st.health = h if isinstance(h, dict) else {}
+                st.counters, st.gauges, st.hists = c, g, hi
+                st.last_ok_t = now
+        self._scrapes += 1
+        if self.drift is not None:
+            self.drift.observe_round(self._step_window_means())
+
+    def _step_window_means(self) -> Dict[int, float]:
+        """Per-host mean step time over the observations that landed
+        since the previous scrape round (histogram count/sum deltas on
+        the accumulated totals, so incarnation rollovers never produce
+        a negative window)."""
+        means: Dict[int, float] = {}
+        with self._lock:
+            for host in set(self._cur) | set(self._base_hists):
+                count, total = self._host_hist_stats_locked(host,
+                                                           _STEP_HIST)
+                pc, ps = self._prev_step_stats.get(host, (0, 0.0))
+                dc, ds = count - pc, total - ps
+                if dc > 0:
+                    means[host] = ds / dc
+                    self._prev_step_stats[host] = (count, total)
+                elif dc < 0:
+                    # accumulated totals are monotonic by construction;
+                    # a shrink means the fleet was reset — resync
+                    self._prev_step_stats[host] = (count, total)
+        return means
+
+    def _host_hist_stats_locked(self, host: int,
+                                name: str) -> Tuple[int, float]:
+        count, total = 0, 0.0
+        bh = self._base_hists.get(host, {}).get(name)
+        if bh is not None:
+            count += bh.count
+            total += bh.sum
+        st = self._cur.get(host)
+        if st is not None and name in st.hists:
+            count += st.hists[name].count
+            total += st.hists[name].sum
+        return count, total
+
+    # -- background poller ----------------------------------------------------
+
+    def start(self) -> "FleetAggregator":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._poll_loop, daemon=True, name="fleet-scraper")
+        self._thread.start()
+        return self
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 - the poller must survive
+                logger.exception("fleet scrape failed; continuing")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    # -- aggregate views ------------------------------------------------------
+
+    def _aggregate_locked(self) -> Tuple[Dict[str, float],
+                                         Dict[str, Histogram]]:
+        """Summed counters + merged histograms over base + current,
+        across every host ever seen."""
+        counters: Dict[str, float] = {}
+        hists: Dict[str, Histogram] = {}
+
+        def add_counters(src: Dict[str, float]) -> None:
+            for k, v in src.items():
+                counters[k] = counters.get(k, 0.0) + v
+
+        def add_hists(src: Dict[str, Histogram]) -> None:
+            for k, h in src.items():
+                if k in hists:
+                    if hists[k].bounds == h.bounds:
+                        hists[k].merge(h)
+                    # mismatched ladders cannot merge without inventing
+                    # observations — keep the first, drop the stray
+                else:
+                    hists[k] = Histogram.from_wire(h.to_wire())
+
+        for host in sorted(set(self._cur) | set(self._base_counters)
+                           | set(self._base_hists)):
+            add_counters(self._base_counters.get(host, {}))
+            add_hists(self._base_hists.get(host, {}))
+            st = self._cur.get(host)
+            if st is not None:
+                add_counters(st.counters)
+                add_hists(st.hists)
+        return counters, hists
+
+    def aggregated_counters(self) -> Dict[str, float]:
+        with self._lock:
+            return self._aggregate_locked()[0]
+
+    def prometheus_text(self) -> str:
+        """The aggregated block for the daemon's ``/metrics`` (register
+        via ``obs.server.register_text``).  Everything lands under the
+        ``fleet_`` prefix so fleet series never collide with the
+        daemon's own counters/gauges on the same endpoint."""
+        with self._lock:
+            counters, hists = self._aggregate_locked()
+            hosts = dict(self._cur)
+        lines: List[str] = []
+        # per-host meta the supervisor owns regardless of worker state
+        lines.append("# TYPE torchacc_fleet_host_up gauge")
+        for h in sorted(hosts):
+            lines.append(
+                f'torchacc_fleet_host_up{{host="{h}"}} '
+                f'{1 if hosts[h].up else 0}')
+        # per-host gauges from the latest scrape (labeled series)
+        gauge_names = sorted({n for st in hosts.values()
+                              for n in st.gauges})
+        for name in gauge_names:
+            m = f"torchacc_fleet_{name}"
+            lines.append(f"# TYPE {m} gauge")
+            for h in sorted(hosts):
+                if name in hosts[h].gauges:
+                    lines.append(
+                        f'{m}{{host="{h}"}} {hosts[h].gauges[name]:g}')
+        # summed counters, at full precision — the goodput sum
+        # invariant is re-checked downstream from these exact values
+        for name in sorted(counters):
+            m = f"torchacc_fleet_{name}_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {counters[name]!r}")
+        # merged histograms
+        for name in sorted(hists):
+            lines.extend(hists[name].prometheus_lines(
+                f"torchacc_fleet_{name}"))
+        return "\n".join(lines) + "\n"
+
+    def fleet_json(self) -> Dict[str, Any]:
+        """The ``/fleet`` payload (register via
+        ``obs.server.register_json``): per-host liveness/health/step/
+        heartbeat, the drift verdict, the cross-host goodput rollup,
+        and whatever the daemon's ``context`` contributes (supervisor
+        state, strict-JSON decision history)."""
+        from torchacc_tpu.obs.goodput import summary_from_counters
+        with self._lock:
+            counters, hists = self._aggregate_locked()
+            hosts = dict(self._cur)
+            known = sorted(set(self._cur) | set(self._base_counters)
+                           | set(self._base_hists))
+            now = time.monotonic()
+            out_hosts: Dict[str, Any] = {}
+            for h in known:
+                st = hosts.get(h)
+                count, total = self._host_hist_stats_locked(h, _STEP_HIST)
+                entry: Dict[str, Any] = {
+                    "step_time_count": count,
+                    "step_time_mean_ms": (total / count) if count else None,
+                }
+                if st is None:
+                    entry["present"] = False
+                else:
+                    entry.update({
+                        "present": True,
+                        "url": st.url,
+                        "up": st.up,
+                        "ever_up": st.ever_up,
+                        "error": st.error,
+                        "status": st.health.get("status"),
+                        "checks": st.health.get("checks", {}),
+                        "pid": st.health.get("pid"),
+                        "step": st.gauges.get("train_host_step"),
+                        "heartbeat_age_s": st.gauges.get(
+                            "watchdog_heartbeat_age_s"),
+                        "last_scrape_age_s": (
+                            round(now - st.last_ok_t, 3)
+                            if st.last_ok_t is not None else None),
+                    })
+                out_hosts[str(h)] = entry
+        doc: Dict[str, Any] = {
+            "time": time.time(),
+            "incarnation": self.incarnation,
+            "scrapes": self._scrapes,
+            "hosts": out_hosts,
+            "counters": counters,
+            "histograms": {n: h.snapshot() for n, h in hists.items()},
+            "goodput_workers": summary_from_counters(counters),
+        }
+        if self.drift is not None:
+            status, reason = self.drift.health()
+            doc["drift"] = {
+                "status": status,
+                "reason": reason,
+                "flagged": {str(h): r
+                            for h, r in self.drift.flagged().items()},
+                "baselines_ms": {str(h): round(b, 3) for h, b in
+                                 self.drift.baselines().items()},
+            }
+        if self._context is not None:
+            try:
+                doc.update(self._context() or {})
+            except Exception as e:  # noqa: BLE001 - a broken context
+                # degrades the payload, never the endpoint
+                doc["context_error"] = repr(e)
+        return doc
+
+    def merged_histogram(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._aggregate_locked()[1].get(name)

@@ -1,0 +1,235 @@
+"""Goodput/badput accounting: where did the wall clock actually go?
+
+The metric production ML systems treat as the top-line SLO (MegaScale,
+NSDI'24; Google's ML-goodput work) is not steps/s — it is the fraction
+of a run's *wall clock* spent on productive training.  ``metrics.jsonl``
+already answers "how fast were the steps"; nothing answered "what
+fraction of the last hour was steps at all" — restart downtime,
+checkpoint stalls and data waits were invisible between records.
+
+:class:`GoodputLedger` is a lap-based wall-clock partitioner: a single
+monotonic mark walks forward through the loop and every ``lap(bucket)``
+attributes the elapsed interval to a named bucket, so **the buckets sum
+to wall clock by construction** (the invariant ``make fleet-smoke``
+gates on; residual between the last lap and "now" is reported as
+``unattributed_s`` and stays within clock noise while laps keep
+coming).  Two instantiations:
+
+- **worker fit** (``obs/runtime.FitObs``): buckets ``init_restore``
+  (manager construction + checkpoint restore), ``data_wait``,
+  ``step`` (dispatch + lagged resolution), ``log_eval``,
+  ``checkpoint`` (tiered submit/pump or blocking save), ``drain``
+  (the fit-exit verdict drain) — plus *overlapping* informational
+  sub-meters ``host_blocked`` / ``save_blocked`` (they live INSIDE the
+  laps, so they are reported separately, never summed with them).
+  ``productive_s = step - host_blocked`` is the goodput numerator.
+- **supervisor fleet** (``supervisor/daemon.py``): buckets ``active``
+  (an incarnation running) vs ``down:<rule>`` — restart/rejoin
+  downtime attributed to the policy rule that caused it
+  (``down:sdc-exclude``, ``down:hang-restart``, ``down:crash-backoff``,
+  ``down:preempt-resume``, ``down:startup`` for the first launch).
+
+Export: :meth:`publish` delta-feeds ``utils.metrics`` counters
+(``goodput_<bucket>_ms`` / ``goodput_sub_<name>_ms`` /
+``goodput_wall_ms`` / ``goodput_productive_ms``; the supervisor uses
+the ``supervisor_goodput_`` prefix) so the breakdown rides every
+``/metrics`` scrape, survives aggregation across hosts (the fleet
+scraper sums them — :func:`summary_from_counters` rebuilds the
+breakdown on the other side), and lands in metrics.jsonl step records
+like every other counter.  :meth:`summary` is the JSON view embedded
+in flight bundles and the ``/fleet`` endpoint.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    return _SANITIZE_RE.sub("_", name)
+
+
+class GoodputLedger:
+    """Lap-based wall-clock partitioner (module docstring).
+
+    Thread-safe: the fit loop laps from the trainer thread while the
+    telemetry server reads :meth:`summary`/:meth:`fraction` from its
+    scrape threads."""
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0: Optional[float] = None
+        self._mark: Optional[float] = None
+        self._buckets: Dict[str, float] = {}
+        self._sub: Dict[str, float] = {}
+        self._published: Dict[str, int] = {}
+
+    def start(self) -> None:
+        """Anchor the wall clock; idempotent (a second start is
+        ignored so a resumed session keeps one timeline)."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = self._mark = self._clock()
+
+    @property
+    def started(self) -> bool:
+        return self._t0 is not None
+
+    def lap(self, bucket: str) -> float:
+        """Attribute the time since the previous lap (or start) to
+        ``bucket``; returns the attributed seconds (0.0 before
+        :meth:`start`)."""
+        with self._lock:
+            if self._mark is None:
+                return 0.0
+            now = self._clock()
+            dt = max(now - self._mark, 0.0)
+            self._mark = now
+            self._buckets[bucket] = self._buckets.get(bucket, 0.0) + dt
+            return dt
+
+    def add(self, bucket: str, seconds: float) -> None:
+        """Credit an externally measured interval to ``bucket``
+        WITHOUT moving the mark (for durations measured elsewhere that
+        are known disjoint from the lapped ones)."""
+        with self._lock:
+            self._buckets[bucket] = (self._buckets.get(bucket, 0.0)
+                                     + max(float(seconds), 0.0))
+
+    def sub_add(self, name: str, seconds: float) -> None:
+        """Credit an *overlapping* informational sub-meter (e.g.
+        host-blocked time inside the ``step`` bucket) — reported
+        separately, never part of the buckets-sum-to-wall invariant."""
+        with self._lock:
+            self._sub[name] = (self._sub.get(name, 0.0)
+                               + max(float(seconds), 0.0))
+
+    # -- views ----------------------------------------------------------------
+
+    def wall_s(self) -> float:
+        with self._lock:
+            return 0.0 if self._t0 is None else self._clock() - self._t0
+
+    def _snapshot(self) -> Tuple[float, Dict[str, float], Dict[str, float]]:
+        with self._lock:
+            wall = 0.0 if self._t0 is None else self._clock() - self._t0
+            return wall, dict(self._buckets), dict(self._sub)
+
+    def productive_s(self) -> float:
+        """``step`` bucket minus the host-blocked sub-meter, clamped —
+        the goodput numerator (time the devices were fed, not waited
+        on).  Ledgers without a ``step`` bucket (the supervisor's
+        active/downtime ledger) report their ``active`` bucket."""
+        _, buckets, sub = self._snapshot()
+        if "step" in buckets:
+            return max(buckets["step"] - sub.get("host_blocked", 0.0), 0.0)
+        return buckets.get("active", 0.0)
+
+    def fraction(self) -> float:
+        wall, _, _ = self._snapshot()
+        return self.productive_s() / wall if wall > 0 else 0.0
+
+    def summary(self) -> Dict[str, object]:
+        """The strict-JSON breakdown (flight bundles, ``/fleet``):
+        buckets + overlapping sub-meters + the invariant fields
+        (``attributed_s`` vs ``wall_s``; ``unattributed_s`` is the
+        not-yet-lapped tail, small while laps keep coming)."""
+        wall, buckets, sub = self._snapshot()
+        attributed = sum(buckets.values())
+        productive = (max(buckets["step"] - sub.get("host_blocked", 0.0),
+                          0.0) if "step" in buckets
+                      else buckets.get("active", 0.0))
+        return {
+            "wall_s": round(wall, 6),
+            "buckets": {k: round(v, 6) for k, v in sorted(buckets.items())},
+            "sub": {k: round(v, 6) for k, v in sorted(sub.items())},
+            "attributed_s": round(attributed, 6),
+            "unattributed_s": round(max(wall - attributed, 0.0), 6),
+            "productive_s": round(productive, 6),
+            "goodput_fraction": round(productive / wall, 6) if wall > 0
+            else 0.0,
+        }
+
+    # -- counter export -------------------------------------------------------
+
+    def publish(self, counters=None, prefix: str = "goodput_") -> None:
+        """Delta-publish the ledger into monotonic counters (integer
+        milliseconds): ``<prefix><bucket>_ms``, ``<prefix>sub_<name>_ms``,
+        ``<prefix>wall_ms``, ``<prefix>productive_ms``.  Idempotent per
+        accumulated total — call as often as convenient (every step
+        record; the deltas ride /metrics between calls unchanged)."""
+        if counters is None:
+            from torchacc_tpu.utils.metrics import counters as _c
+            counters = _c
+        wall, buckets, sub = self._snapshot()
+        productive = (max(buckets["step"] - sub.get("host_blocked", 0.0),
+                          0.0) if "step" in buckets
+                      else buckets.get("active", 0.0))
+        series = [("wall", wall), ("productive", productive)]
+        series += list(buckets.items())
+        series += [(f"sub_{k}", v) for k, v in sub.items()]
+        with self._lock:
+            for key, total_s in series:
+                name = f"{prefix}{_sanitize(key)}_ms"
+                total = int(total_s * 1000.0)
+                delta = total - self._published.get(name, 0)
+                if delta > 0:
+                    counters.inc(name, delta)
+                    self._published[name] = total
+
+
+def summary_from_counters(counter_values: Dict[str, float],
+                          prefix: str = "goodput_") -> Dict[str, object]:
+    """Rebuild a goodput breakdown from published counter totals — the
+    consumer-side inverse of :meth:`GoodputLedger.publish`.  Works on a
+    single worker's counter snapshot OR the fleet aggregator's
+    cross-host sums (then ``wall_ms`` is summed host wall time and the
+    fraction is the host-weighted average goodput)."""
+    wall = 0.0
+    productive = 0.0
+    buckets: Dict[str, float] = {}
+    sub: Dict[str, float] = {}
+    for name, v in counter_values.items():
+        if not name.startswith(prefix) or not name.endswith("_ms"):
+            continue
+        key = name[len(prefix):-3]
+        if key == "wall":
+            wall = float(v)
+        elif key == "productive":
+            productive = float(v)
+        elif key.startswith("sub_"):
+            sub[key[4:]] = float(v)
+        else:
+            buckets[key] = float(v)
+    attributed = sum(buckets.values())
+    return {
+        "wall_ms": wall,
+        "buckets": buckets,
+        "sub": sub,
+        "productive_ms": productive,
+        "attributed_ms": attributed,
+        "unattributed_ms": max(wall - attributed, 0.0),
+        "goodput_fraction": (productive / wall) if wall > 0 else 0.0,
+    }
+
+
+def check_sum(summary: Dict[str, object],
+              tolerance: float = 0.05) -> Tuple[bool, float]:
+    """The fleet-smoke invariant: do the buckets sum to wall clock
+    within ``tolerance``?  Accepts both the ledger's :meth:`summary`
+    (``_s`` fields) and :func:`summary_from_counters` (``_ms``)
+    shapes.  Returns ``(ok, relative_gap)``; an empty ledger (zero
+    wall) passes trivially."""
+    wall = float(summary.get("wall_s", summary.get("wall_ms", 0.0)))
+    attributed = float(summary.get("attributed_s",
+                                   summary.get("attributed_ms", 0.0)))
+    if wall <= 0:
+        return True, 0.0
+    gap = abs(wall - attributed) / wall
+    return gap <= tolerance, gap

@@ -156,7 +156,12 @@ class Histogram:
             cum += c
             lines.append(f'{name}_bucket{{le="{bound:g}"}} {cum}')
         lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{name}_sum {s:g}")
+        # _sum at full precision (repr round-trips the float exactly):
+        # the fleet drift detector differences successive parsed sums
+        # per scrape window, so %g's 6 significant digits would turn a
+        # long run's window means into quantization noise (the bucket
+        # EDGES tolerate %g — from_cumulative snaps them back)
+        lines.append(f"{name}_sum {s!r}")
         lines.append(f"{name}_count {total}")
         return lines
 
@@ -167,6 +172,106 @@ class Histogram:
             self.sum = 0.0
             self.min = float("inf")
             self.max = float("-inf")
+
+    # -- wire round-trip (the fleet-aggregation transport) --------------------
+
+    def to_wire(self) -> Dict[str, object]:
+        """Strict-JSON wire form: everything :meth:`from_wire` needs to
+        reconstruct an equivalent histogram (bounds, per-bucket counts,
+        count/sum, min/max — min/max as None when empty so the payload
+        stays strict JSON)."""
+        with self._lock:
+            return {
+                "bounds": list(self.bounds),
+                "counts": list(self.counts),
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+            }
+
+    @classmethod
+    def from_wire(cls, d: Dict[str, object]) -> "Histogram":
+        h = cls(bounds=[float(b) for b in d["bounds"]])
+        counts = [int(c) for c in d["counts"]]
+        if len(counts) != len(h.counts):
+            raise ValueError(
+                f"wire counts length {len(counts)} does not match "
+                f"{len(h.bounds)} bounds + overflow")
+        if any(c < 0 for c in counts):
+            raise ValueError("wire bucket counts must be >= 0")
+        total = int(d["count"])
+        if total != sum(counts):
+            raise ValueError(
+                f"wire count {total} != sum of bucket counts "
+                f"{sum(counts)} (the aggregation path must neither "
+                "invent nor drop observations)")
+        h.counts = counts
+        h.count = total
+        h.sum = float(d["sum"])
+        if d.get("min") is not None:
+            h.min = float(d["min"])
+        if d.get("max") is not None:
+            h.max = float(d["max"])
+        return h
+
+    @classmethod
+    def from_cumulative(cls, bounds: Sequence[float],
+                        cumulative: Sequence[int], total: int,
+                        sum_: float, *,
+                        snap_bounds: bool = True) -> "Histogram":
+        """Reconstruct from the Prometheus cumulative-``le`` text form —
+        the wire the fleet aggregator parses off a worker ``/metrics``
+        scrape.  ``bounds``/``cumulative`` are the finite ``le`` edges
+        and their cumulative counts; ``total`` is the ``+Inf`` bucket
+        (== ``_count``); ``sum_`` is ``_sum``.
+
+        ``snap_bounds``: text edges went through ``%g`` formatting, so a
+        parsed edge may differ from the in-process float in the last
+        digits; when the parsed ladder matches :func:`default_bounds`
+        within print tolerance it is snapped onto the canonical floats
+        so a parsed histogram merges with an in-process one.
+
+        ``min``/``max`` are not on this wire: they are estimated from
+        the landed buckets (affects only the percentile interpolation
+        endpoints, never counts/sum — the merge-relevant state)."""
+        bounds = [float(b) for b in bounds]
+        if snap_bounds:
+            # %g keeps 6 significant digits -> up to ~5e-6 relative
+            # rounding on an edge; 1e-5 covers it with margin while
+            # still rejecting a genuinely different ladder (adjacent
+            # default edges differ by 50%)
+            dflt = default_bounds()
+            if len(bounds) == len(dflt) and all(
+                    abs(a - b) <= 1e-5 * max(abs(b), 1e-12)
+                    for a, b in zip(bounds, dflt)):
+                bounds = dflt
+        h = cls(bounds=bounds)
+        per: List[int] = []
+        prev = 0
+        for c in cumulative:
+            c = int(c)
+            if c < prev:
+                raise ValueError(
+                    "cumulative bucket counts must be non-decreasing")
+            per.append(c - prev)
+            prev = c
+        total = int(total)
+        if total < prev:
+            raise ValueError(
+                f"histogram _count {total} below the last cumulative "
+                f"bucket {prev}")
+        per.append(total - prev)         # the +Inf overflow bucket
+        h.counts = per
+        h.count = total
+        h.sum = float(sum_)
+        if total:
+            lo_i = next(i for i, c in enumerate(per) if c)
+            hi_i = max(i for i, c in enumerate(per) if c)
+            h.min = 0.0 if lo_i == 0 else h.bounds[lo_i - 1]
+            h.max = (h.bounds[hi_i] if hi_i < len(h.bounds)
+                     else h.bounds[-1])
+        return h
 
 
 # -- process-wide registry ----------------------------------------------------

@@ -90,6 +90,16 @@ class FitObs:
             flight.recorder.set_context(
                 "config", trainer.config.to_dict())
             flight.recorder.set_context("run_dir", run_dir)
+        # goodput/badput wall-clock ledger (obs/goodput.py): the fit
+        # loop laps into it (trainer._fit_inner), counters publish on
+        # every record, and the summary rides flight bundles + /fleet.
+        # Host-side only; obs.goodput=False leaves it None and every
+        # hook a no-op.
+        self.goodput = None
+        if getattr(obs_cfg, "goodput", True):
+            from torchacc_tpu.obs.goodput import GoodputLedger
+            self.goodput = GoodputLedger()
+            self.goodput.start()
         t = trainer
         # quarantine baseline at session open: the exit disposition
         # reports the DELTA (hosts quarantined during THIS run) — the
@@ -119,6 +129,10 @@ class FitObs:
         gauge("watchdog_heartbeat_age_s", self._heartbeat_age,
               help="seconds since the fit loop last proved liveness "
                    "(0 when no watchdog is armed)")
+        if self.goodput is not None:
+            gauge("goodput_fraction", self.goodput.fraction,
+                  help="productive step time / wall clock this fit "
+                       "(obs/goodput.py bucket definitions)")
         check("watchdog_heartbeat", self._h_heartbeat)
         check("guard_anomalies", self._h_guard)
         check("sdc", self._h_sdc)
@@ -180,17 +194,42 @@ class FitObs:
     def on_step_time(self, ms: float) -> None:
         hist.observe("step_time_ms", ms)
 
+    def lap(self, bucket: str) -> None:
+        """Goodput ledger lap — the trainer's fit loop calls this at
+        its phase transitions (no-op when the ledger is off)."""
+        if self.goodput is not None:
+            self.goodput.lap(bucket)
+
     def on_record(self, rec: dict) -> None:
         if "host_blocked_ms" in rec:
             hist.observe("host_blocked_ms", rec["host_blocked_ms"])
         if "save_blocked_ms" in rec:
             hist.observe("save_blocked_ms", rec["save_blocked_ms"])
+        if self.goodput is not None:
+            # the blocked meters overlap the lapped buckets (they run
+            # INSIDE step/checkpoint laps) — sub-meters, not buckets
+            if "host_blocked_ms" in rec:
+                self.goodput.sub_add("host_blocked",
+                                     rec["host_blocked_ms"] / 1e3)
+            if "save_blocked_ms" in rec:
+                self.goodput.sub_add("save_blocked",
+                                     rec["save_blocked_ms"] / 1e3)
+            # publish per record so any /metrics scrape (incl. the
+            # fleet aggregator's last one before this process exits)
+            # carries a self-consistent breakdown
+            self.goodput.publish()
         if self.cfg.flight_recorder:
             flight.recorder.record_step(rec.get("step", -1), rec)
 
     def _quarantine_context(self) -> dict:
         from torchacc_tpu.resilience.sdc import read_quarantined_hosts
-        return {"quarantine": read_quarantined_hosts(self.run_dir)}
+        ctx = {"quarantine": read_quarantined_hosts(self.run_dir)}
+        if self.goodput is not None:
+            # the postmortem answers "what fraction of this run was
+            # productive, and which badput bucket grew" without a
+            # second artefact
+            ctx["goodput"] = self.goodput.summary()
+        return ctx
 
     def _disposition(self, reason: str,
                      err: Optional[BaseException] = None,
@@ -241,6 +280,10 @@ class FitObs:
             disposition=self._disposition("preemption", step=step))
 
     def close(self) -> None:
+        if self.goodput is not None:
+            # final publish: the tail since the last record (drain,
+            # teardown) still lands on /metrics before deregistration
+            self.goodput.publish()
         for name, fn in self._gauges.items():
             server.unregister_gauge(name, fn)
         for name, fn in self._checks.items():

@@ -48,6 +48,14 @@ from torchacc_tpu.utils.logger import logger
 _reg_lock = threading.Lock()
 _gauges: Dict[str, Tuple[Callable[[], float], str]] = {}
 _health: Dict[str, Callable[[], Tuple[str, Optional[str]]]] = {}
+# extra Prometheus-text producers appended verbatim to /metrics (the
+# fleet aggregator's labeled per-host / merged-histogram series, which
+# the scalar gauge registry cannot express)
+_texts: Dict[str, Callable[[], str]] = {}
+# extra GET routes serving strict JSON (the supervisor's /fleet view);
+# reserved paths stay owned by the handler
+_RESERVED_PATHS = ("/metrics", "/healthz", "/health")
+_json_routes: Dict[str, Callable[[], Dict]] = {}
 
 _STATUS_RANK = {"ok": 0, "degraded": 1, "unhealthy": 2}
 
@@ -86,11 +94,49 @@ def unregister_health(name: str, fn: Optional[Callable] = None) -> None:
             _health.pop(name, None)
 
 
+def register_text(name: str, fn: Callable[[], str]) -> None:
+    """Publish an extra Prometheus-text block: ``fn()`` is called at
+    scrape time and its output appended to ``/metrics`` verbatim.  The
+    producer owns its metric names (labeled series, merged histograms)
+    and must not collide with the local registries.  Last owner wins."""
+    with _reg_lock:
+        _texts[name] = fn
+
+
+def unregister_text(name: str, fn: Optional[Callable] = None) -> None:
+    """Remove a text block (same ownership rule as
+    :func:`unregister_gauge`)."""
+    with _reg_lock:
+        if fn is None or _texts.get(name) is fn:
+            _texts.pop(name, None)
+
+
+def register_json(path: str, fn: Callable[[], Dict]) -> None:
+    """Serve ``fn()`` as strict JSON under GET ``path`` (e.g. the
+    supervisor's ``/fleet``).  The payload goes through
+    ``flight.json_safe`` before serialisation, so providers may hand
+    back numpy scalars / non-finite floats.  Last owner wins."""
+    if not path.startswith("/") or path in _RESERVED_PATHS:
+        raise ValueError(
+            f"json route must start with '/' and not shadow "
+            f"{_RESERVED_PATHS}; got {path!r}")
+    with _reg_lock:
+        _json_routes[path] = fn
+
+
+def unregister_json(path: str, fn: Optional[Callable] = None) -> None:
+    with _reg_lock:
+        if fn is None or _json_routes.get(path) is fn:
+            _json_routes.pop(path, None)
+
+
 def clear_registries() -> None:
-    """Drop every gauge + health provider (tests)."""
+    """Drop every gauge + health + text + json provider (tests)."""
     with _reg_lock:
         _gauges.clear()
         _health.clear()
+        _texts.clear()
+        _json_routes.clear()
 
 
 def health() -> Dict[str, object]:
@@ -154,6 +200,18 @@ def prometheus_text() -> str:
         lines.append(f"{m} {value:g}")
     for name, h in sorted(_hist.all_histograms().items()):
         lines.extend(h.prometheus_lines(_prom_name(name)))
+    with _reg_lock:
+        texts = dict(_texts)
+    for name, fn in sorted(texts.items()):
+        try:
+            block = fn()
+        except Exception as e:  # noqa: BLE001 - one broken producer
+            # must not take the whole scrape down (same policy as a
+            # dead gauge)
+            logger.debug(f"text provider {name} failed: {e!r}")
+            continue
+        if block:
+            lines.append(block.rstrip("\n"))
     return "\n".join(lines) + "\n"
 
 
@@ -183,6 +241,21 @@ class _Handler(BaseHTTPRequestHandler):
                 code = 503 if h["status"] == "unhealthy" else 200
                 self._send(code, json.dumps(h).encode(),
                            "application/json")
+            elif path in _json_routes:
+                with _reg_lock:
+                    fn = _json_routes.get(path)
+                if fn is None:      # unregistered between the two reads
+                    self._send(404, b"route gone\n", "text/plain")
+                    return
+                try:
+                    from torchacc_tpu.obs.flight import json_safe
+                    body = json.dumps(json_safe(fn()),
+                                      allow_nan=False).encode()
+                    self._send(200, body, "application/json")
+                except Exception as e:  # noqa: BLE001 - a broken
+                    # provider answers with an error, never a hang
+                    self._send(500, json.dumps(
+                        {"error": repr(e)}).encode(), "application/json")
             else:
                 self._send(404, b"not found: try /metrics or /healthz\n",
                            "text/plain")

@@ -36,6 +36,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import itertools
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence as Seq
 
@@ -65,6 +67,13 @@ class Request:
     # the misses.  Neither field drops or preempts work.
     priority: int = 0
     deadline_s: Optional[float] = None
+    # end-to-end trace id: threaded through every serve span of this
+    # request's lifecycle (queue -> admit -> prefill -> decode ->
+    # deliver) so one request's timeline is filterable out of the
+    # Chrome-trace export (docs/observability.md "Per-request serve
+    # traces").  None = the engine assigns one at submit; a caller
+    # propagating an upstream id (gateway, RPC) sets it here.
+    trace_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -84,11 +93,20 @@ class RequestResult:
     cached_prompt_tokens: int = 0
     # finish beat the request's deadline (None = no deadline given)
     deadline_met: Optional[bool] = None
+    # the id every serve span of this request carried (filter the
+    # Chrome-trace export on it to see this request's full timeline)
+    trace_id: str = ""
 
 
 def _percentile(xs: List[float], q: float) -> float:
     return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
 
+
+#: process-global trace-id sequence: request ids restart at 0 per
+#: engine, but co-located engines (bench's control engine, an A/B
+#: pair) share one tracing ring — ids must be unique per PROCESS or
+#: filtering the exported timeline mixes two requests' spans
+_trace_seq = itertools.count()
 
 _tpu_block_size_warned = False
 
@@ -268,10 +286,15 @@ class ServeEngine:
                 f"deadline_s must be > 0 seconds from submit, got "
                 f"{req.deadline_s}")
         serve = self.config.serve
+        # trace id: pid x process-global sequence — unique across
+        # processes AND across co-located engines in one process
+        trace_id = (req.trace_id if req.trace_id
+                    else f"{os.getpid():x}-{next(_trace_seq):x}")
         seq = Sequence(sid=self._next_id, prompt=prompt, max_new=max_new,
                        temperature=req.temperature, top_k=req.top_k,
                        top_p=req.top_p, eos_id=req.eos_id, seed=req.seed,
-                       priority=req.priority, on_token=on_token)
+                       priority=req.priority, on_token=on_token,
+                       trace_id=trace_id)
         need = self.scheduler.blocks_for(seq)
         if need > self.scheduler.max_blocks_per_seq:
             raise ValueError(
@@ -584,6 +607,7 @@ class ServeEngine:
             cached_prompt_tokens=seq.cached_tokens,
             deadline_met=(None if seq.deadline == float("inf")
                           else bool(seq.t_finish <= seq.deadline)),
+            trace_id=seq.trace_id,
         )
         if pop:
             del self._all[request_id]

@@ -464,6 +464,12 @@ class Sequence:
     # ring resolves each token (<= decode_depth - 1 iterations after
     # dispatch) — engine.submit(..., on_token=...) plumbs it here
     on_token: Any = None
+    # end-to-end trace id (engine.submit assigns it): rides every serve
+    # span this request participates in — `trace` on its own spans
+    # (queue/admit/single prefill), `traces` on the batched ones
+    # (batched prefill, decode, deliver) — and surfaces in
+    # RequestResult.trace_id
+    trace_id: str = ""
     # runtime
     slot: int = -1
     blocks: List[int] = dataclasses.field(default_factory=list)
@@ -625,6 +631,7 @@ class Scheduler:
         if ok:
             now = time.perf_counter()
             tracing.record_span("serve/admit", t0, now, sid=seq.sid,
+                                trace=seq.trace_id,
                                 cached_tokens=seq.cached_tokens)
             if seq.t_submit:
                 # the queue-wait interval, recorded at the only moment
@@ -632,7 +639,7 @@ class Scheduler:
                 tracing.record_span(
                     "serve/queue",
                     now - max(seq.t_admit - seq.t_submit, 0.0), now,
-                    sid=seq.sid)
+                    sid=seq.sid, trace=seq.trace_id)
         return ok
 
     def _admit_impl(self, seq: Sequence) -> bool:
@@ -781,7 +788,8 @@ class Scheduler:
         pools = (self.k_pools, self.v_pools)
         final = (t0 + n_valid) >= seq.prompt_len
         with tracing.span("serve/prefill", sid=seq.sid, t0=t0,
-                          tokens=n_valid, batched=False):
+                          tokens=n_valid, batched=False,
+                          trace=seq.trace_id):
             pools, last_logits = self.decoder._prefill(
                 self.params, pools, jnp.asarray(self.tables[seq.slot]),
                 jnp.asarray(t0, jnp.int32), jnp.asarray(chunk, jnp.int32),
@@ -817,6 +825,7 @@ class Scheduler:
         pools = (self.k_pools, self.v_pools)
         with tracing.span("serve/prefill", batched=True,
                           sids=[s.sid for s in seqs],
+                          traces=[s.trace_id for s in seqs],
                           tokens=int(sum(taken))):
             pools, logits = self.decoder._prefill_batch(
                 self.params, pools, jnp.asarray(tables), jnp.asarray(t0s),
@@ -877,8 +886,13 @@ class Scheduler:
         tables, active, temp, top_k, top_p = self._dev_stable_arrays()
         all_greedy = bool((self.temp[self.active] <= 0.0).all())
         pools = (self.k_pools, self.v_pools)
+        # per-request trace ids on the batched span: built only while
+        # tracing records (the list comprehension must cost nothing on
+        # the disabled hot path)
+        _traces = ([s.trace_id for _, s in snapshot]
+                   if tracing.enabled() else None)
         with tracing.span("serve/decode", iter=self._iter,
-                          slots=len(snapshot)):
+                          slots=len(snapshot), traces=_traces):
             pools, self.carry, toks = self.decoder._decode(
                 self.params, pools, self.carry,
                 tables, jnp.asarray(self.seq_lens),
@@ -956,7 +970,12 @@ class Scheduler:
         entry = self._ring.popleft()
         # stream-delivery span: token readback (the lagged blocking
         # fetch) + per-request recording incl. on_token callbacks
-        with tracing.span("serve/deliver", kind=entry.kind):
+        _traces = None
+        if tracing.enabled():
+            _traces = ([entry.seq.trace_id] if entry.kind == "first"
+                       else [s.trace_id for _, s in entry.slots])
+        with tracing.span("serve/deliver", kind=entry.kind,
+                          traces=_traces):
             if self.blocked is not None:     # the (only) blocking fetch
                 with self.blocked.blocked():
                     toks = np.asarray(entry.tokens)

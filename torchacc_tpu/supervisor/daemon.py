@@ -126,6 +126,9 @@ class Supervisor:
                  policy: Optional[RestartPolicy] = None, *,
                  poll_interval_s: float = 0.25,
                  obs_port: Optional[int] = None,
+                 fleet_poll_interval_s: float = 2.0,
+                 drift_factor: float = 1.5,
+                 drift_patience: int = 3,
                  rng=None,
                  sleep: Callable[[float], None] = time.sleep,
                  prober_factory: Optional[
@@ -142,16 +145,115 @@ class Supervisor:
         self._last_durable = newest_valid_step(spec.run_dir)
         self._handles: List[WorkerHandle] = []
         self.final_bundle_path: Optional[str] = None
+        self._t0 = time.monotonic()
+        #: the fleet scraper (obs/aggregate.py): pod-wide /metrics
+        #: aggregation + the /fleet JSON view, served from THIS
+        #: daemon's obs port — the single pane of glass
+        self.fleet = None
+        #: restart/rejoin downtime ledger (obs/goodput.py): `active`
+        #: vs `down:<policy rule>` buckets over the run's wall clock
+        self._fleet_ledger = None
+        #: policy rule the NEXT between-incarnation gap is attributed
+        #: to (the first launch's cost is `down:startup`)
+        self._pending_rule = "startup"
         if obs_port is not None:
             # the daemon's own /metrics endpoint: the supervisor_*
-            # counters ride it automatically (torchacc_*_total)
+            # counters ride it automatically (torchacc_*_total), and
+            # the fleet aggregation layers on top of it
             from torchacc_tpu.obs import server as obs_server
+            srv = None
             try:
-                obs_server.start(port=obs_port)
+                srv = obs_server.start(port=obs_port)
             except OSError as e:
                 logger.warning(
                     f"supervisor: telemetry port {obs_port} busy ({e}); "
                     "continuing without /metrics")
+            if srv is not None:
+                from torchacc_tpu.obs.aggregate import (
+                    DriftDetector,
+                    FleetAggregator,
+                )
+                from torchacc_tpu.obs.goodput import GoodputLedger
+                self._fleet_ledger = GoodputLedger()
+                self.fleet = FleetAggregator(
+                    poll_interval_s=fleet_poll_interval_s,
+                    timeout_s=spec.probe_timeout_s,
+                    drift=DriftDetector(factor=drift_factor,
+                                        patience=drift_patience),
+                    context=self._fleet_context)
+                # satellite gauges: the fleet endpoint answers usefully
+                # even before any worker binds its telemetry port
+                obs_server.register_gauge(
+                    "supervisor_uptime_s",
+                    lambda: time.monotonic() - self._t0,
+                    help="seconds since this supervisor daemon started")
+                obs_server.register_gauge(
+                    "supervisor_incarnation",
+                    lambda: float(self.incarnation),
+                    help="current worker incarnation index")
+                obs_server.register_gauge(
+                    "supervisor_world",
+                    lambda: float(self.engine.world),
+                    help="current pod world size (initial minus "
+                         "exclusions)")
+                obs_server.register_text(
+                    "supervisor_hosts", self._hosts_prom_text)
+                obs_server.register_text(
+                    "supervisor_fleet", self.fleet.prometheus_text)
+                obs_server.register_json("/fleet", self.fleet.fleet_json)
+                obs_server.register_health(
+                    "fleet_straggler", self.fleet.drift.health)
+                self.fleet.start()
+
+    # -- fleet view ----------------------------------------------------------
+
+    def _fleet_context(self) -> Dict[str, Any]:
+        """The daemon-owned half of the ``/fleet`` payload: supervisor
+        state, the strict-JSON decision history (every entry carries
+        rule/error type/timestamp — the log line's machine twin), and
+        the restart-downtime goodput ledger."""
+        d: Dict[str, Any] = {
+            "supervisor": {
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "incarnation": self.incarnation,
+                "world": self.engine.world,
+                "world_size": self.spec.world_size,
+                "excluded": sorted(self.engine.excluded),
+                "restarts_used": self.engine.restarts_used,
+                "max_restarts": self.policy.max_restarts,
+                "newest_durable_step": self._last_durable,
+                "alive": {str(h.host): bool(h.running())
+                          for h in self._handles},
+            },
+            "decisions": list(self.decisions),
+        }
+        if self._fleet_ledger is not None:
+            d["goodput_supervisor"] = self._fleet_ledger.summary()
+        return d
+
+    def _hosts_prom_text(self) -> str:
+        """Per-host alive/excluded gauges (labeled series the scalar
+        gauge registry cannot express).  ``host`` ids: alive uses the
+        CURRENT incarnation's indices, excluded the ORIGINAL pod's —
+        host ids renumber after an elastic shrink
+        (docs/observability.md "Fleet view")."""
+        running = {h.host: h.running() for h in self._handles}
+        lines = ["# TYPE torchacc_fleet_host_alive gauge"]
+        for host in sorted(running):
+            lines.append(
+                f'torchacc_fleet_host_alive{{host="{host}"}} '
+                f'{1 if running[host] else 0}')
+        lines.append("# TYPE torchacc_fleet_host_excluded gauge")
+        for host in range(self.spec.world_size):
+            lines.append(
+                f'torchacc_fleet_host_excluded{{host="{host}"}} '
+                f'{1 if host in self.engine.excluded else 0}')
+        return "\n".join(lines) + "\n"
+
+    def _ledger_lap(self, bucket: str) -> None:
+        if self._fleet_ledger is not None:
+            self._fleet_ledger.lap(bucket)
+            self._fleet_ledger.publish(prefix="supervisor_goodput_")
 
     # -- workers -------------------------------------------------------------
 
@@ -170,8 +272,12 @@ class Supervisor:
         world = self.engine.world
         coord_port = free_port()
         handles, probers = [], []
+        worker_urls: Dict[int, str] = {}
+        # workers get telemetry ports when probing OR when the fleet
+        # aggregator needs endpoints to scrape
+        want_obs = s.probe or self.fleet is not None
         for host in range(world):
-            obs_port = free_port() if s.probe else 0
+            obs_port = free_port() if want_obs else 0
             mapping = {"host": host, "world": world,
                        "incarnation": self.incarnation,
                        "run_dir": s.run_dir, "coord_port": coord_port,
@@ -184,6 +290,8 @@ class Supervisor:
             handle = WorkerHandle(host, argv, env=env,
                                   log_path=log).start()
             handles.append(handle)
+            if want_obs:
+                worker_urls[host] = f"http://127.0.0.1:{obs_port}"
             if s.probe:
                 pr = self._prober_factory(host, obs_port)
                 # restart identity: /healthz answers carrying another
@@ -194,6 +302,12 @@ class Supervisor:
                 probers.append(pr)
             else:
                 probers.append(None)
+        if self.fleet is not None:
+            # fresh incarnation: the dying one's last-seen totals fold
+            # into the per-host base inside (counters/histograms stay
+            # monotonic across restarts)
+            self.fleet.set_workers(worker_urls,
+                                   incarnation=self.incarnation)
         return handles, probers
 
     def _stop_all(self, handles: List[WorkerHandle]) -> None:
@@ -292,16 +406,23 @@ class Supervisor:
         "final_bundle": path|None}``."""
         s = self.spec
         os.makedirs(s.run_dir, exist_ok=True)
+        if self._fleet_ledger is not None:
+            self._fleet_ledger.start()
         try:
             while True:
                 since = time.time()
                 handles, probers = self._launch()
                 self._handles = handles
+                # everything since the previous incarnation ended (the
+                # decision, the backoff sleep, the relaunch) is restart
+                # downtime attributed to the policy rule that caused it
+                self._ledger_lap(f"down:{self._pending_rule}")
                 try:
                     exit_code, probe_verdict = self._watch(handles,
                                                            probers)
                 finally:
                     self._stop_all(handles)
+                self._ledger_lap("active")
                 disposition = read_exit_disposition(s.run_dir, since)
                 newest = newest_valid_step(s.run_dir)
                 if newest > self._last_durable:
@@ -314,6 +435,13 @@ class Supervisor:
                                             probe_verdict=probe_verdict)
                 self._record(action, disposition, exit_code,
                              probe_verdict)
+                self._pending_rule = action.rule
+                if self.fleet is not None and action.hosts:
+                    for h in action.hosts:
+                        # an excluded index may be reused by the
+                        # renumbered successor — its drift baseline
+                        # must not carry over
+                        self.fleet.drift.forget(h)
                 if action.kind == "done":
                     logger.info(
                         f"supervisor: run complete after "
@@ -338,6 +466,16 @@ class Supervisor:
                 self.incarnation += 1
         finally:
             self._stop_all(self._handles)
+            if self.fleet is not None:
+                # one last sweep so a fast-exiting worker's final
+                # counters land before the endpoints die, then stop
+                # the poller; the aggregated view stays served (the
+                # smoke gates scrape AFTER run() returns)
+                try:
+                    self.fleet.scrape_once()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+                self.fleet.stop()
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -359,6 +497,9 @@ class Supervisor:
                 probe_verdict: Optional[str]) -> None:
         d = disposition
         entry = {
+            # wall-clock decision timestamp: the /fleet decision
+            # history is the strict-JSON twin of the log line
+            "time": time.time(),
             "incarnation": self.incarnation,
             "rule": action.rule,
             "action": action.kind,

@@ -69,6 +69,13 @@ def _parse(argv):
                    help="apply --chaos only on this incarnation "
                         "(-1 = every incarnation)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--linger-s", type=float, default=0.0,
+                   help="hold the process (and its telemetry endpoint) "
+                        "open this long before exiting, on success AND "
+                        "typed-error abort — gives the supervisor's "
+                        "fleet scraper a final window to catch the "
+                        "run's last counters/histograms (the flight "
+                        "bundle is already on disk before the linger)")
     return p.parse_args(argv)
 
 
@@ -171,6 +178,11 @@ def main(argv=None) -> int:
     # newest commit-marked step BEFORE this incarnation restores
     print(f"SUPERVISOR_RESUME_CANDIDATE="
           f"{newest_valid_step(args.run_dir)}", flush=True)
+    def _linger():
+        if args.linger_s > 0:
+            import time
+            time.sleep(args.linger_s)
+
     ctx = plan if armed else contextlib.nullcontext()
     try:
         with ctx:
@@ -184,6 +196,7 @@ def main(argv=None) -> int:
         # disk — the supervisor reads THAT, not this line
         print(f"SUPERVISOR_ABORT type={type(e).__name__}: {e}",
               flush=True)
+        _linger()
         return 1
     for r in history:
         print("SUPERVISOR_REC "
@@ -191,6 +204,7 @@ def main(argv=None) -> int:
               flush=True)
     print(f"SUPERVISOR_DONE world={args.world} host={args.host} "
           f"incarnation={args.incarnation}", flush=True)
+    _linger()
     return 0
 
 

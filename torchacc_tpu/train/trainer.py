@@ -1584,6 +1584,13 @@ class Trainer:
                 if wd is not None:
                     wd.disarm()
 
+        # goodput ledger (obs/goodput.py): everything from the session
+        # open (manager construction, quarantine read, restore, loader
+        # seek) up to here is the init_restore bucket; the loop laps
+        # the rest.  Host-side and obs-gated — obs off touches nothing.
+        fo = self._obs_fit
+        if fo is not None:
+            fo.lap("init_restore")
         try:
             steps_it = enumerate(bounded, start=start_step)
             while True:
@@ -1594,7 +1601,11 @@ class Trainer:
                 except StopIteration:
                     if wd is not None:
                         wd.disarm()
+                    if fo is not None:
+                        fo.lap("data_wait")
                     break
+                if fo is not None:
+                    fo.lap("data_wait")
                 if wd is not None:
                     # the deadline is armed around dispatch + the LAGGED
                     # resolution point: in steady state the blocking
@@ -1602,17 +1613,20 @@ class Trainer:
                     # still means "a step's device work did not finish
                     # in time" (docs/resilience.md watchdog table)
                     wd.arm("train_step", res_cfg.step_deadline_s)
-                if self._obs_fit is not None:
+                if fo is not None:
                     # step wall time (dispatch + lagged resolution) into
                     # the step_time_ms histogram — host-side only
                     _t_step = _time.perf_counter()
                     self.step(batch)
-                    self._obs_fit.on_step_time(
+                    fo.on_step_time(
                         (_time.perf_counter() - _t_step) * 1e3)
+                    fo.lap("step")
                 else:
                     self.step(batch)
                 if self.last_resolved is not None:
                     _emit(self.last_resolved)
+                if fo is not None:
+                    fo.lap("log_eval")
                 if wd is not None:
                     # step boundary: a stall detected mid-step surfaces
                     # as HangError HERE (abort_on_hang), where state is
@@ -1709,6 +1723,8 @@ class Trainer:
                         saved = mgr.save(step_idx + 1, self.state,
                                          loader_state=loader_state_fn,
                                          guard_state=guard_state_fn)
+                if fo is not None:
+                    fo.lap("checkpoint")
                 # cross-host sync point: the emergency save triggers on
                 # EVERY host at this same boundary when ANY host saw the
                 # signal (exact local-flag check in single-process runs).
@@ -1780,11 +1796,13 @@ class Trainer:
                         f"preemption requested: emergency checkpoint at "
                         f"step {step_idx + 1} is durable; stopping fit "
                         "(resume with fit(resume='auto'))")
-                    if self._obs_fit is not None:
+                    if fo is not None:
+                        # the emergency-save window is checkpoint time
+                        fo.lap("checkpoint")
                         # preemption is a planned exit, but the operator
                         # still wants the last-minute picture — same
                         # bundle as a typed-error abort
-                        self._obs_fit.on_preempt(step_idx + 1)
+                        fo.on_preempt(step_idx + 1)
                     break
             # drain the dispatch pipeline: the final k in-flight steps
             # still owe their guard/SDC verdicts and log records — a
@@ -1794,6 +1812,8 @@ class Trainer:
             # updates are past the abort point and no checkpoint
             # committed them), and a hung device cannot be drained.
             _drain_all()
+            if fo is not None:
+                fo.lap("drain")
         finally:
             self._watchdog = None
             if wd is not None:
