@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke serve-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -99,6 +99,20 @@ supervisor-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 
+# serve-side fault-tolerance gate (docs/serving.md "Serving under the
+# supervisor"): (1) a supervised serve worker is SIGKILLed mid-decode
+# -> crash-backoff restart -> the request journal replays -> FAILS
+# unless 100% of submitted requests end completed (greedy outputs
+# token-identical to an uninterrupted reference) or explicitly
+# shed/unserved, zero silent losses, with the restart downtime
+# attributed to a down: bucket in the supervisor goodput ledger;
+# (2) a 2-worker serve fleet with a sustained injected slowdown on
+# host 1 -> fleet_straggler drift verdict -> the opt-in
+# straggler-eviction rule excludes host 1 (elastic shrink) and
+# attributes the downtime to down:straggler-evict
+serve-chaos:
+	JAX_PLATFORMS=cpu python scripts/serve_chaos_smoke.py
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -115,11 +129,13 @@ chaos:
 			tests/test_handoff.py tests/test_tiered.py \
 			tests/test_obs.py tests/test_profiling.py \
 			tests/test_supervisor.py tests/test_fleet.py \
+			tests/test_serve_resilience.py \
 			-m "not slow" \
 			-q || exit 1; \
 	done
 	$(MAKE) supervisor-smoke
 	$(MAKE) fleet-smoke
+	$(MAKE) serve-chaos
 
 # multi-host robustness proof: 2-process jax.distributed fixtures
 # (cross-host resume consensus with divergent quarantine, preemption

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""`make serve-chaos`: the end-to-end serve-side fault-tolerance gate
+(docs/serving.md "Serving under the supervisor").
+
+Two scenarios, zero human intervention, all on CPU:
+
+1. **kill -9 mid-decode -> restart -> journal replay** (one supervised
+   serve worker): a ChaosPlan SIGKILLs the worker at decode iteration
+   31 — requests already completed, one mid-decode, one queued, one
+   carrying an already-expired deadline.  No drain, no bundle, no
+   goodbye.  The supervisor's crash-backoff rule restarts it; the
+   fresh incarnation replays the journal (completed ids deduped, the
+   in-flight request re-decoded, the expired-deadline request shed
+   with a typed result) and exits clean.  The gate FAILS unless EVERY
+   submitted request is accounted — completed with tokens identical to
+   an uninterrupted reference run (greedy), or explicitly shed — with
+   zero silent losses, and the restart downtime is attributed to a
+   ``down:`` bucket in the supervisor's goodput ledger.
+2. **sustained straggler -> eviction** (2 supervised serve workers):
+   every decode iteration on host 1 sleeps 0.4s while host 0 serves at
+   full speed.  The fleet drift detector (baselining on the
+   ``serve_token_gap_ms`` histogram) flags host 1; the opt-in
+   straggler-eviction rule rides the verdict past its patience window,
+   stops the incarnation, EXCLUDES host 1 (elastic shrink to world=1)
+   and attributes the downtime to ``down:straggler-evict``.  The
+   surviving host replays its journal and completes.
+
+FAILS (exit 1) unless every assertion holds.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from torchacc_tpu.supervisor import (  # noqa: E402
+    RestartPolicy,
+    Supervisor,
+    WorkerSpec,
+    free_port,
+)
+from torchacc_tpu.supervisor.worker import JOURNAL_NAME  # noqa: E402
+
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+FIXTURE = [sys.executable, "-m", "torchacc_tpu.supervisor.serve_fixture"]
+
+
+def check(ok, msg):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {msg}", flush=True)
+    if not ok:
+        raise SystemExit(f"serve-chaos FAILED: {msg}")
+
+
+def read_journal_state(run_dir, host):
+    """(pending, completed, shed) dicts for one host's journal —
+    stdlib-only (the gate never imports jax)."""
+    path = os.path.join(run_dir, f"journal_h{host}", JOURNAL_NAME)
+    accepted, completed, shed = {}, {}, {}
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return accepted, completed, shed
+    for line in raw.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        rid = rec.get("rid")
+        if rec.get("kind") == "accepted":
+            accepted.setdefault(rid, rec)
+        elif rec.get("kind") == "completed":
+            completed[rid] = rec
+        elif rec.get("kind") == "shed":
+            shed[rid] = rec
+    pending = {r: v for r, v in accepted.items()
+               if r not in completed and r not in shed}
+    return pending, completed, shed
+
+
+def fixture_argv(requests, max_new, chaos, *, deadline_s=0.0,
+                 chaos_inc=0, linger_s=0.0, no_shed=False):
+    argv = FIXTURE + [
+        "--run-dir", "{run_dir}", "--world", "{world}",
+        "--host", "{host}", "--obs-port", "{obs_port}",
+        "--incarnation", "{incarnation}",
+        "--requests", str(requests), "--max-new", str(max_new),
+        "--chaos", json.dumps(chaos),
+        "--chaos-incarnation", str(chaos_inc),
+    ]
+    if deadline_s > 0:
+        argv += ["--deadline-s", str(deadline_s)]
+    if linger_s > 0:
+        argv += ["--linger-s", str(linger_s)]
+    if no_shed:
+        argv += ["--no-shed"]
+    return argv
+
+
+def reference_tokens(tmp, requests, max_new):
+    """Uninterrupted single-life run (shed off, no chaos): the
+    per-request greedy tokens every recovered run must reproduce."""
+    import subprocess
+    d = os.path.join(tmp, "reference")
+    os.makedirs(d)
+    env = dict(os.environ, **WORKER_ENV)
+    argv = FIXTURE + ["--run-dir", d, "--host", "0",
+                      "--requests", str(requests),
+                      "--max-new", str(max_new), "--no-shed"]
+    out = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=600)
+    if out.returncode != 0:
+        print(out.stdout[-3000:], out.stderr[-3000:])
+        raise SystemExit("reference serve run failed")
+    _, completed, _ = read_journal_state(d, 0)
+    return {rid: rec["tokens"] for rid, rec in completed.items()}
+
+
+def fetch_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def fetch_text(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def scenario_kill_replay(tmp, obs_port):
+    print("== scenario 1: SIGKILL mid-decode -> restart -> journal "
+          "replay ==", flush=True)
+    run_dir = os.path.join(tmp, "kill")
+    n_req, max_new = 6, 24
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=1, role="serve",
+        # kill at decode iteration 31: rids 0-3 completed (~iter 24),
+        # rid 4 admitted and mid-decode, the expired-deadline rid 5
+        # already shed by the sweep (its 1.5s deadline cannot survive
+        # the compile wait)
+        argv=fixture_argv(n_req, max_new,
+                          {"kill": {"after": 30}}, deadline_s=1.5),
+        env=WORKER_ENV, incarnation_timeout_s=600.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=3,
+                                         backoff_initial_s=0.2),
+                     obs_port=obs_port)
+    t0 = time.time()
+    rep = sup.run()
+    print(f"  report: "
+          f"{json.dumps({k: v for k, v in rep.items() if k != 'decisions'})}"
+          f" ({time.time() - t0:.0f}s)", flush=True)
+    check(rep["status"] == "completed", "run completed unattended")
+    d0 = rep["decisions"][0]
+    check(d0["rule"] == "crash-backoff" and d0["exit_code"] not in (0, None),
+          f"decision 0 = crash-backoff on the SIGKILL exit "
+          f"(rule={d0['rule']}, exit_code={d0['exit_code']})")
+    check(rep["decisions"][-1]["rule"] == "clean-exit",
+          "recovered incarnation exited clean")
+    # 100% accounting: every submitted id is completed or typed-shed
+    pending, completed, shed = read_journal_state(run_dir, 0)
+    check(not pending, f"zero silent losses (pending={sorted(pending)})")
+    check(set(completed) | set(shed) == set(range(n_req)),
+          f"all {n_req} requests accounted "
+          f"(completed={sorted(completed)}, shed={sorted(shed)})")
+    check(n_req - 1 in shed,
+          f"expired-deadline request {n_req - 1} shed with a typed "
+          f"record ({shed.get(n_req - 1, {}).get('reason')!r})")
+    # greedy replay token-identity vs the uninterrupted reference
+    ref = reference_tokens(tmp, n_req, max_new)
+    bad = [r for r in completed if completed[r]["tokens"] != ref.get(r)]
+    check(not bad,
+          f"every completed request token-identical to the "
+          f"uninterrupted reference ({len(completed)} checked"
+          + (f"; MISMATCH {bad}" if bad else "") + ")")
+    check(len(completed) >= n_req - 1,
+          f"kill cost latency, not requests "
+          f"({len(completed)}/{n_req - 1} servable completed)")
+    # restart downtime attributed in the goodput ledger
+    fleet = fetch_json(obs_port, "/fleet")
+    buckets = fleet["goodput_supervisor"]["buckets"]
+    check(buckets.get("down:crash-backoff", 0) > 0,
+          f"restart downtime attributed to down:crash-backoff "
+          f"({buckets})")
+    metrics = fetch_text(obs_port, "/metrics")
+    check("torchacc_supervisor_goodput_down_crash_backoff_ms_total"
+          in metrics,
+          "downtime bucket rides /metrics as a counter")
+    # the serve journal is the daemon's progress signal: the crash
+    # streak reset on replayed completions
+    check(rep["newest_durable_step"] >= n_req,
+          f"serve progress = finished journal records "
+          f"({rep['newest_durable_step']})")
+
+
+def scenario_straggler_evict(tmp, obs_port):
+    print("== scenario 2: sustained slow host -> fleet_straggler -> "
+          "eviction + elastic shrink ==", flush=True)
+    run_dir = os.path.join(tmp, "straggler")
+    n_req, max_new = 40, 4
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=2, role="serve",
+        # host 0 pays a small uniform sleep (keeps it serving across
+        # enough scrape windows to warm its baseline and survive until
+        # the verdict); host 1 is 9x slower — the sustained straggler
+        argv=fixture_argv(
+            n_req, max_new,
+            {"slow": [{"seconds": 0.045},
+                      {"seconds": 0.4, "host": 1}]},
+            chaos_inc=-1, linger_s=90.0),
+        env=WORKER_ENV,
+        exit_grace_s=600.0, incarnation_timeout_s=600.0)
+    policy = RestartPolicy(max_restarts=3, straggler_evict=True,
+                           straggler_evict_budget=1,
+                           straggler_patience_s=1.0)
+    sup = Supervisor(spec, policy, obs_port=obs_port,
+                     fleet_poll_interval_s=1.0,
+                     drift_factor=2.0, drift_patience=2,
+                     drift_min_rounds=2)
+    t0 = time.time()
+    rep = sup.run()
+    print(f"  report: "
+          f"{json.dumps({k: v for k, v in rep.items() if k != 'decisions'})}"
+          f" ({time.time() - t0:.0f}s)", flush=True)
+    check(rep["status"] == "completed", "run completed unattended")
+    check(rep["excluded"] == [1], f"host 1 evicted ({rep['excluded']})")
+    check(rep["world"] == 1, "fleet shrunk to world=1")
+    rules = [d["rule"] for d in rep["decisions"]]
+    check("straggler-evict" in rules,
+          f"decision carries the straggler-evict rule ({rules})")
+    evict = next(d for d in rep["decisions"]
+                 if d["rule"] == "straggler-evict")
+    check(evict["hosts"] == [1] and "fleet_straggler" in evict["reason"],
+          f"eviction names host 1 off the fleet_straggler verdict "
+          f"({evict['reason']!r})")
+    # downtime attributed to the eviction rule
+    fleet = fetch_json(obs_port, "/fleet")
+    buckets = fleet["goodput_supervisor"]["buckets"]
+    check(buckets.get("down:straggler-evict", 0) > 0,
+          f"restart downtime attributed to down:straggler-evict "
+          f"({buckets})")
+    metrics = fetch_text(obs_port, "/metrics")
+    check("torchacc_supervisor_straggler_evictions_total 1" in metrics,
+          "eviction counter rides /metrics")
+    # the surviving host's requests all accounted
+    pending0, completed0, shed0 = read_journal_state(run_dir, 0)
+    check(not pending0 and len(completed0) + len(shed0) == n_req,
+          f"surviving host fully served after the shrink "
+          f"(completed={len(completed0)}, shed={len(shed0)}, "
+          f"pending={sorted(pending0)})")
+    # the evicted host's unfinished requests are identifiable for
+    # resubmission — accounted, not silently gone
+    pending1, completed1, shed1 = read_journal_state(run_dir, 1)
+    check(len(pending1) + len(completed1) + len(shed1) == n_req,
+          f"evicted host's journal accounts every request "
+          f"({len(completed1)} completed, {len(pending1)} resubmittable)")
+
+
+def main() -> int:
+    t0 = time.time()
+    # ONE obs port for the whole gate: the telemetry server is a
+    # process-wide singleton (its first port wins), and provider
+    # registration is last-owner-wins — each scenario's supervisor
+    # takes over the same endpoint
+    obs_port = free_port()
+    with tempfile.TemporaryDirectory(prefix="serve_chaos_") as tmp:
+        scenario_kill_replay(tmp, obs_port)
+        scenario_straggler_evict(tmp, obs_port)
+    print(f"serve-chaos PASSED in {time.time() - t0:.0f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,825 @@
+"""Serve-side fault-tolerance tests (ISSUE 15): durable request
+journal + replay, deadline shedding, straggler eviction, serve exit
+disposition — plus this PR's satellite regressions (bf16-shadow swap
+invariant, NeoX converter dispatch).
+
+Load-bearing guarantees:
+
+- journal append/recover round-trips strict JSON and tolerates the one
+  torn tail line a ``kill -9`` can leave;
+- ``ServeEngine.recover()`` re-admits journaled-but-unfinished
+  requests idempotently under their ORIGINAL ids, dedupes completed
+  ids, and greedy replays are token-identical to an uninterrupted run;
+- ``serve.journal_dir`` unset is inert (token-identical, no files);
+- deadline shedding produces a typed, counted, journaled result —
+  never a silent timeout;
+- the straggler-eviction rule honours patience (a transient blip never
+  evicts), its eviction budget, and ``min_world``;
+- the serve exit disposition round-trips through the supervisor's
+  bundle reader.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchacc_tpu.config import Config, ObsConfig, ServeConfig
+from torchacc_tpu.models import TransformerLM, get_preset
+from torchacc_tpu.serve import Request, ServeEngine
+from torchacc_tpu.serve.journal import (
+    JOURNAL_NAME,
+    RequestJournal,
+    read_journal,
+    replay_state,
+)
+
+pytestmark = pytest.mark.serve_resilience
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_preset(
+        "llama-tiny", dtype=jnp.float32, num_layers=1, hidden_size=32,
+        num_heads=2, num_kv_heads=2, intermediate_size=64,
+        vocab_size=VOCAB, max_seq_len=128)
+    model = TransformerLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _cfg(journal_dir=None, **kw):
+    base = dict(block_size=8, num_blocks=64, max_slots=4,
+                prefill_chunk=8, decode_depth=2)
+    base.update(kw)
+    return Config(serve=ServeConfig(journal_dir=journal_dir, **base))
+
+
+def _prompts(seed, n):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, VOCAB, size=int(l)).tolist()
+            for l in rng.integers(3, 14, size=n)]
+
+
+# ---------------------------------------------------------------------------
+# journal units
+# ---------------------------------------------------------------------------
+
+def test_journal_append_read_roundtrip(tmp_path):
+    j = RequestJournal(str(tmp_path))
+    j.accepted(rid=0, trace_id="t0", prompt_ids=[1, 2, 3],
+               max_new_tokens=4, temperature=0.0, top_k=0, top_p=1.0,
+               eos_id=None, seed=0, priority=2, deadline_unix=123.5)
+    j.completed(rid=0, tokens=[7, 8], finish_reason="length")
+    j.shed(rid=1, reason="deadline-unmeetable")
+    j.close()
+    recs = read_journal(str(tmp_path))
+    assert [r["kind"] for r in recs] == ["accepted", "completed", "shed"]
+    a = recs[0]
+    assert a["rid"] == 0 and a["prompt_ids"] == [1, 2, 3]
+    assert a["deadline_unix"] == 123.5 and a["priority"] == 2
+    assert a["prompt_sha"]                      # content hash present
+    assert recs[1]["tokens"] == [7, 8]
+    # strict JSON: every line parses standalone
+    with open(tmp_path / JOURNAL_NAME) as f:
+        for line in f:
+            json.loads(line)
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    j = RequestJournal(str(tmp_path))
+    j.accepted(rid=0, trace_id="t", prompt_ids=[1], max_new_tokens=2,
+               temperature=0.0, top_k=0, top_p=1.0, eos_id=None,
+               seed=0, priority=0, deadline_unix=None)
+    j.close()
+    # the torn tail a kill -9 mid-append leaves
+    with open(tmp_path / JOURNAL_NAME, "ab") as f:
+        f.write(b'{"kind": "completed", "rid": 0, "tok')
+    recs = read_journal(str(tmp_path))
+    assert [r["kind"] for r in recs] == ["accepted"]
+    pending, completed, shed = replay_state(recs)
+    assert sorted(pending) == [0] and not completed and not shed
+
+
+def test_journal_rejects_unknown_kind(tmp_path):
+    j = RequestJournal(str(tmp_path))
+    with pytest.raises(ValueError):
+        j.append({"kind": "bogus", "rid": 0})
+
+
+def test_replay_state_semantics():
+    recs = [
+        {"kind": "accepted", "rid": 0},
+        {"kind": "accepted", "rid": 0, "dup": True},   # first wins
+        {"kind": "accepted", "rid": 1},
+        {"kind": "accepted", "rid": 2},
+        {"kind": "completed", "rid": 1, "tokens": [5]},
+        {"kind": "shed", "rid": 2, "reason": "x"},
+        {"kind": "completed", "rid": 9},     # terminal without accept
+    ]
+    pending, completed, shed = replay_state(recs)
+    assert sorted(pending) == [0]
+    assert "dup" not in pending[0]
+    assert sorted(completed) == [1, 9] and sorted(shed) == [2]
+
+
+# ---------------------------------------------------------------------------
+# engine replay
+# ---------------------------------------------------------------------------
+
+def test_journal_off_is_inert(tiny, tmp_path):
+    model, params = tiny
+    prompts = _prompts(1, 3)
+    reqs = lambda: [Request(prompt_ids=p, max_new_tokens=6)
+                    for p in prompts]
+    off = ServeEngine(model, params, _cfg())
+    out_off = [r.tokens for r in off.generate(reqs())]
+    on = ServeEngine(model, params, _cfg(str(tmp_path / "j")))
+    out_on = [r.tokens for r in on.generate(reqs())]
+    assert out_off == out_on
+    # off: no journal anywhere, recover() is an inert no-op; on: the
+    # journal landed where configured
+    assert off._journal is None
+    assert off.recover() == {"replayed": [], "completed": [],
+                             "shed": [], "shed_on_recovery": []}
+    assert (tmp_path / "j" / JOURNAL_NAME).exists()
+
+
+def test_replay_token_identical_with_completed_dedupe(tiny, tmp_path):
+    """The acceptance-shaped scenario, in process: some requests
+    complete, one is mid-decode, some are queued when the engine is
+    abandoned (the kill -9 stand-in) — the recovered engine serves
+    EXACTLY the unfinished remainder, token-identical."""
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    prompts = _prompts(2, 6)
+    mk = lambda: [Request(prompt_ids=p, max_new_tokens=8)
+                  for p in prompts]
+    # uninterrupted reference
+    ref = ServeEngine(model, params, _cfg())
+    ref_tokens = [r.tokens for r in ref.generate(mk())]
+
+    cfg = _cfg(jd, max_slots=2)          # 2 slots: a real queue forms
+    eng = ServeEngine(model, params, cfg)
+    ids = [eng.submit(r) for r in mk()]
+    assert ids == list(range(6))
+    # run until at least one completed while others are mid-flight
+    for _ in range(500):
+        eng.step()
+        if eng._completed >= 2:
+            break
+    assert eng._completed >= 2
+    pend_before, comp_before, _ = replay_state(read_journal(jd))
+    assert comp_before and pend_before
+    # "kill": abandon the engine mid-decode; fresh engine, same journal
+    eng2 = ServeEngine(model, params, cfg)
+    rec = eng2.recover()
+    assert rec["replayed"] == sorted(pend_before)
+    assert rec["completed"] == sorted(comp_before)
+    eng2.run()
+    # second recover is a no-op (idempotent)
+    assert eng2.recover() == rec
+    pending, completed, shed = replay_state(read_journal(jd))
+    assert not pending and not shed
+    assert sorted(completed) == list(range(6))
+    for rid in range(6):
+        assert completed[rid]["tokens"] == ref_tokens[rid], rid
+    # the replayed requests kept their original ids and results are
+    # reachable under them
+    for rid in rec["replayed"]:
+        assert eng2.result(rid).tokens == ref_tokens[rid]
+
+
+def test_unservable_after_restart_keeps_result_contract(tiny, tmp_path):
+    """A journaled request the restarted engine can no longer serve is
+    shed with the SAME typed, retrievable result a deadline shed gets —
+    the caller holding the original id must never see a KeyError."""
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    eng = ServeEngine(model, params, _cfg(jd))
+    ok = eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=2))
+    # forge an accepted record the fixture model cannot serve (beyond
+    # the learned position table) — the stand-in for a restart onto a
+    # smaller pool/model
+    eng._journal.accepted(
+        rid=7, trace_id="t7", prompt_ids=[1, 2], max_new_tokens=100_000,
+        temperature=0.0, top_k=0, top_p=1.0, eos_id=None, seed=0,
+        priority=0, deadline_unix=None)
+    eng2 = ServeEngine(model, params, _cfg(jd))
+    rec = eng2.recover()
+    assert rec["replayed"] == [ok] and rec["shed_on_recovery"] == [7]
+    res = eng2.result(7)                  # no KeyError: typed shed
+    assert res.finish_reason == "shed" and res.tokens == []
+    _, _, shed = replay_state(read_journal(jd))
+    assert 7 in shed and "unservable-after-restart" in shed[7]["reason"]
+    eng2.run()
+    assert eng2.result(ok).tokens         # the servable one completed
+
+
+def test_recover_retryable_after_journal_write_failure(tiny, tmp_path,
+                                                       monkeypatch):
+    """A journal write error mid-recovery (disk full while shedding)
+    surfaces as the ORIGINAL OSError and leaves recover() retryable —
+    never a TypeError off a consumed replay fold, never a lost
+    replay."""
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    eng = ServeEngine(model, params, _cfg(jd))
+    ok = eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=2))
+    eng._journal.accepted(                # unservable: forces a shed
+        rid=7, trace_id="t7", prompt_ids=[1, 2], max_new_tokens=100_000,
+        temperature=0.0, top_k=0, top_p=1.0, eos_id=None, seed=0,
+        priority=0, deadline_unix=None)
+    eng2 = ServeEngine(model, params, _cfg(jd))
+    real_shed = eng2._journal.shed
+    calls = {"n": 0}
+
+    def flaky_shed(**kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("disk full")
+        return real_shed(**kw)
+
+    monkeypatch.setattr(eng2._journal, "shed", flaky_shed)
+    with pytest.raises(OSError, match="disk full"):
+        eng2.recover()
+    rec = eng2.recover()                  # retry completes the replay
+    assert rec["shed_on_recovery"] == [7]
+    # the retry's report covers the WHOLE recovery, including the
+    # requests the failed first attempt already re-admitted
+    assert rec["replayed"] == [ok]
+    assert eng2.result(7).finish_reason == "shed"
+    eng2.run()
+    assert eng2.result(ok).tokens         # the servable one completed
+
+
+def test_recover_advances_next_id(tiny, tmp_path):
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    eng = ServeEngine(model, params, _cfg(jd))
+    eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=2))
+    eng2 = ServeEngine(model, params, _cfg(jd))
+    eng2.recover()
+    rid = eng2.submit(Request(prompt_ids=[4, 5], max_new_tokens=2))
+    assert rid == 1                       # fresh id past the journal's
+    eng2.run()
+    _, completed, _ = replay_state(read_journal(jd))
+    assert sorted(completed) == [0, 1]
+
+
+def test_replay_prefix_cache_rewarm(tiny, tmp_path):
+    """Replay under an enabled prefix cache stays token-identical (the
+    re-prefill re-warms the cache; stale-state hazards would surface as
+    drift)."""
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    sys_p = list(range(1, 17))
+    prompts = [sys_p + [20 + i] for i in range(3)]
+    mk = lambda: [Request(prompt_ids=p, max_new_tokens=6)
+                  for p in prompts]
+    ref = ServeEngine(model, params, _cfg(prefix_cache=True))
+    ref_tokens = [r.tokens for r in ref.generate(mk())]
+    eng = ServeEngine(model, params, _cfg(jd, prefix_cache=True))
+    for r in mk():
+        eng.submit(r)
+    for _ in range(4):
+        eng.step()
+    eng2 = ServeEngine(model, params, _cfg(jd, prefix_cache=True))
+    eng2.recover()
+    eng2.run()
+    _, completed, _ = replay_state(read_journal(jd))
+    assert sorted(completed) == [0, 1, 2]
+    for rid in range(3):
+        assert completed[rid]["tokens"] == ref_tokens[rid]
+
+
+def test_submit_before_recover_never_reuses_journaled_ids(tiny, tmp_path):
+    """A journal-configured engine reserves the journal's ids at
+    construction: a submit() that races ahead of recover() can never
+    collide with a journaled request (a collision would let the new
+    request's 'completed' record mark the OLD unfinished one done)."""
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    eng = ServeEngine(model, params, _cfg(jd))
+    eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=2))  # rid 0
+    eng2 = ServeEngine(model, params, _cfg(jd))
+    rid = eng2.submit(Request(prompt_ids=[9, 9], max_new_tokens=2))
+    assert rid == 1                        # reserved past the journal
+    rec = eng2.recover()
+    assert rec["replayed"] == [0]
+    eng2.run()
+    pending, completed, _ = replay_state(read_journal(jd))
+    assert not pending and sorted(completed) == [0, 1]
+
+
+def test_failed_journal_append_enqueues_nothing(tiny, tmp_path, monkeypatch):
+    """submit() journals BEFORE taking the request: an append failure
+    raises with nothing enqueued — no half-accepted request the
+    journal has never heard of.  The id is BURNED, not recycled: a
+    raise from fsync does not prove the line missed the disk, and a
+    different request reusing the id would let the phantom accepted
+    record hijack it on replay."""
+    model, params = tiny
+    eng = ServeEngine(model, params, _cfg(str(tmp_path / "j")))
+    real_append = eng._journal.append
+    monkeypatch.setattr(eng._journal, "append",
+                        lambda rec: (_ for _ in ()).throw(OSError("full")))
+    with pytest.raises(OSError):
+        eng.submit(Request(prompt_ids=[1, 2], max_new_tokens=2))
+    assert not eng._queue and not eng._all
+    assert eng._next_id == 1               # burned, never reused
+    assert not eng.step()                  # nothing to serve
+    monkeypatch.setattr(eng._journal, "append", real_append)
+    rid = eng.submit(Request(prompt_ids=[3, 4], max_new_tokens=2))
+    assert rid == 1                        # fresh id past the burn
+
+
+def test_straggler_watch_reset_clears_patience_clocks():
+    """Daemon incarnation boundaries reset the patience window: a
+    sticky pre-restart verdict (its clock inflated by the downtime)
+    must be re-sustained against the fresh incarnation."""
+    from torchacc_tpu.supervisor import StragglerWatch
+    t = [0.0]
+    w = StragglerWatch(patience_s=2.0, clock=lambda: t[0])
+    w.update({1: "slow"})
+    t[0] = 30.0                            # restart downtime elapsed
+    w.reset()                              # new incarnation
+    assert w.update({1: "slow"}) is None   # clock restarted
+    t[0] = 32.5
+    assert w.update({1: "slow"}) == 1      # re-sustained -> evict
+
+
+# ---------------------------------------------------------------------------
+# deadline shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_expired_deadline_typed_and_accounted(tiny, tmp_path):
+    model, params = tiny
+    from torchacc_tpu.utils.metrics import counters
+    base = counters.get("serve_requests_shed")
+    jd = str(tmp_path / "j")
+    eng = ServeEngine(model, params, _cfg(jd, shed_deadlines=True))
+    ok = eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4))
+    doomed = eng.submit(Request(prompt_ids=[4, 5], max_new_tokens=4,
+                                deadline_s=0.005))
+    time.sleep(0.02)                      # expire while queued
+    eng.run()
+    r = eng.result(doomed)
+    assert r.finish_reason == "shed" and r.tokens == []
+    assert r.deadline_met is False
+    assert eng.result(ok).finish_reason in ("length", "eos")
+    assert counters.get("serve_requests_shed") == base + 1
+    assert eng.stats()["shed"] == 1
+    assert eng.drain_report()["shed"] == [doomed]
+    _, completed, shed = replay_state(read_journal(jd))
+    assert sorted(shed) == [doomed] and sorted(completed) == [ok]
+    assert shed[doomed]["reason"].startswith("deadline-unmeetable")
+
+
+def test_shed_off_serves_late(tiny):
+    model, params = tiny
+    eng = ServeEngine(model, params, _cfg())     # shed_deadlines off
+    rid = eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4,
+                             deadline_s=0.005))
+    time.sleep(0.02)
+    eng.run()
+    r = eng.result(rid)
+    assert r.finish_reason == "length" and len(r.tokens) == 4
+    assert r.deadline_met is False               # miss, not a shed
+
+
+def test_shed_on_recovery_when_deadline_passed_while_down(tiny, tmp_path):
+    model, params = tiny
+    jd = str(tmp_path / "j")
+    eng = ServeEngine(model, params, _cfg(jd, shed_deadlines=True))
+    rid = eng.submit(Request(prompt_ids=[1, 2, 3], max_new_tokens=4,
+                             deadline_s=0.01))
+    # process "dies" before serving; deadline passes while down
+    time.sleep(0.05)
+    eng2 = ServeEngine(model, params, _cfg(jd, shed_deadlines=True))
+    from torchacc_tpu.utils.metrics import counters
+    replayed_before = counters.get("serve_requests_replayed")
+    rec = eng2.recover()
+    # reported as dropped, not as about-to-be-served — and the replay
+    # counter agrees with the returned list (an expired replay is a
+    # shed, not a replay)
+    assert rec["shed_on_recovery"] == [rid]
+    assert rid not in rec["replayed"]
+    assert counters.get("serve_requests_replayed") == replayed_before
+    # a shed-only window is visible in stats(), not collapsed to
+    # "nothing happened"
+    s = eng2.stats()
+    assert s["requests"] == 0 and s["shed"] == 1
+    eng2.run()
+    assert eng2.result(rid).finish_reason == "shed"
+    _, completed, shed = replay_state(read_journal(jd))
+    assert sorted(shed) == [rid] and not completed
+
+
+# ---------------------------------------------------------------------------
+# straggler-eviction rule
+# ---------------------------------------------------------------------------
+
+def _engine(world=4, **pol):
+    from torchacc_tpu.supervisor import PolicyEngine, RestartPolicy
+    defaults = dict(straggler_evict=True, straggler_evict_budget=1,
+                    straggler_patience_s=1.0, max_restarts=8)
+    defaults.update(pol)
+    return PolicyEngine(RestartPolicy(**defaults), world)
+
+
+def test_straggler_evict_excludes_named_host():
+    eng = _engine()
+    a = eng.decide(None, straggler_host=2)
+    assert a.kind == "restart_excluding" and a.rule == "straggler-evict"
+    assert a.hosts == (2,)
+    assert eng.excluded == {2} and eng.world == 3
+    assert eng.restarts_used == 1         # consumes the restart budget
+    assert "fleet_straggler" in a.reason
+
+
+def test_straggler_budget_bounds_evictions():
+    eng = _engine(straggler_evict_budget=1)
+    assert eng.decide(None, straggler_host=1).rule == "straggler-evict"
+    a = eng.decide(None, straggler_host=2)
+    assert a.rule == "straggler-not-evictable" and a.kind == "restart"
+    assert eng.excluded == {1}            # budget spent: no 2nd evict
+
+
+def test_straggler_never_below_min_world():
+    eng = _engine(world=2, min_world=2)
+    a = eng.decide(None, straggler_host=1)
+    assert a.rule == "straggler-not-evictable"
+    assert eng.excluded == set() and eng.world == 2
+
+
+def test_straggler_rule_off_never_excludes():
+    eng = _engine(straggler_evict=False)
+    a = eng.decide(None, straggler_host=1)
+    assert a.rule == "straggler-not-evictable"
+    assert eng.excluded == set()
+
+
+def test_peer_drain_bundle_never_reads_as_preemption_on_crash():
+    """A kill -9'd serve worker leaves no bundle; its SIGTERM-drained
+    peer writes a ``preempted`` one.  The nonzero aggregate exit code
+    must route the decision to crash-backoff — reading the peer's
+    collateral drain as a scheduler eviction would resume budget-free
+    forever and mask the crash loop."""
+    from torchacc_tpu.supervisor import ExitDisposition
+    eng = _engine()
+    d = ExitDisposition(reason="preemption", preempted=True)
+    a = eng.decide(d, exit_code=-9)
+    assert a.kind == "restart" and a.rule == "crash-backoff"
+    assert eng.restarts_used == 1
+    # a genuine eviction (every worker drained and exited 0) resumes,
+    # as does a unit call that carries no exit code at all
+    assert eng.decide(d, exit_code=0).rule == "preempt-resume"
+    assert eng.decide(d).rule == "preempt-resume"
+    assert eng.restarts_used == 1
+
+
+def test_straggler_watch_patience_blip_never_evicts():
+    from torchacc_tpu.supervisor import StragglerWatch
+    t = [0.0]
+    w = StragglerWatch(patience_s=2.0, clock=lambda: t[0])
+    assert w.update({1: "slow"}) is None          # first sighting
+    t[0] = 1.0
+    assert w.update({1: "slow"}) is None          # inside patience
+    t[0] = 1.5
+    assert w.update({}) is None                   # blip: flag cleared
+    t[0] = 3.6                                    # would be past 2.0s
+    assert w.update({1: "slow"}) is None          # ...but clock reset
+    t[0] = 5.7
+    assert w.update({1: "slow"}) == 1             # sustained -> evict
+
+
+def test_straggler_watch_names_lowest_sustained_host():
+    from torchacc_tpu.supervisor import StragglerWatch
+    t = [0.0]
+    w = StragglerWatch(patience_s=1.0, clock=lambda: t[0])
+    w.update({2: "slow", 3: "slow"})
+    t[0] = 1.5
+    assert w.update({2: "slow", 3: "slow"}) == 2
+
+
+def test_daemon_straggler_gating(tmp_path):
+    """Supervisor._straggler_ready re-gates on budget/min_world/live
+    indices, so a flapping detector can never stop an incarnation the
+    policy cannot act on."""
+    from torchacc_tpu.supervisor import (
+        RestartPolicy,
+        Supervisor,
+        WorkerSpec,
+    )
+
+    class _FakeDrift:
+        def __init__(self):
+            self.flags = {}
+
+        def flagged(self):
+            return dict(self.flags)
+
+        def forget(self, h):
+            self.flags.pop(h, None)
+
+    class _FakeFleet:
+        def __init__(self):
+            self.drift = _FakeDrift()
+
+    spec = WorkerSpec(run_dir=str(tmp_path), world_size=2,
+                      argv=["true"], role="serve")
+    pol = RestartPolicy(straggler_evict=True, straggler_patience_s=0.0,
+                        straggler_evict_budget=1, min_world=1)
+    sup = Supervisor(spec, pol)
+    sup.fleet = _FakeFleet()
+    sup.fleet.drift.flags = {1: "slow"}
+    assert sup._straggler_ready() == 1            # evictable
+    sup.engine.excluded.add(1)
+    assert sup._straggler_ready() is None         # already excluded
+    sup.engine.excluded.clear()
+    sup.engine.straggler_evictions = 1
+    assert sup._straggler_ready() is None         # budget exhausted
+    sup.engine.straggler_evictions = 0
+    sup.policy.min_world = 2
+    assert sup._straggler_ready() is None         # min_world floor
+    sup.policy.min_world = 1
+    sup.engine.restarts_used = sup.policy.max_restarts
+    assert sup._straggler_ready() is None         # restart budget spent:
+    sup.engine.restarts_used = 0                  # never stop a healthy
+    assert sup._straggler_ready() == 1            # pod just to give up
+    sup.fleet.drift.flags = {7: "slow"}
+    assert sup._straggler_ready() is None         # not a live index
+
+
+def test_workerspec_role_validation(tmp_path):
+    from torchacc_tpu.supervisor import WorkerSpec
+    with pytest.raises(ValueError):
+        WorkerSpec(run_dir=str(tmp_path), world_size=1, argv=["x"],
+                   role="inference")
+    assert WorkerSpec(run_dir=str(tmp_path), world_size=1,
+                      argv=["x"], role="serve").role == "serve"
+
+
+def test_serve_progress_counts_finished_records(tmp_path):
+    from torchacc_tpu.supervisor import serve_progress
+    assert serve_progress(str(tmp_path)) == 0
+    j0 = RequestJournal(str(tmp_path / "journal_h0"))
+    j1 = RequestJournal(str(tmp_path / "journal_h1"))
+    for rid in range(3):
+        j0.accepted(rid=rid, trace_id="t", prompt_ids=[1],
+                    max_new_tokens=1, temperature=0.0, top_k=0,
+                    top_p=1.0, eos_id=None, seed=0, priority=0,
+                    deadline_unix=None)
+    j0.completed(rid=0, tokens=[3], finish_reason="length")
+    j0.shed(rid=1, reason="x")
+    j1.completed(rid=0, tokens=[4], finish_reason="eos")
+    assert serve_progress(str(tmp_path)) == 3     # 2 + 1, accepted != done
+    assert serve_progress(None) == 0
+
+
+# ---------------------------------------------------------------------------
+# serve exit disposition + liveness + chaos kill rule
+# ---------------------------------------------------------------------------
+
+def test_serve_disposition_reader_roundtrip(tiny, tmp_path):
+    model, params = tiny
+    from torchacc_tpu.supervisor import read_exit_disposition
+    jd = str(tmp_path / "j")
+    cfg = _cfg(jd, max_slots=2)
+    cfg.obs = ObsConfig(enabled=True, flight_dir=str(tmp_path))
+    since = time.time() - 1.0
+    eng = ServeEngine(model, params, cfg)
+    for p in _prompts(3, 5):
+        eng.submit(Request(prompt_ids=p, max_new_tokens=6))
+    for _ in range(3):
+        eng.step()
+    eng.begin_drain("test preemption")
+    eng.run()                             # drains + emits disposition
+    d = read_exit_disposition(str(tmp_path), since)
+    assert d is not None and d.preempted
+    assert d.reason == "preemption"
+    assert d.serve, "serve block missing from disposition"
+    assert d.serve["journal"].endswith(JOURNAL_NAME)
+    # accounting closes: completed + in-flight(none after drain) +
+    # unserved covers every submitted id
+    assert d.serve["completed"] + len(d.serve["unserved"]) == 5
+    assert d.serve["in_flight"] == []
+    # the unserved ids are exactly the journal's pending set
+    pending, _, _ = replay_state(read_journal(jd))
+    assert sorted(pending) == d.serve["unserved"]
+    eng.close()
+
+
+def test_serve_liveness_health_flips_on_hang(tiny, tmp_path):
+    model, params = tiny
+    cfg = _cfg()
+    cfg.obs = ObsConfig(enabled=True, http_port=None,
+                        health_degraded_heartbeat_s=0.1,
+                        health_unhealthy_heartbeat_s=0.2)
+    eng = ServeEngine(model, params, cfg)
+    obs = eng._obs
+    assert obs is not None
+    assert obs._h_liveness()[0] == "ok"           # not running
+    eng.submit(Request(prompt_ids=[1, 2], max_new_tokens=2))
+    eng._running = True
+    eng._t_heartbeat = time.monotonic()
+    assert obs._h_liveness()[0] == "ok"           # fresh heartbeat
+    eng._t_heartbeat = time.monotonic() - 0.15
+    assert obs._h_liveness()[0] == "degraded"
+    eng._t_heartbeat = time.monotonic() - 0.5
+    status, msg = obs._h_liveness()
+    assert status == "unhealthy" and "hung" in msg
+    eng._running = False
+    assert obs._h_liveness()[0] == "ok"           # not run()-driven
+    eng._running = True
+    eng.run()                                     # serves the request
+    assert obs._h_liveness()[0] == "ok"           # idle engine
+    eng.close()
+
+
+def test_chaos_kill_rule_sends_sigkill(monkeypatch):
+    import signal
+
+    from torchacc_tpu.resilience.chaos import ChaosPlan, failpoint
+    sent = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: sent.append(
+        (pid, sig)))
+    plan = ChaosPlan().kill("serve.decode", after=2)
+    with plan:
+        failpoint("serve.decode", iter=0)
+        failpoint("serve.decode", iter=1)
+        assert sent == []                 # clean prefix honoured
+        failpoint("serve.decode", iter=2)
+    assert sent == [(os.getpid(), signal.SIGKILL)]
+
+
+# ---------------------------------------------------------------------------
+# satellites: bf16-shadow swap invariant, NeoX converter dispatch
+# ---------------------------------------------------------------------------
+
+def test_swap_params_refreshes_shadow_atomically():
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+    from torchacc_tpu.train.amp import shadow_params
+    mc = get_preset("llama-tiny", vocab_size=VOCAB, hidden_size=32,
+                    num_layers=1, num_heads=2, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.bfloat16)
+    cfg = ta.Config(compute=ta.ComputeConfig(dtype="bfloat16",
+                                             bf16_compute_params=True))
+    trainer, _ = accelerate(mc, None, cfg, optimizer=optax.sgd(1e-2))
+    trainer.init()
+    assert trainer._shadow_consistent()
+    new_params = jax.tree.map(lambda p: p + 1.0 if jnp.issubdtype(
+        p.dtype, jnp.floating) else p, trainer.state.params)
+    # the hazard this guards against: a bare replace leaves the shadow
+    # stale — the forward would silently train the OLD weights
+    trainer.state = trainer.state.replace(params=new_params)
+    assert not trainer._shadow_consistent()
+    # the supported path restores the invariant atomically
+    trainer.swap_params(new_params, verify_shadow=True)
+    assert trainer._shadow_consistent()
+    sh = shadow_params(trainer.state.opt_state)
+    want = jax.tree.map(lambda p: p.astype(jnp.bfloat16),
+                        trainer.state.params)
+    for a, b in zip(jax.tree.leaves(sh), jax.tree.leaves(want)):
+        assert bool(jnp.all(a == b))
+
+
+def test_swap_params_keep_moments_path():
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+    mc = get_preset("llama-tiny", vocab_size=VOCAB, hidden_size=32,
+                    num_layers=1, num_heads=2, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.bfloat16)
+    cfg = ta.Config(compute=ta.ComputeConfig(dtype="bfloat16",
+                                             bf16_compute_params=True))
+    trainer, _ = accelerate(mc, None, cfg,
+                            optimizer=optax.adam(1e-3))
+    trainer.init()
+    inner_before = trainer.state.opt_state[0]
+    new_params = jax.tree.map(lambda p: p * 0.5 if jnp.issubdtype(
+        p.dtype, jnp.floating) else p, trainer.state.params)
+    trainer.swap_params(new_params, reinit_opt=False,
+                        verify_shadow=True)
+    # moments preserved, shadow re-derived
+    a0 = jax.tree.leaves(inner_before)
+    a1 = jax.tree.leaves(trainer.state.opt_state[0])
+    assert all(x is y or bool(jnp.all(x == y)) for x, y in zip(a0, a1))
+    assert trainer._shadow_consistent()
+
+
+def test_swap_params_rejects_mismatched_tree():
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.errors import TrainerStateError
+    from torchacc_tpu.train import accelerate
+    mc = get_preset("llama-tiny", vocab_size=VOCAB, hidden_size=32,
+                    num_layers=1, num_heads=2, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.float32)
+    trainer, _ = accelerate(mc, None, ta.Config(),
+                            optimizer=optax.sgd(1e-2))
+    with pytest.raises(TrainerStateError):
+        trainer.swap_params({"nope": jnp.zeros(())})  # before init
+    trainer.init()
+    with pytest.raises(TrainerStateError):
+        trainer.swap_params({"nope": jnp.zeros(())})
+    # same TREE, wrong leaf shape/dtype: must fail at swap time naming
+    # the leaf, not later as a shape error inside the jitted step
+    good = trainer.state.params
+    wrong_shape = jax.tree.map(lambda p: jnp.zeros(p.shape + (1,),
+                                                   p.dtype), good)
+    with pytest.raises(TrainerStateError, match="shapes/dtypes"):
+        trainer.swap_params(wrong_shape)
+    wrong_dtype = jax.tree.map(
+        lambda p: p.astype(jnp.bfloat16), good)
+    with pytest.raises(TrainerStateError, match="shapes/dtypes"):
+        trainer.swap_params(wrong_dtype)
+
+
+def test_journal_torn_append_sealed_before_next_record(tmp_path):
+    """A failed append that flushed partial bytes must not let the
+    NEXT append concatenate onto the torn fragment — the merged line
+    would be skipped on replay, silently losing the later record."""
+    j = RequestJournal(str(tmp_path), fsync=False)
+    j.accepted(rid=0, trace_id="t", prompt_ids=[1], max_new_tokens=1,
+               temperature=0.0, top_k=0, top_p=1.0, eos_id=None,
+               seed=0, priority=0, deadline_unix=None)
+    # simulate the failure: partial bytes on disk, no newline, and the
+    # append marked torn (what the OSError path records)
+    j._f.write(b'{"kind":"accepted","rid":9')
+    j._f.flush()
+    j._torn = True
+    j.shed(rid=1, reason="after-the-tear")
+    recs = read_journal(j.path)
+    assert [r["rid"] for r in recs] == [0, 1]     # later record intact
+    assert recs[1]["kind"] == "shed"
+    j.close()
+
+
+def test_reopened_journal_seals_predecessor_torn_tail(tmp_path):
+    """A kill -9 mid-append leaves a torn fragment; the NEXT
+    incarnation's first append must not merge into it — the merged
+    line would silently eat the newer record on the replay after
+    that."""
+    j = RequestJournal(str(tmp_path), fsync=False)
+    j.accepted(rid=0, trace_id="t", prompt_ids=[1], max_new_tokens=1,
+               temperature=0.0, top_k=0, top_p=1.0, eos_id=None,
+               seed=0, priority=0, deadline_unix=None)
+    j._f.write(b'{"kind":"completed","rid":0,"tok')   # kill -9 here
+    j._f.flush()
+    j.close()
+    j2 = RequestJournal(str(tmp_path), fsync=False)
+    assert j2._torn                                   # tail detected
+    j2.shed(rid=1, reason="next-life")
+    recs = read_journal(j2.path)
+    assert [(r["kind"], r["rid"]) for r in recs] == [("accepted", 0),
+                                                     ("shed", 1)]
+    j2.close()
+
+
+def test_run_restamps_liveness_heartbeat(tiny):
+    """run() must measure loop progress from its OWN start — a long
+    warmup between construction and run() is not a hang."""
+    model, params = tiny
+    eng = ServeEngine(model, params, _cfg())
+    eng._t_heartbeat -= 3600.0            # pretend construction was old
+    eng.run()                             # empty queue: returns at once
+    assert time.monotonic() - eng._t_heartbeat < 60.0
+
+
+def test_neox_dispatch_keys_on_layer_prefix():
+    from torchacc_tpu.models.hf import _is_neox_state_dict
+    neox = {
+        "gpt_neox.layers.0.attention.query_key_value.weight": 0,
+        "gpt_neox.embed_in.weight": 0,
+    }
+    neox_stripped = {
+        "layers.11.attention.query_key_value.weight": 0,
+        "embed_in.weight": 0,
+    }
+    falcon = {
+        # Falcon names: transformer.h.<i>.self_attention.query_key_value
+        "transformer.h.0.self_attention.query_key_value.weight": 0,
+        "transformer.word_embeddings.weight": 0,
+    }
+    assert _is_neox_state_dict(neox)
+    assert _is_neox_state_dict(neox_stripped)
+    # the regression: a Falcon-style checkpoint must NOT take the NeoX
+    # materialising path (the old endswith() predicate matched it)
+    assert not _is_neox_state_dict(falcon)
+    assert not _is_neox_state_dict(
+        {"layers.0.self_attention.query_key_value.weight": 0})

@@ -487,6 +487,28 @@ class ServeConfig:
     # queued-but-unserved request ids for resubmission elsewhere.
     # Off: run() ignores preemption entirely (pre-PR-13 behaviour).
     drain_on_preempt: bool = True
+    # durable request journal (serve/journal.py, docs/serving.md
+    # "Serving under the supervisor"): every accepted request and every
+    # completed/shed result appends one strict-JSON line to
+    # <journal_dir>/journal.jsonl, and ServeEngine.recover() re-admits
+    # the journaled-but-unfinished requests after a restart — a kill -9
+    # mid-decode costs latency, never requests (greedy replays are
+    # token-identical by construction).  None (the default) = no
+    # journal, no replay, serve path byte-identical to pre-journal
+    # behaviour.
+    journal_dir: Optional[str] = None
+    # fsync every journal append (the durable contract: an id submit()
+    # returned HAS an accepted record on disk).  False keeps the flush
+    # (survives a process kill, not host power loss) when per-request
+    # fsync cost matters.
+    journal_fsync: bool = True
+    # deadline shedding (docs/serving.md "Deadline shedding"): a queued
+    # request whose deadline has already passed — provably unmeetable,
+    # it still needs >= 1 decode step — gets a typed 'shed' result
+    # (counted, journaled) instead of being silently served late.
+    # Off (default): pre-PR-15 behaviour, late requests serve anyway
+    # and count as deadline misses.
+    shed_deadlines: bool = False
 
     def validate(self) -> None:
         _check(self.block_size >= 1, "serve.block_size must be >= 1")

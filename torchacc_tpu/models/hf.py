@@ -25,6 +25,7 @@ attention_bias/mlp_bias variants.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, Mapping, Optional, Tuple
 
 import jax.numpy as jnp
@@ -493,6 +494,24 @@ def _params_from_neox(state_dict, cfg: ModelConfig, dtype):
     return jax.tree.map(lambda a: jnp.asarray(a, dtype), params)
 
 
+#: GPT-NeoX attention tensors live at ``layers.<i>.attention.
+#: query_key_value.weight`` (optionally under a ``gpt_neox.`` prefix).
+#: Anchoring on the ``layers.<i>.`` prefix matters: Falcon-style
+#: checkpoints name theirs ``h.<i>.self_attention.query_key_value.
+#: weight``, which a bare ``endswith("attention.query_key_value.
+#: weight")`` also matches — dispatching those through the NeoX layout
+#: would silently mis-convert (wrong transpose + fused-qkv split).
+_NEOX_QKV_RE = re.compile(
+    r"(?:^|\.)layers\.\d+\.attention\.query_key_value\.weight$")
+
+
+def _is_neox_state_dict(state_dict: Mapping[str, Any]) -> bool:
+    """True only for the GPT-NeoX tensor layout (see ``_NEOX_QKV_RE``);
+    Falcon-style ``self_attention.query_key_value`` keys do NOT
+    qualify."""
+    return any(_NEOX_QKV_RE.search(k) for k in state_dict)
+
+
 def params_from_hf_state_dict(
     state_dict: Mapping[str, Any],
     cfg: ModelConfig,
@@ -512,8 +531,7 @@ def params_from_hf_state_dict(
     # unsupported and will fail on their attention tensors loudly)
     if any(k.endswith("attn.c_attn.weight") for k in state_dict):
         return _params_from_gpt2(state_dict, cfg, dtype)
-    if any(k.endswith("attention.query_key_value.weight")
-           for k in state_dict):
+    if _is_neox_state_dict(state_dict):
         return _params_from_neox(state_dict, cfg, dtype)
     L = cfg.num_layers
     h = cfg.hidden_size

@@ -28,12 +28,18 @@ machine answer.  This module is the supervisor-side close:
   and goodput ledger — whatever the daemon's ``context`` callable
   contributes).
 - :class:`DriftDetector` is the straggler sensor: a rolling per-host
-  baseline over the ``step_time_ms`` histogram deltas each scrape
-  window; a host whose window mean exceeds ``factor`` x the median of
-  its peers' baselines for ``patience`` consecutive windows flips the
-  daemon's ``/healthz`` to **degraded naming the slow host** — the
-  sensing half of a future straggler-eviction policy (the supervisor
-  does NOT act on it yet; docs/observability.md "Fleet view").
+  baseline over the drift histogram's deltas each scrape window
+  (``step_time_ms`` for training pods; serve fleets pass
+  ``drift_hist='serve_token_gap_ms'`` — serve workers are independent,
+  so per-host gaps genuinely differ where a lockstep pod equalises); a
+  host whose window mean exceeds ``factor`` x the median of its peers'
+  baselines for ``patience`` consecutive windows flips the daemon's
+  ``/healthz`` to **degraded naming the slow host**.  The decide half
+  is the supervisor's opt-in straggler-eviction rule
+  (``RestartPolicy.straggler_evict``, docs/resilience.md
+  "Supervisor"): sustained verdicts past a patience window evict the
+  host through the elastic-shrink path; without the opt-in, degraded
+  never kills.
 
 Stdlib-only (urllib + threading), no jax anywhere: like the rest of
 the supervisor stack this must run on a host that never initialised a
@@ -290,11 +296,17 @@ class FleetAggregator:
     def __init__(self, *, poll_interval_s: float = 2.0,
                  timeout_s: float = 2.0,
                  drift: Optional[DriftDetector] = None,
+                 drift_hist: str = _STEP_HIST,
                  context: Optional[Callable[[], Dict[str, Any]]] = None,
                  fetch: Optional[Callable[[str, float], str]] = None):
         self.poll_interval_s = float(poll_interval_s)
         self.timeout_s = float(timeout_s)
         self.drift = drift
+        # the histogram the drift detector baselines on: step_time_ms
+        # for training pods; serve fleets use serve_token_gap_ms (each
+        # serve worker is independent, so its own gap series names it —
+        # a lockstep training pod's per-host wall clock equalises)
+        self.drift_hist = str(drift_hist)
         self._context = context
         self._fetch = fetch if fetch is not None else self._http_fetch
         self._lock = threading.Lock()
@@ -382,8 +394,8 @@ class FleetAggregator:
         means: Dict[int, float] = {}
         with self._lock:
             for host in set(self._cur) | set(self._base_hists):
-                count, total = self._host_hist_stats_locked(host,
-                                                           _STEP_HIST)
+                count, total = self._host_hist_stats_locked(
+                    host, self.drift_hist)
                 pc, ps = self._prev_step_stats.get(host, (0, 0.0))
                 dc, ds = count - pc, total - ps
                 if dc > 0:
@@ -522,7 +534,8 @@ class FleetAggregator:
             out_hosts: Dict[str, Any] = {}
             for h in known:
                 st = hosts.get(h)
-                count, total = self._host_hist_stats_locked(h, _STEP_HIST)
+                count, total = self._host_hist_stats_locked(
+                    h, self.drift_hist)
                 entry: Dict[str, Any] = {
                     "step_time_count": count,
                     "step_time_mean_ms": (total / count) if count else None,
@@ -551,6 +564,12 @@ class FleetAggregator:
             "time": time.time(),
             "incarnation": self.incarnation,
             "scrapes": self._scrapes,
+            # what the per-host step_time_* fields (and the drift
+            # verdict) are computed FROM: step_time_ms on training
+            # pods, serve_token_gap_ms on serve fleets — a consumer
+            # comparing across fleets must check this before treating
+            # the numbers as step times
+            "drift_hist": self.drift_hist,
             "hosts": out_hosts,
             "counters": counters,
             "histograms": {n: h.snapshot() for n, h in hists.items()},

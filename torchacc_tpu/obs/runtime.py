@@ -298,13 +298,26 @@ class ServeObs:
 
     def __init__(self, engine, obs_cfg):
         self.cfg = obs_cfg
+        self.engine = engine
         apply_config(obs_cfg)
         sched = engine.scheduler
         self._gauges: dict = {}
+        self._checks: dict = {}
 
         def gauge(name, fn, help=""):
             self._gauges[name] = fn
             server.register_gauge(name, fn, help=help)
+
+        def check(name, fn):
+            self._checks[name] = fn
+            server.register_health(name, fn)
+
+        # decode-loop liveness (the serve /healthz the supervisor
+        # probes): a run() loop with work that has not completed an
+        # iteration within the heartbeat thresholds is hung — a wedged
+        # device blocks inside engine.step(), so the age grows while
+        # the HTTP thread keeps answering
+        check("serve_liveness", self._h_liveness)
 
         gauge("serve_queue_depth", lambda: len(engine._queue),
               help="requests waiting for admission")
@@ -321,6 +334,29 @@ class ServeObs:
         gauge("kv_pool_blocks_in_use", lambda: sched.pool.in_use,
               help="KV blocks held by live sequences")
 
+    def _h_liveness(self):
+        """Hung-decode detector: only judges a LIVE ``run()`` loop with
+        work pending (an idle engine, or one driven manually between
+        phases, is ok — absence of iterations is not a hang there)."""
+        import time as _time
+        e = self.engine
+        if not getattr(e, "_running", False):
+            return "ok", None
+        has_work = bool(e._queue) or e.scheduler.busy()
+        if not has_work:
+            return "ok", None
+        age = _time.monotonic() - e._t_heartbeat
+        if age > self.cfg.health_unhealthy_heartbeat_s:
+            return "unhealthy", (
+                f"no serve-loop iteration for {age:.1f}s with work "
+                f"pending (> {self.cfg.health_unhealthy_heartbeat_s:.1f}s"
+                f" — decode loop hung?)")
+        if age > self.cfg.health_degraded_heartbeat_s:
+            return "degraded", (
+                f"no serve-loop iteration for {age:.1f}s with work "
+                f"pending (> {self.cfg.health_degraded_heartbeat_s:.1f}s)")
+        return "ok", None
+
     def on_request_done(self, seq) -> None:
         """Feed the latency histograms from a completed scheduler
         ``Sequence`` (called from the engine's completion drain)."""
@@ -332,3 +368,5 @@ class ServeObs:
     def close(self) -> None:
         for name, fn in self._gauges.items():
             server.unregister_gauge(name, fn)
+        for name, fn in self._checks.items():
+            server.unregister_health(name, fn)

@@ -69,6 +69,7 @@ class _Rule:
     rate: float = 0.0              # ... plus with this seeded probability
     exc: Callable[[str], BaseException] = OSError
     sleep_s: float = 0.0           # > 0: hang (sleep) instead of raising
+    kill: bool = False             # SIGKILL the process instead of raising
     after: int = 0                 # skip this many hits before injecting
     raised: int = 0
     hits: int = 0
@@ -118,6 +119,17 @@ class ChaosPlan:
                                    after=after)
         return self
 
+    def kill(self, point: str, *, after: int = 0) -> "ChaosPlan":
+        """Make hit ``after + 1`` of ``point`` SIGKILL the process —
+        a REAL ``kill -9``: no exception, no drain, no atexit, no
+        flight bundle.  The crash-mid-anything failpoint the serve
+        chaos gate uses (``serve.decode``: a supervised serving worker
+        dies mid-decode and the journal replay must make it whole).
+        The injected signal is deterministic (hit-count gated), so the
+        same plan kills at the same decode iteration every run."""
+        self._rules[point] = _Rule(times=1, kill=True, after=after)
+        return self
+
     def hit(self, point: str, ctx: Dict[str, Any]) -> None:
         rule = self._rules.get(point)
         if rule is None:
@@ -129,6 +141,14 @@ class ChaosPlan:
                   or (rule.rate > 0.0 and self._rng.random() < rule.rate))
         if inject:
             rule.raised += 1
+            if rule.kill:
+                import os
+                import signal as _signal
+                logger.warning(
+                    f"chaos: SIGKILL self at {point} ({ctx or {}}) — "
+                    f"simulated hard crash, no cleanup will run")
+                os.kill(os.getpid(), _signal.SIGKILL)
+                return                     # unreachable outside tests
             if rule.sleep_s > 0.0:
                 logger.warning(
                     f"chaos: injecting {rule.sleep_s:.1f}s hang "

@@ -27,6 +27,11 @@ handoff" section for the fit↔serve loop).
 """
 
 from torchacc_tpu.serve.engine import Request, RequestResult, ServeEngine
+from torchacc_tpu.serve.journal import (
+    RequestJournal,
+    read_journal,
+    replay_state,
+)
 from torchacc_tpu.serve.kv_cache import (
     BlockPool,
     PrefixIndex,
@@ -40,9 +45,12 @@ __all__ = [
     "PagedDecoder",
     "PrefixIndex",
     "Request",
+    "RequestJournal",
     "RequestResult",
     "Scheduler",
     "ServeEngine",
     "blocks_needed",
     "make_pools",
+    "read_journal",
+    "replay_state",
 ]

@@ -44,6 +44,7 @@ from typing import Any, Dict, List, Optional, Sequence as Seq
 import numpy as np
 
 from torchacc_tpu.config import Config
+from torchacc_tpu.serve.journal import RequestJournal, read_journal, replay_state
 from torchacc_tpu.serve.scheduler import Scheduler, Sequence, priority_key
 from torchacc_tpu.utils.logger import logger
 from torchacc_tpu.utils.metrics import BlockedMeter, counters, open_metrics
@@ -171,6 +172,46 @@ class ServeEngine:
         self._drain_reported = False
         self._metrics = open_metrics(metrics_dir)
         self._completed = 0
+        # durable request journal + replay (serve/journal.py,
+        # docs/serving.md "Serving under the supervisor"): None = off,
+        # serve path byte-identical to the journal-free engine
+        self._journal = (RequestJournal(config.serve.journal_dir,
+                                        fsync=config.serve.journal_fsync)
+                         if config.serve.journal_dir else None)
+        self._journal_fold = None
+        if self._journal is not None:
+            # one read at construction serves both consumers: the id
+            # reservation here (a submit() BEFORE recover() must never
+            # reuse a journaled id — a collision would poison the
+            # replay dedupe: a new request's 'completed' record would
+            # mark the old one done) and recover()'s replay fold,
+            # which consumes and releases it.  Records this engine
+            # appends after construction never matter to either — its
+            # own requests live in self._all and recover() skips them.
+            pending, completed, shed = replay_state(
+                read_journal(self._journal.path))
+            # keep only what recover() needs: the pending records
+            # (bounded by outstanding work, not history) and the
+            # terminal ID sets — never the terminal bodies (full token
+            # payloads) for the lifetime of an engine that may never
+            # call recover()
+            self._journal_fold = (pending, set(completed), set(shed))
+            known = [rid for part in self._journal_fold for rid in part]
+            if known:
+                self._next_id = max(known) + 1
+        self._recovered: Optional[Dict[str, List[int]]] = None
+        # recovery progress across recover() RETRIES (a mid-loop
+        # journal error leaves the attempt partial): ids the replay
+        # loop already enqueued / already shed, so the attempt that
+        # finally succeeds reports the full recovery, not its own slice
+        self._replay_enqueued: set = set()
+        self._replay_shed: set = set()
+        self._shed_ids: List[int] = []
+        # liveness heartbeat for the /healthz serve check: stamped at
+        # the end of every engine iteration; _running marks a live
+        # run() loop (a paused caller between phases is not a hang)
+        self._t_heartbeat = time.monotonic()
+        self._running = False
         self._agg = self._fresh_agg()
         self._evict_base = 0                 # pool.evictions at window start
         # telemetry session (docs/observability.md): queue/KV-pool
@@ -270,7 +311,52 @@ class ServeEngine:
         streaming callback, invoked as the lagged ring resolves each
         token (<= ``decode_depth - 1`` iterations after dispatch; never
         a post-finish garbage token).  Runs inside the engine loop —
-        keep it cheap, hand off to a queue/socket for real delivery."""
+        keep it cheap, hand off to a queue/socket for real delivery.
+
+        With ``serve.journal_dir`` set, the accepted request is
+        journaled (durably, before this returns) so a process death
+        never loses it: a restarted engine's :meth:`recover` re-admits
+        it under the same id."""
+        serve = self.config.serve
+        seq = self._build_seq(req, self._next_id, on_token)
+        if len(self._queue) >= serve.max_queue:
+            raise RuntimeError(
+                f"admission queue full ({serve.max_queue}); shed load "
+                f"upstream or raise serve.max_queue")
+        seq.t_submit = time.monotonic()
+        if req.deadline_s is not None:
+            seq.deadline = seq.t_submit + req.deadline_s
+        # the id is BURNED from here on, even if the journal append
+        # fails: a raise from fsync does not prove the line missed the
+        # disk, and reusing the id for a different request would let
+        # the phantom 'accepted' record hijack it on replay
+        # (replay_state keeps the FIRST accepted record per id)
+        self._next_id += 1
+        if self._journal is not None:
+            # journal BEFORE the engine takes the request: a failed
+            # append (disk full) raises with nothing enqueued — the
+            # engine never serves a request that has no accepted
+            # record, and the caller's retry cannot double-serve.
+            # seq.max_new is _build_seq's resolution — the journal
+            # must record what will actually be SERVED, or a replay
+            # diverges from the original run
+            self._journal.accepted(
+                rid=seq.sid, trace_id=seq.trace_id,
+                prompt_ids=req.prompt_ids, max_new_tokens=seq.max_new,
+                temperature=req.temperature, top_k=req.top_k,
+                top_p=req.top_p, eos_id=req.eos_id, seed=req.seed,
+                priority=req.priority,
+                deadline_unix=(None if req.deadline_s is None
+                               else time.time() + req.deadline_s))
+        self._all[seq.sid] = seq
+        self._queue.append(seq)
+        counters.inc("serve_requests_submitted")
+        return seq.sid
+
+    def _build_seq(self, req: Request, rid: int, on_token) -> Sequence:
+        """Validate a request and build its scheduler ``Sequence``
+        (shared by :meth:`submit` and journal replay — one home for the
+        front-door rules)."""
         prompt = np.asarray(list(req.prompt_ids), np.int32)
         if prompt.ndim != 1 or prompt.shape[0] < 1:
             raise ValueError("prompt_ids must be a non-empty 1-D sequence")
@@ -285,12 +371,11 @@ class ServeEngine:
             raise ValueError(
                 f"deadline_s must be > 0 seconds from submit, got "
                 f"{req.deadline_s}")
-        serve = self.config.serve
         # trace id: pid x process-global sequence — unique across
         # processes AND across co-located engines in one process
         trace_id = (req.trace_id if req.trace_id
                     else f"{os.getpid():x}-{next(_trace_seq):x}")
-        seq = Sequence(sid=self._next_id, prompt=prompt, max_new=max_new,
+        seq = Sequence(sid=rid, prompt=prompt, max_new=max_new,
                        temperature=req.temperature, top_k=req.top_k,
                        top_p=req.top_p, eos_id=req.eos_id, seed=req.seed,
                        priority=req.priority, on_token=on_token,
@@ -309,18 +394,173 @@ class ServeEngine:
             raise ValueError(
                 f"prompt + max_new_tokens = {total} exceeds the learned "
                 f"position table max_seq_len {self.cfg.max_seq_len}")
-        if len(self._queue) >= serve.max_queue:
-            raise RuntimeError(
-                f"admission queue full ({serve.max_queue}); shed load "
-                f"upstream or raise serve.max_queue")
-        seq.t_submit = time.monotonic()
-        if req.deadline_s is not None:
-            seq.deadline = seq.t_submit + req.deadline_s
-        self._next_id += 1
-        self._all[seq.sid] = seq
-        self._queue.append(seq)
-        counters.inc("serve_requests_submitted")
-        return seq.sid
+        return seq
+
+    # -- journal replay ------------------------------------------------------
+
+    def recover(self) -> Dict[str, List[int]]:
+        """Re-admit every journaled-but-unfinished request after a
+        restart (docs/serving.md "Serving under the supervisor").
+
+        Idempotent: completed/shed ids are deduped (never served
+        twice), replayed requests keep their ORIGINAL ids (the id a
+        dead incarnation returned to its caller stays valid), and a
+        second call is a no-op.  Greedy replays are token-identical by
+        construction (same prompt, params, seed); the prefix cache —
+        if enabled — re-warms as the replays prefill.  A pending
+        request whose ABSOLUTE deadline passed while the process was
+        down is shed with a typed result when ``serve.shed_deadlines``
+        is on (otherwise it replays and counts as a deadline miss,
+        exactly as if it had been served late in one life).
+
+        Returns ``{"replayed": [...], "completed": [...],
+        "shed": [...], "shed_on_recovery": [...]}`` (ids).  No journal
+        configured -> all empty."""
+        if self._journal is None:
+            return {"replayed": [], "completed": [], "shed": [],
+                    "shed_on_recovery": []}
+        if self._recovered is not None:
+            return self._recovered
+        pending, completed, shed = self._journal_fold
+        replayed: List[int] = []
+        shed_now: List[int] = []
+        now_wall = time.time()
+        now_mono = time.monotonic()
+        for rid in sorted(pending):
+            if rid in self._all:
+                # already live: either a PREVIOUS recover() attempt
+                # enqueued/shed it before raising (report it — the
+                # successful attempt must describe the whole recovery)
+                # or this engine accepted it itself (submit() raced
+                # ahead of recover(); not a replay)
+                if rid in self._replay_enqueued:
+                    replayed.append(rid)
+                elif rid in self._replay_shed:
+                    shed_now.append(rid)
+                continue
+            rec = pending[rid]
+            req = Request(
+                prompt_ids=rec["prompt_ids"],
+                max_new_tokens=rec.get("max_new_tokens"),
+                temperature=rec.get("temperature", 0.0),
+                top_k=rec.get("top_k", 0), top_p=rec.get("top_p", 1.0),
+                eos_id=rec.get("eos_id"), seed=rec.get("seed", 0),
+                priority=rec.get("priority", 0),
+                trace_id=rec.get("trace_id") or None)
+            try:
+                seq = self._build_seq(req, rid, None)
+            except (ValueError, RuntimeError) as e:
+                # a journaled request this engine can no longer serve
+                # (shrunken pool, changed model) is accounted, loudly —
+                # never silently dropped.  A stub finished Sequence
+                # keeps the result() contract: the caller holding the
+                # original id gets the same typed shed result a
+                # deadline shed produces, not a KeyError.
+                stub = Sequence(
+                    sid=rid,
+                    prompt=np.asarray(rec.get("prompt_ids") or [],
+                                      np.int32),
+                    max_new=int(rec.get("max_new_tokens") or 0),
+                    trace_id=rec.get("trace_id") or "")
+                stub.t_submit = stub.t_admit = now_mono
+                stub.t_first_token = now_mono
+                # shed (journal-first) BEFORE registering the stub: a
+                # failed append leaves no half-shed record for a
+                # recover() retry to skip over
+                self._shed(stub, f"unservable-after-restart: {e}")
+                self._all[rid] = stub
+                self._replay_shed.add(rid)
+                shed_now.append(rid)
+                continue
+            # re-anchor the wall-clock deadline onto this process's
+            # monotonic clock; queue-wait/TTFT metrics restart at
+            # recovery (the dead incarnation's wall time is not
+            # observable here — the journal's t_accept is, for audits)
+            seq.t_submit = now_mono
+            dl = rec.get("deadline_unix")
+            if dl is not None:
+                seq.deadline = now_mono + (float(dl) - now_wall)
+            self._all[seq.sid] = seq
+            self._queue.append(seq)
+            self._replay_enqueued.add(rid)
+            replayed.append(rid)
+        if replayed or shed_now:
+            logger.warning(
+                f"request journal replay: {len(replayed)} request(s) "
+                f"re-admitted ({len(completed)} already completed, "
+                f"{len(shed)} already shed, {len(shed_now)} shed on "
+                f"recovery) from {self._journal.path}")
+        # expired deadlines among the replays shed immediately (typed,
+        # journaled) instead of waiting for the first step()'s sweep —
+        # and they report under shed_on_recovery, not replayed: a
+        # consumer resubmitting/accounting off this dict must see them
+        # as dropped, not as about-to-be-served
+        self._shed_expired()
+        still_live = []
+        for rid in replayed:
+            if self._all[rid].finish_reason == "shed":
+                shed_now.append(rid)
+            else:
+                still_live.append(rid)
+        # counted AFTER the expiry sweep so the counter always agrees
+        # with the returned "replayed" list (an expired replay is a
+        # shed, not a replay)
+        counters.inc("serve_requests_replayed", len(still_live))
+        self._recovered = {
+            "replayed": still_live, "completed": sorted(completed),
+            "shed": sorted(shed), "shed_on_recovery": sorted(shed_now),
+        }
+        # released only on success: a recover() that raised mid-loop
+        # (journal disk error while shedding) must stay retryable —
+        # the already-enqueued prefix is skipped via the self._all
+        # guard above, the remainder replays on the retry
+        self._journal_fold = None
+        return self._recovered
+
+    # -- deadline shedding ---------------------------------------------------
+
+    def _shed_record(self, rid: int, reason: str) -> None:
+        """Journal + count one shed (no Sequence state to finish).
+        Journal-first, like submit(): a failed append (disk full)
+        raises with NOTHING recorded, so the shed stays retryable and
+        the engine never accounts a shed the journal does not have."""
+        if self._journal is not None:
+            self._journal.shed(rid=rid, reason=reason)
+        self._shed_ids.append(rid)
+        counters.inc("serve_requests_shed")
+
+    def _shed(self, seq: Sequence, reason: str) -> None:
+        """Typed shed result for a QUEUED sequence: finished with
+        ``finish_reason='shed'``, zero tokens, deadline_met False —
+        counted and journaled, never a silent timeout.  The journal
+        append comes FIRST (via _shed_record): if it raises, the
+        sequence is untouched and the shed retries cleanly."""
+        self._shed_record(seq.sid, reason)
+        seq.finished = True
+        seq.finish_reason = "shed"
+        seq.t_finish = time.monotonic()
+        self._agg["shed"] = self._agg.get("shed", 0) + 1
+        logger.warning(f"serve: shed request {seq.sid} ({reason})")
+
+    def _shed_expired(self) -> None:
+        """Shed every queued request whose deadline has provably
+        passed (``serve.shed_deadlines``): it still needs >= 1 decode
+        step, so no schedule can meet it — the one case shedding never
+        second-guesses a recovery.  In-flight sequences are never shed
+        (the whole-reservation guarantee: an admitted request always
+        finishes)."""
+        if not self.config.serve.shed_deadlines or not self._queue:
+            return
+        now = time.monotonic()
+        expired = [s for s in self._queue
+                   if s.deadline != float("inf") and now >= s.deadline]
+        for seq in expired:
+            # shed first (journal-first append may raise), THEN drop
+            # from the queue — a failed append must never leave a
+            # request neither queued nor shed
+            self._shed(seq, "deadline-unmeetable"
+                            + (" (drain)" if self._draining else ""))
+            self._queue.remove(seq)
 
     # -- the loop -----------------------------------------------------------
 
@@ -378,6 +618,7 @@ class ServeEngine:
     def step(self) -> bool:
         """One engine iteration (admission + scheduler.step + completion
         accounting).  Returns True while there is work anywhere."""
+        self._shed_expired()
         with self._mesh_ctx():
             # admission inside the mesh context too: a fully-cached
             # prompt's admit dispatches the copy-on-write program over
@@ -385,6 +626,10 @@ class ServeEngine:
             self._admit()
             self.scheduler.step()
         self._drain_events()
+        # liveness heartbeat (the serve /healthz check): every completed
+        # iteration proves the loop is alive; a decode wedged on device
+        # blocks INSIDE this method, so the age grows while it hangs
+        self._t_heartbeat = time.monotonic()
         # scheduler.busy() == False already implies the ring drained
         # (an empty slot table with entries in flight is impossible:
         # eviction only happens at resolution), so nothing to flush.
@@ -405,6 +650,23 @@ class ServeEngine:
                 install_preemption_handler,
             )
             install_preemption_handler()
+        # re-stamp the heartbeat as the loop STARTS: the liveness age
+        # must measure loop progress, not the gap since construction
+        # (a long warmup/recover() before run() is not a hang)
+        self._t_heartbeat = time.monotonic()
+        self._running = True
+        try:
+            self._run_loop(max_iters, watch_preempt)
+        except Exception as e:
+            # serve-flavored postmortem through the flight-bundle
+            # channel (the supervisor's exit-disposition reader): the
+            # bundle rides the abort, never replaces it
+            self._emit_disposition(type(e).__name__, err=e)
+            raise
+        finally:
+            self._running = False
+
+    def _run_loop(self, max_iters: int, watch_preempt: bool) -> None:
         idle = 0
         for _ in range(max_iters):
             if watch_preempt and not self._draining:
@@ -416,6 +678,7 @@ class ServeEngine:
             if not self.step():
                 if self._draining:
                     self._log_drain_report()
+                    self._emit_disposition("preemption")
                 return
             # defensive no-progress detection: queued work that can
             # never admit while nothing is running is a config error
@@ -472,7 +735,52 @@ class ServeEngine:
             "in_flight": sorted(
                 s.sid for s in self.scheduler.slot_seq if s is not None),
             "unserved": self.unserved_ids(),
+            "shed": list(self._shed_ids),
+            "journal": (self._journal.path if self._journal is not None
+                        else None),
         }
+
+    def _emit_disposition(self, reason: str,
+                          err: Optional[BaseException] = None
+                          ) -> Optional[str]:
+        """Write the serve-flavored ``exit_disposition`` flight bundle
+        the supervisor's reader consumes (supervisor/policy.py): what
+        finished, what is still in flight, what was never admitted,
+        what was shed, and where the journal lives — the serving
+        equivalent of the trainer's resumable-tiers block.  No-op
+        unless the flight recorder is armed and a dump dir is known
+        (``obs.flight_dir``, else the journal dir)."""
+        obs = getattr(self.config, "obs", None)
+        if obs is None or not obs.enabled or not obs.flight_recorder:
+            return None
+        d = obs.flight_dir or (self._journal.dir
+                               if self._journal is not None else None)
+        if not d:
+            return None
+        from torchacc_tpu.obs import flight
+        from torchacc_tpu.resilience.coordination import (
+            process_count,
+            process_index,
+        )
+        report = self.drain_report()
+        disposition = {
+            "reason": reason,
+            "error_type": type(err).__name__ if err is not None else None,
+            "flagged_step": None,
+            "hosts": [],
+            "resumable": {},
+            "quarantine": {},
+            "quarantine_delta": [],
+            "preempted": reason == "preemption",
+            "process_index": process_index(),
+            "world_size": process_count(),
+            "serve": report,
+        }
+        return flight.recorder.dump(
+            reason, error=err, dump_dir=d,
+            filename=f"flight_serve_{os.getpid()}.json",
+            extra={"serve": report},
+            disposition=disposition)
 
     def _log_drain_report(self) -> None:
         if self._drain_reported:
@@ -544,6 +852,12 @@ class ServeEngine:
             self._completed += 1
             counters.inc("serve_requests_completed")
             counters.inc("serve_tokens_generated", len(seq.out_tokens))
+            if self._journal is not None:
+                # the completion record is the replay dedupe key: once
+                # it is durable, no restart ever serves this id again
+                self._journal.completed(rid=seq.sid,
+                                        tokens=seq.out_tokens,
+                                        finish_reason=seq.finish_reason)
             # SLO aggregates accumulate HERE, at completion — stats()
             # stays correct for long-running servers that pop/discard
             # results to bound memory (the aggregate sample lists grow
@@ -629,7 +943,10 @@ class ServeEngine:
         long-running-server hygiene) never shrinks the aggregates."""
         a = self._agg
         if not a["requests"]:
-            return {"requests": 0}
+            # a shed-only window (deadline storm, recovery sweep) is
+            # exactly what shedding exists to make visible — never
+            # collapse it to "nothing happened"
+            return {"requests": 0, "shed": a.get("shed", 0)}
         pool = self.scheduler.pool
         return {
             "requests": a["requests"],
@@ -660,6 +977,10 @@ class ServeEngine:
             # deadline_s; misses finished after their deadline)
             "deadline_requests": a["deadline_total"],
             "deadline_misses": a["deadline_miss"],
+            # deadline shedding (serve.shed_deadlines): queued requests
+            # dropped with a typed result because their deadline had
+            # provably passed (this stats window)
+            "shed": a.get("shed", 0),
         }
 
     def reset_stats(self) -> None:
@@ -678,6 +999,8 @@ class ServeEngine:
             self._obs = None
         if self._metrics is not None:
             self._metrics.close()
+        if self._journal is not None:
+            self._journal.close()
         if self._queue:
             logger.warning(
                 f"ServeEngine closed with {len(self._queue)} queued "

@@ -1,0 +1,232 @@
+"""Durable request journal: serve-side state capture + replay.
+
+The training path survives a ``kill -9`` because every step is either
+durably checkpointed or re-derivable; the serving path (pre PR 15)
+lost every queued and in-flight request when the process died — the
+graceful drain (PR 13) only covers the SIGTERM half.  Systems serving
+on preemptible capacity (SpotServe, ASPLOS'24) show that request-level
+state capture + replay is what turns a dead serving process from
+dropped traffic into bounded extra latency.  This module is that
+capture:
+
+- :class:`RequestJournal` appends one strict-JSON line per event to
+  ``<journal_dir>/journal.jsonl``: ``accepted`` when ``submit()``
+  validates a request (id, trace id, prompt hash + token ids, sampling
+  params, priority, the ABSOLUTE wall-clock deadline, arrival time),
+  ``completed`` when the engine resolves its last token (tokens +
+  finish reason), ``shed`` when deadline shedding drops it.  Appends
+  are flushed (and fsync'd by default) before ``submit()`` returns /
+  the completion is visible, so the journal is never BEHIND what a
+  caller was told.
+- :func:`read_journal` reads the file back tolerantly: the one torn
+  line a mid-write ``kill -9`` can leave is at the tail (single
+  appender), and it is skipped, never fatal.
+- :func:`replay_state` folds the records into "what must restart do":
+  every accepted-but-not-finished request, the completed ids (the
+  dedupe set — a replayed engine must never serve them twice), and the
+  shed ids.
+
+``ServeEngine.recover()`` (serve/engine.py) consumes ``replay_state``
+to re-admit the unfinished requests idempotently under their ORIGINAL
+ids: greedy decodes are token-identical on replay by construction
+(same prompt, params, seed), the prefix cache re-warms the re-prefill,
+and a request whose wall-clock deadline passed while the process was
+dead is shed with a typed result instead of silently served late.
+
+Stdlib-only (json/os/hashlib) — but note the serve package __init__
+pulls jax, so the jax-free supervisor does NOT import this module: it
+duplicates the minimal read-and-count (``supervisor/worker.py
+serve_progress``, by design, with the filename/kind literals inlined),
+and the chaos gate carries its own reader.  A journal format change
+must touch all three.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from torchacc_tpu.utils.logger import logger
+
+#: the journal file inside ``serve.journal_dir`` (one engine = one
+#: journal; co-located engines need distinct dirs)
+JOURNAL_NAME = "journal.jsonl"
+
+#: record kinds a journal line may carry
+KINDS = ("accepted", "completed", "shed")
+
+
+def prompt_digest(prompt_ids) -> str:
+    """Stable content hash of a prompt's token ids (journal +
+    replay-audit key; independent of python int types)."""
+    h = hashlib.sha256()
+    for t in prompt_ids:
+        h.update(int(t).to_bytes(4, "little", signed=True))
+    return h.hexdigest()[:16]
+
+
+class RequestJournal:
+    """Append-only strict-JSON event log for one serving engine.
+
+    ``fsync=True`` (the default) makes every append durable before it
+    returns — the property the replay contract rests on: an id the
+    caller was given has an ``accepted`` record; tokens a caller could
+    have read have a ``completed`` record.  ``fsync=False`` keeps the
+    flush (OS-buffered: survives a process kill, not a host power
+    loss) for deployments where the per-request fsync dominates.
+    """
+
+    def __init__(self, journal_dir: str, *, fsync: bool = True):
+        self.dir = journal_dir
+        self.path = os.path.join(journal_dir, JOURNAL_NAME)
+        self.fsync = bool(fsync)
+        os.makedirs(journal_dir, exist_ok=True)
+        self._f = open(self.path, "ab")
+        # a failed append (this process) or a kill -9 mid-append (a
+        # previous incarnation) may have left PARTIAL bytes with no
+        # trailing newline; the next successful append must not
+        # concatenate onto that torn fragment (the merged line would be
+        # skipped on replay, silently losing the LATER record).  When
+        # torn, the next append writes a newline guard first — a blank
+        # line the reader already tolerates.
+        self._torn = self._tail_unterminated()
+
+    def _tail_unterminated(self) -> bool:
+        """True when the existing file ends mid-line (no trailing
+        newline) — the signature of a predecessor's torn append."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return False
+                f.seek(-1, os.SEEK_END)
+                return f.read(1) != b"\n"
+        except OSError:
+            return False
+
+    # -- writing -------------------------------------------------------------
+
+    def append(self, record: Dict[str, Any]) -> None:
+        """One strict-JSON line, flushed (+fsync'd) before returning."""
+        if record.get("kind") not in KINDS:
+            raise ValueError(f"journal record kind must be one of "
+                             f"{KINDS}, got {record.get('kind')!r}")
+        line = json.dumps(record, allow_nan=False,
+                          separators=(",", ":")) + "\n"
+        try:
+            if self._torn:
+                self._f.write(b"\n")     # seal the torn fragment
+                self._f.flush()
+                self._torn = False
+            self._f.write(line.encode())
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+        except OSError:
+            self._torn = True
+            raise
+
+    def accepted(self, *, rid: int, trace_id: str, prompt_ids,
+                 max_new_tokens: int, temperature: float, top_k: int,
+                 top_p: float, eos_id: Optional[int], seed: int,
+                 priority: int,
+                 deadline_unix: Optional[float]) -> None:
+        """The admission record.  ``deadline_unix`` is ABSOLUTE wall
+        time (submit wall clock + the request's relative deadline_s) so
+        a replay after restart can judge whether the deadline already
+        passed while the process was dead."""
+        self.append({
+            "kind": "accepted", "rid": int(rid), "trace_id": trace_id,
+            "prompt_sha": prompt_digest(prompt_ids),
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature), "top_k": int(top_k),
+            "top_p": float(top_p),
+            "eos_id": None if eos_id is None else int(eos_id),
+            "seed": int(seed), "priority": int(priority),
+            "deadline_unix": (None if deadline_unix is None
+                              else float(deadline_unix)),
+            "t_accept": time.time(),
+        })
+
+    def completed(self, *, rid: int, tokens, finish_reason: str) -> None:
+        self.append({
+            "kind": "completed", "rid": int(rid),
+            "tokens": [int(t) for t in tokens],
+            "finish_reason": finish_reason, "t_complete": time.time(),
+        })
+
+    def shed(self, *, rid: int, reason: str) -> None:
+        self.append({"kind": "shed", "rid": int(rid), "reason": reason,
+                     "t_shed": time.time()})
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+# -- reading ------------------------------------------------------------------
+
+
+def read_journal(path: str) -> List[Dict[str, Any]]:
+    """Records from a journal file (or a journal DIR containing one).
+    Unparseable lines are skipped with a warning — the single-appender
+    write discipline means only the tail can be torn (a mid-write
+    ``kill -9``), and a torn completion record merely re-serves one
+    request (token-identical for greedy)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_NAME)
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return records
+    for i, line in enumerate(raw.splitlines()):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            logger.warning(
+                f"request journal {path}: skipping unparseable line "
+                f"{i + 1} ({len(line)} bytes — a torn tail from an "
+                f"unclean exit is expected; anything else is not)")
+            continue
+        if isinstance(rec, dict) and rec.get("kind") in KINDS:
+            records.append(rec)
+    return records
+
+
+def replay_state(records: List[Dict[str, Any]]
+                 ) -> Tuple[Dict[int, Dict[str, Any]], Dict[int, Dict],
+                            Dict[int, Dict]]:
+    """Fold journal records into ``(pending, completed, shed)`` — each
+    a dict keyed by request id.  ``pending`` holds the accepted records
+    with no terminal record (the replay set, in acceptance order);
+    ``completed``/``shed`` hold the terminal records (the dedupe
+    sets)."""
+    accepted: Dict[int, Dict[str, Any]] = {}
+    completed: Dict[int, Dict[str, Any]] = {}
+    shed: Dict[int, Dict[str, Any]] = {}
+    for rec in records:
+        rid = rec.get("rid")
+        if not isinstance(rid, int):
+            continue
+        kind = rec["kind"]
+        if kind == "accepted":
+            # duplicate accepted records (a torn recovery) keep the
+            # FIRST — the original admission is the authoritative one
+            accepted.setdefault(rid, rec)
+        elif kind == "completed":
+            completed[rid] = rec
+        elif kind == "shed":
+            shed[rid] = rec
+    pending = {rid: rec for rid, rec in accepted.items()
+               if rid not in completed and rid not in shed}
+    return pending, completed, shed

@@ -69,6 +69,7 @@ import numpy as np
 
 from torchacc_tpu.obs import tracing
 from torchacc_tpu.ops.paged_attention import paged_attention
+from torchacc_tpu.resilience.chaos import failpoint
 from torchacc_tpu.serve.kv_cache import (
     BlockPool,
     PrefixIndex,
@@ -881,6 +882,12 @@ class Scheduler:
         return self._dev_stable
 
     def _decode_once(self) -> None:
+        # serve chaos seam (resilience/chaos.py): crash-mid-decode
+        # (ChaosPlan.kill -> SIGKILL with sequences in flight — the
+        # journal-replay gate) and decode-loop hang (ChaosPlan.hang ->
+        # the serve_liveness health check flips, the supervisor probe
+        # kills).  One global `is None` check when no plan is active.
+        failpoint("serve.decode", iter=self._iter)
         snapshot = [(i, s) for i, s in enumerate(self.slot_seq)
                     if self.active[i] and s is not None]
         tables, active, temp, top_k, top_p = self._dev_stable_arrays()

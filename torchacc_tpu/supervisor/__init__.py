@@ -3,7 +3,12 @@ loop (sense -> decide -> restart -> rejoin).  See supervisor/daemon.py
 for the architecture and docs/resilience.md "Supervisor" for the
 policy table and tuning knobs."""
 
-from torchacc_tpu.supervisor.daemon import Supervisor, WorkerSpec, free_port
+from torchacc_tpu.supervisor.daemon import (
+    StragglerWatch,
+    Supervisor,
+    WorkerSpec,
+    free_port,
+)
 from torchacc_tpu.supervisor.policy import (
     Action,
     ExitDisposition,
@@ -19,6 +24,7 @@ from torchacc_tpu.supervisor.worker import (
     WorkerHandle,
     newest_valid_step,
     read_exit_disposition,
+    serve_progress,
     valid_steps,
 )
 
@@ -29,6 +35,7 @@ __all__ = [
     "ProbeClient",
     "ProbeResult",
     "RestartPolicy",
+    "StragglerWatch",
     "Supervisor",
     "WorkerHandle",
     "WorkerProber",
@@ -36,5 +43,6 @@ __all__ = [
     "free_port",
     "newest_valid_step",
     "read_exit_disposition",
+    "serve_progress",
     "valid_steps",
 ]

@@ -82,6 +82,17 @@ class WorkerSpec:
     #: argv template; placeholders: {host} {world} {incarnation}
     #: {run_dir} {coord_port} {obs_port}
     argv: List[str]
+    #: workload role: 'train' (default) or 'serve'.  Serve workers have
+    #: no checkpoint tiers — the daemon's durable-progress signal (the
+    #: crash-streak reset) is the request-journal completed count
+    #: instead of the newest commit-marked step, and the fleet drift
+    #: detector baselines on the per-token gap histogram instead of
+    #: step time.  The policy rules need no serve variants: a crashed
+    #: or probe-dead serve worker restarts with backoff and replays its
+    #: journal (ServeEngine.recover), a preemption bundle (the graceful
+    #: drain) resumes budget-free, and the exclude-on-SDC rules simply
+    #: never fire (serve workers raise no SDC errors).
+    role: str = "train"
     #: extra environment for every worker (values templated too)
     env: Dict[str, str] = field(default_factory=dict)
     #: per-incarnation worker logs land here (default:
@@ -115,8 +126,49 @@ class WorkerSpec:
             raise ValueError("world_size must be >= 1")
         if not self.argv:
             raise ValueError("worker argv template is empty")
+        if self.role not in ("train", "serve"):
+            raise ValueError(
+                f"WorkerSpec.role must be 'train' or 'serve', got "
+                f"{self.role!r}")
         if self.log_dir is None:
             self.log_dir = os.path.join(self.run_dir, "supervisor_logs")
+
+
+class StragglerWatch:
+    """Patience window over the drift detector's ``fleet_straggler``
+    verdicts: a host must stay flagged CONTINUOUSLY for ``patience_s``
+    before it is offered for eviction — a transient blip (one clean
+    observation) resets its clock and never evicts.  Pure host logic
+    with an injectable clock (tests/test_serve_resilience.py)."""
+
+    def __init__(self, patience_s: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.patience_s = float(patience_s)
+        self._clock = clock
+        self._since: Dict[int, float] = {}
+
+    def update(self, flagged) -> Optional[int]:
+        """One observation round: ``flagged`` is the drift detector's
+        ``{host: reason}``.  Returns the lowest host whose flag has
+        been sustained past the patience window (None otherwise)."""
+        now = self._clock()
+        for h in list(self._since):
+            if h not in flagged:
+                del self._since[h]          # blip cleared — start over
+        ready = [h for h in flagged
+                 if now - self._since.setdefault(h, now)
+                 >= self.patience_s]
+        return min(ready) if ready else None
+
+    def forget(self, host: int) -> None:
+        self._since.pop(host, None)
+
+    def reset(self) -> None:
+        """Start every patience clock over (a fresh incarnation): a
+        verdict from before a restart — possibly sticky while its host
+        produced no samples, with the downtime on its clock — must be
+        RE-sustained against the new incarnation before it can evict."""
+        self._since.clear()
 
 
 class Supervisor:
@@ -129,6 +181,8 @@ class Supervisor:
                  fleet_poll_interval_s: float = 2.0,
                  drift_factor: float = 1.5,
                  drift_patience: int = 3,
+                 drift_min_rounds: int = 4,
+                 drift_hist: Optional[str] = None,
                  rng=None,
                  sleep: Callable[[float], None] = time.sleep,
                  prober_factory: Optional[
@@ -142,7 +196,12 @@ class Supervisor:
                                 is not None else self._default_prober)
         self.decisions: List[Dict[str, Any]] = []
         self.incarnation = 0
-        self._last_durable = newest_valid_step(spec.run_dir)
+        self._last_durable = self._progress()
+        # straggler eviction (policy.straggler_evict): the daemon-side
+        # patience window over the drift verdict; None while the rule
+        # (or the fleet scraper it feeds from) is off
+        self._straggler = (StragglerWatch(self.policy.straggler_patience_s)
+                           if self.policy.straggler_evict else None)
         self._handles: List[WorkerHandle] = []
         self.final_bundle_path: Optional[str] = None
         self._t0 = time.monotonic()
@@ -175,11 +234,22 @@ class Supervisor:
                 )
                 from torchacc_tpu.obs.goodput import GoodputLedger
                 self._fleet_ledger = GoodputLedger()
+                # drift baseline series: per-step time for training
+                # pods, per-token decode gap for serve fleets (serve
+                # workers are independent, so the gap histogram names
+                # the slow host; a lockstep training pod's wall-clock
+                # equalises — docs/observability.md "Fleet view")
+                if drift_hist is None:
+                    drift_hist = ("serve_token_gap_ms"
+                                  if spec.role == "serve"
+                                  else "step_time_ms")
                 self.fleet = FleetAggregator(
                     poll_interval_s=fleet_poll_interval_s,
                     timeout_s=spec.probe_timeout_s,
                     drift=DriftDetector(factor=drift_factor,
-                                        patience=drift_patience),
+                                        patience=drift_patience,
+                                        min_rounds=drift_min_rounds),
+                    drift_hist=drift_hist,
                     context=self._fleet_context)
                 # satellite gauges: the fleet endpoint answers usefully
                 # even before any worker binds its telemetry port
@@ -255,6 +325,17 @@ class Supervisor:
             self._fleet_ledger.lap(bucket)
             self._fleet_ledger.publish(prefix="supervisor_goodput_")
 
+    def _progress(self) -> int:
+        """The durable-progress signal that resets the crash-loop
+        streak: newest commit-marked checkpoint step for training pods;
+        finished (completed + shed) journal-record count for serve
+        fleets — serve workers have no checkpoint-tier semantics, a
+        request durably accounted IS their unit of progress."""
+        if self.spec.role == "serve":
+            from torchacc_tpu.supervisor.worker import serve_progress
+            return serve_progress(self.spec.run_dir)
+        return newest_valid_step(self.spec.run_dir)
+
     # -- workers -------------------------------------------------------------
 
     def _default_prober(self, host: int, port: int) -> WorkerProber:
@@ -319,14 +400,38 @@ class Supervisor:
 
     # -- sensing -------------------------------------------------------------
 
+    def _straggler_ready(self) -> Optional[int]:
+        """The host the straggler watch says to evict NOW, gated on
+        everything the eviction rule needs (budget, min_world, not
+        already excluded, a real current-incarnation index) — the
+        daemon never stops a healthy incarnation it is not allowed to
+        act on."""
+        if (self._straggler is None or self.fleet is None
+                or self.fleet.drift is None):
+            return None
+        host = self._straggler.update(self.fleet.drift.flagged())
+        if host is None:
+            return None
+        p = self.policy
+        if (host in self.engine.excluded or host >= self.engine.world
+                or self.engine.straggler_evictions
+                >= p.straggler_evict_budget
+                or self.engine.world - 1 < p.min_world
+                # eviction consumes one unit of the RESTART budget too:
+                # with it spent, stopping a healthy-but-slow pod would
+                # convert working capacity into a terminal give-up
+                or self.engine.restarts_used >= p.max_restarts):
+            return None
+        return host
+
     def _watch(self, handles: List[WorkerHandle],
                probers: List[Optional[WorkerProber]]
-               ) -> Tuple[Optional[int], Optional[str]]:
+               ) -> Tuple[Optional[int], Optional[str], Optional[int]]:
         """Block until the incarnation resolves.  Returns
-        ``(exit_code, probe_verdict)``: exit_code is 0 only when every
-        worker exited 0, the first nonzero code when one failed, and
-        None when the supervisor killed the workers (probe verdict /
-        deadline names why)."""
+        ``(exit_code, probe_verdict, straggler_host)``: exit_code is 0
+        only when every worker exited 0, the first nonzero code when
+        one failed, and None when the supervisor killed the workers
+        (the probe verdict / deadline / straggler host names why)."""
         s = self.spec
         t0 = time.monotonic()
         first_exit_at: Optional[float] = None
@@ -336,7 +441,7 @@ class Supervisor:
             exited = [c for c in codes if c is not None]
             nonzero = [c for c in exited if c != 0]
             if len(exited) == len(handles):
-                return (0 if not nonzero else nonzero[0]), None
+                return (0 if not nonzero else nonzero[0]), None, None
             if exited and first_exit_at is None:
                 first_exit_at = time.monotonic()
             if nonzero and first_exit_at is not None \
@@ -349,7 +454,7 @@ class Supervisor:
                     f"pod-wide within {s.exit_grace_s:.0f}s — "
                     "stopping the stragglers")
                 self._stop_all(handles)
-                return nonzero[0], None
+                return nonzero[0], None, None
             if not nonzero and first_exit_at is not None \
                     and time.monotonic() - first_exit_at > s.exit_grace_s:
                 # clean exits that never completed pod-wide: the
@@ -360,7 +465,7 @@ class Supervisor:
                     f"still running after {s.exit_grace_s:.0f}s; "
                     "killing and treating as hung")
                 self._stop_all(handles)
-                return None, "dead"
+                return None, "dead", None
             if s.incarnation_timeout_s is not None \
                     and time.monotonic() - t0 > s.incarnation_timeout_s:
                 logger.warning(
@@ -368,7 +473,17 @@ class Supervisor:
                     f"exceeded {s.incarnation_timeout_s:.0f}s — "
                     "killing (deadline hang detector)")
                 self._stop_all(handles)
-                return None, "dead"
+                return None, "dead", None
+            straggler = self._straggler_ready()
+            if straggler is not None:
+                logger.warning(
+                    f"supervisor: fleet_straggler verdict on host "
+                    f"{straggler} sustained past the "
+                    f"{self.policy.straggler_patience_s:.1f}s patience "
+                    f"window — stopping the incarnation for eviction")
+                counters.inc("supervisor_straggler_stops")
+                self._stop_all(handles)
+                return None, None, straggler
             if s.probe and time.monotonic() >= next_probe:
                 next_probe = time.monotonic() + s.probe_interval_s
                 for h, pr in zip(handles, probers):
@@ -394,7 +509,7 @@ class Supervisor:
                             "the incarnation")
                         counters.inc("supervisor_probe_kills")
                         self._stop_all(handles)
-                        return None, v
+                        return None, v, None
             self._sleep(self.poll_interval_s)
 
     # -- the loop ------------------------------------------------------------
@@ -413,18 +528,20 @@ class Supervisor:
                 since = time.time()
                 handles, probers = self._launch()
                 self._handles = handles
+                if self._straggler is not None:
+                    self._straggler.reset()
                 # everything since the previous incarnation ended (the
                 # decision, the backoff sleep, the relaunch) is restart
                 # downtime attributed to the policy rule that caused it
                 self._ledger_lap(f"down:{self._pending_rule}")
                 try:
-                    exit_code, probe_verdict = self._watch(handles,
-                                                           probers)
+                    exit_code, probe_verdict, straggler = self._watch(
+                        handles, probers)
                 finally:
                     self._stop_all(handles)
                 self._ledger_lap("active")
                 disposition = read_exit_disposition(s.run_dir, since)
-                newest = newest_valid_step(s.run_dir)
+                newest = self._progress()
                 if newest > self._last_durable:
                     # durable progress since the last failure: the
                     # crash-loop streak resets (policy.note_progress)
@@ -432,7 +549,8 @@ class Supervisor:
                     self.engine.note_progress()
                 action = self.engine.decide(disposition,
                                             exit_code=exit_code,
-                                            probe_verdict=probe_verdict)
+                                            probe_verdict=probe_verdict,
+                                            straggler_host=straggler)
                 self._record(action, disposition, exit_code,
                              probe_verdict)
                 self._pending_rule = action.rule
@@ -442,6 +560,8 @@ class Supervisor:
                         # renumbered successor — its drift baseline
                         # must not carry over
                         self.fleet.drift.forget(h)
+                        if self._straggler is not None:
+                            self._straggler.forget(h)
                 if action.kind == "done":
                     logger.info(
                         f"supervisor: run complete after "
@@ -486,8 +606,12 @@ class Supervisor:
             counters.inc("supervisor_exclusions", len(action.hosts))
         if action.rule in ("hang-restart", "probe-dead-restart"):
             counters.inc("supervisor_hang_restarts")
-        if action.rule in ("crash-backoff", "sdc-reoccurred-excluded"):
+        if action.rule in ("crash-backoff", "sdc-reoccurred-excluded",
+                           "straggler-not-evictable"):
             counters.inc("supervisor_crash_restarts")
+        if action.rule == "straggler-evict":
+            counters.inc("supervisor_straggler_evictions",
+                         len(action.hosts))
         if action.kind == "resume":
             counters.inc("supervisor_preempt_resumes")
 

@@ -29,6 +29,10 @@ declares worker dead   a wedged device clears with a process restart,
 anything else          bounded crash-loop: jittered exponential
 (CheckpointError,      backoff, ``max_restarts`` total budget,
 unknown crash)         terminal give-up with a final flight bundle
+sustained              (opt-in ``straggler_evict``) restart EXCLUDING
+fleet_straggler        the named host — the decide half of the PR-14
+verdict                drift detector; patience window + bounded
+                       eviction budget, never below ``min_world``
 =====================  =============================================
 
 Every restart except a preemption resume consumes one unit of the
@@ -69,6 +73,10 @@ class ExitDisposition:
     preempted: bool = False
     process_index: Optional[int] = None
     world_size: Optional[int] = None
+    #: serve-flavored block (ServeEngine._emit_disposition): completed
+    #: count, in-flight/unserved/shed request ids, journal path — the
+    #: serving equivalent of ``resumable`` (empty for training workers)
+    serve: Dict[str, Any] = field(default_factory=dict)
     #: path of the bundle this was parsed from (logging only)
     bundle_path: Optional[str] = None
 
@@ -93,6 +101,7 @@ class ExitDisposition:
             preempted=bool(d.get("preempted", False)),
             process_index=d.get("process_index"),
             world_size=d.get("world_size"),
+            serve=dict(d.get("serve") or {}),
             bundle_path=path,
         )
 
@@ -136,6 +145,17 @@ class RestartPolicy:
     #: never shrink the pod below this many hosts — an exclusion that
     #: would leave fewer gives up instead (the incident needs a human)
     min_world: int = 1
+    #: straggler eviction (opt-in; docs/resilience.md "Supervisor"):
+    #: act on the fleet drift detector's ``fleet_straggler`` verdict —
+    #: a host flagged CONTINUOUSLY for ``straggler_patience_s`` seconds
+    #: (on top of the detector's own consecutive-window patience; a
+    #: transient blip clears both and never evicts) is excluded via the
+    #: elastic-shrink path, at most ``straggler_evict_budget`` times
+    #: per run and never below ``min_world``.  Off (default): the
+    #: PR-14 behaviour — drift only degrades /healthz, nothing acts.
+    straggler_evict: bool = False
+    straggler_evict_budget: int = 1
+    straggler_patience_s: float = 10.0
 
     def validate(self) -> None:
         if self.max_restarts < 0:
@@ -150,6 +170,10 @@ class RestartPolicy:
             raise ValueError("backoff_jitter must be in [0, 1)")
         if self.min_world < 1:
             raise ValueError("min_world must be >= 1")
+        if self.straggler_evict_budget < 0:
+            raise ValueError("straggler_evict_budget must be >= 0")
+        if self.straggler_patience_s < 0:
+            raise ValueError("straggler_patience_s must be >= 0")
 
 
 class PolicyEngine:
@@ -172,6 +196,7 @@ class PolicyEngine:
         self.excluded: set = set()
         self.restarts_used = 0
         self.crash_streak = 0
+        self.straggler_evictions = 0
         self._rng = rng if rng is not None else random.Random(0)
 
     # -- state ---------------------------------------------------------------
@@ -192,7 +217,8 @@ class PolicyEngine:
 
     def decide(self, disposition: Optional[ExitDisposition], *,
                exit_code: Optional[int] = None,
-               probe_verdict: Optional[str] = None) -> Action:
+               probe_verdict: Optional[str] = None,
+               straggler_host: Optional[int] = None) -> Action:
         """Map one incarnation's outcome to an action.
 
         ``disposition``: the newest exit-disposition bundle written
@@ -200,16 +226,69 @@ class PolicyEngine:
         ``exit_code``: the aggregate worker exit code (0 only when
         every worker exited 0; None = workers were killed by the
         supervisor).  ``probe_verdict``: 'dead'/'unhealthy' when the
-        probe layer — not the exit — triggered the decision."""
+        probe layer — not the exit — triggered the decision.
+        ``straggler_host``: the host the daemon's straggler watch
+        stopped the incarnation over (the ``fleet_straggler`` verdict
+        sustained past the policy's patience window) — decided FIRST,
+        since the supervisor's own SIGTERM makes the stopped workers
+        write preemption bundles that must not be mistaken for a
+        scheduler eviction."""
         d = disposition
+        # 0. straggler eviction (opt-in): the daemon stopped a healthy-
+        # but-slow incarnation on the sustained drift verdict — exclude
+        # the named host through the same elastic-shrink path an SDC
+        # exclusion takes, bounded by its own eviction budget and
+        # min_world (the daemon gates on both before stopping anything;
+        # re-checked here so the rule is safe to unit-test in isolation)
+        if straggler_host is not None:
+            host = int(straggler_host)
+            p = self.policy
+            evictable = (p.straggler_evict
+                         and host not in self.excluded
+                         and self.straggler_evictions
+                         < p.straggler_evict_budget
+                         and self.world - 1 >= p.min_world)
+            if evictable:
+                budget = self._consume_budget("straggler-evict",
+                                              "fleet_straggler")
+                if budget is not None:
+                    return budget
+                self.excluded.add(host)
+                self.straggler_evictions += 1
+                self.crash_streak = 0
+                return Action(
+                    "restart_excluding", "straggler-evict",
+                    hosts=(host,), delay_s=p.restart_delay_s,
+                    reason=f"fleet_straggler verdict sustained past "
+                           f"{p.straggler_patience_s:.1f}s patience: "
+                           f"evicting host {host}, elastic shrink to "
+                           f"world={self.world} (eviction "
+                           f"{self.straggler_evictions}"
+                           f"/{p.straggler_evict_budget})")
+            # not evictable (budget spent / would breach min_world /
+            # already excluded): the incarnation was stopped anyway —
+            # same-world restart under the ordinary crash bound so a
+            # flapping detector can never spin the pod for free
+            return self._crash(
+                "straggler-not-evictable",
+                f"fleet_straggler named host {host} but eviction is "
+                f"not permitted (budget "
+                f"{self.straggler_evictions}/{p.straggler_evict_budget}"
+                f", world {self.world}, min_world {p.min_world})")
         # 1. preemption is a planned exit: resume, budget untouched.
         # Guarded on probe_verdict: when the SUPERVISOR killed the
         # incarnation (probe-dead / deadline), its own SIGTERM made the
         # workers write preemption bundles — mistaking that for a
         # scheduler eviction would resume budget-free forever and mask
-        # the real failure
+        # the real failure.  Guarded on exit_code too: when one worker
+        # CRASHED (nonzero) and the daemon's exit-grace SIGTERM drained
+        # its peers, the peers' preemption bundles are collateral, not
+        # a verdict — serve workers are independent, so a kill -9'd
+        # host leaves no error bundle of its own and the drained peer's
+        # would otherwise read as a budget-free scheduler eviction
         if d is not None and (d.preempted or d.reason == "preemption") \
-                and probe_verdict is None:
+                and probe_verdict is None \
+                and (exit_code is None or exit_code == 0):
             return Action("resume", "preempt-resume",
                           delay_s=self.policy.preempt_resume_delay_s,
                           reason="preemption bundle — waiting out the "

@@ -1,0 +1,229 @@
+"""Deterministic SERVING worker for the serve-side chaos gate.
+
+``python -m torchacc_tpu.supervisor.serve_fixture --run-dir D --host I
+...`` is the worker ``make serve-chaos`` (scripts/serve_chaos_smoke.py)
+and the daemon tests launch under the supervisor with
+``WorkerSpec(role='serve')``: a tiny llama model on CPU serving a
+deterministic greedy workload through the full production wiring —
+continuous-batching engine, durable request journal + replay
+(``serve.journal_dir``), deadline shedding, graceful drain on SIGTERM,
+and the telemetry plane (/metrics + /healthz + serve-flavored exit
+disposition) armed.
+
+Determinism: params initialise from ``PRNGKey(0)`` and the workload is
+a pure function of ``--seed``, so every incarnation (and the clean
+reference run the gate compares against) serves the same requests over
+the same weights — greedy outputs are token-identical across
+kill/replay by construction.
+
+Idempotent submission: the journal is the source of truth.  On start
+the engine replays every journaled-but-unfinished request under its
+original id, and only workload items with ids past the journal's
+newest accepted id are submitted fresh — a relaunched incarnation
+never double-submits.
+
+Faults are ChaosPlan-driven from ``--chaos`` (strict JSON), applied
+only when ``--incarnation`` matches ``--chaos-incarnation`` (-1 =
+every incarnation) AND the rule's optional ``host`` matches ``--host``:
+
+- ``{"kill": {"after": 30}}`` — SIGKILL self at the 31st decode
+  iteration (a REAL ``kill -9`` mid-decode: no drain, no bundle — the
+  journal replay must make the fleet whole);
+- ``{"hang": {"seconds": 30, "after": 5}}`` — the decode loop sleeps:
+  the ``serve_liveness`` health check flips and the supervisor's probe
+  kills the worker;
+- ``{"slow": {"seconds": 0.4, "host": 1}}`` — EVERY decode iteration
+  on host 1 sleeps: the sustained straggler the drift detector must
+  name and the (opt-in) eviction rule must act on.
+
+Exit code 0 = workload served (or a handled preemption drain); 1 =
+unexpected error; 2 = bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+
+
+def _parse(argv):
+    p = argparse.ArgumentParser(
+        prog="torchacc_tpu.supervisor.serve_fixture",
+        description="deterministic chaos-driven serving worker "
+                    "(serve-chaos smoke/test fixture)")
+    p.add_argument("--run-dir", required=True)
+    p.add_argument("--world", type=int, default=1)
+    p.add_argument("--host", type=int, default=0)
+    p.add_argument("--obs-port", type=int, default=0,
+                   help="serve /metrics + /healthz here (0 = no server)")
+    p.add_argument("--incarnation", type=int, default=0)
+    p.add_argument("--requests", type=int, default=6)
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--deadline-s", type=float, default=0.0,
+                   help="> 0: the LAST workload request carries this "
+                        "relative deadline (the shed-accounting probe)")
+    p.add_argument("--no-shed", action="store_true",
+                   help="serve late instead of shedding expired "
+                        "deadlines (the clean-reference configuration)")
+    p.add_argument("--chaos", default="",
+                   help="strict-JSON fault spec (see module docstring)")
+    p.add_argument("--chaos-incarnation", type=int, default=0,
+                   help="apply --chaos only on this incarnation "
+                        "(-1 = every incarnation)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--linger-s", type=float, default=0.0,
+                   help="hold the process (and its telemetry endpoint) "
+                        "open this long after serving completes — the "
+                        "straggler scenario needs the fast host alive "
+                        "while the slow one drifts; SIGTERM breaks the "
+                        "linger immediately")
+    return p.parse_args(argv)
+
+
+def workload(seed: int, n: int, max_new: int, vocab: int = 64):
+    """The deterministic request list: item i IS request id i (ids are
+    assigned in submission order), so journal replay and idempotent
+    resubmission key on the index."""
+    import numpy as np
+    rng = np.random.default_rng(seed * 9173 + 1)
+    lens = rng.integers(3, 14, size=n)
+    return [rng.integers(1, vocab, size=int(l)).tolist() for l in lens]
+
+
+def _rule(chaos, name, host):
+    """The named chaos rule applying to this host.  A rule with no
+    ``host`` key applies everywhere; a list holds host-scoped variants
+    and the LAST match wins (so ``[{base}, {bigger, "host": 1}]``
+    reads "everyone pays base, host 1 pays bigger")."""
+    r = chaos.get(name)
+    if r is None:
+        return None
+    picked = None
+    for rr in (r if isinstance(r, list) else [r]):
+        if isinstance(rr, dict) and ("host" not in rr
+                                     or int(rr["host"]) == host):
+            picked = rr
+    return picked
+
+
+def main(argv=None) -> int:
+    args = _parse(sys.argv[1:] if argv is None else list(argv))
+    try:
+        chaos = json.loads(args.chaos) if args.chaos else {}
+    except ValueError as e:
+        print(f"serve_fixture: bad --chaos JSON: {e}", file=sys.stderr)
+        return 2
+    apply_chaos = (args.chaos_incarnation < 0
+                   or args.incarnation == args.chaos_incarnation)
+    chaos = chaos if apply_chaos else {}
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.models import TransformerLM, get_preset
+    from torchacc_tpu.resilience import ChaosPlan
+    from torchacc_tpu.serve import Request, ServeEngine
+
+    mc = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                    num_layers=1, num_heads=2, num_kv_heads=2,
+                    intermediate_size=64, dtype=jnp.float32,
+                    max_seq_len=128)
+    model = TransformerLM(mc)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    journal_dir = os.path.join(args.run_dir, f"journal_h{args.host}")
+    cfg = ta.Config(
+        serve=ta.ServeConfig(
+            block_size=8, num_blocks=96, max_slots=4, prefill_chunk=8,
+            decode_depth=2, max_new_tokens=args.max_new,
+            journal_dir=journal_dir,
+            shed_deadlines=not args.no_shed),
+        obs=ta.ObsConfig(enabled=True,
+                         http_port=(args.obs_port or None),
+                         flight_dir=args.run_dir))
+
+    plan = ChaosPlan(seed=args.seed)
+    armed = False
+    kill = _rule(chaos, "kill", args.host)
+    if kill:
+        plan.kill("serve.decode", after=int(kill.get("after", 0)))
+        armed = True
+    hang = _rule(chaos, "hang", args.host)
+    if hang:
+        plan.hang("serve.decode", seconds=float(hang["seconds"]),
+                  after=int(hang.get("after", 0)))
+        armed = True
+    slow = _rule(chaos, "slow", args.host)
+    if slow:
+        # a sustained straggler, not a one-shot hang: every decode
+        # iteration pays the injected sleep
+        plan.hang("serve.decode", seconds=float(slow["seconds"]),
+                  times=10 ** 9, after=int(slow.get("after", 0)))
+        armed = True
+
+    engine = ServeEngine(model, params, cfg)
+    recovered = engine.recover()
+    known = (recovered["replayed"] + recovered["completed"]
+             + recovered["shed"] + recovered["shed_on_recovery"])
+    start = max(known) + 1 if known else 0
+    prompts = workload(args.seed, args.requests, args.max_new)
+    for i in range(start, len(prompts)):
+        deadline = (args.deadline_s
+                    if (args.deadline_s > 0 and i == len(prompts) - 1)
+                    else None)
+        engine.submit(Request(prompt_ids=prompts[i],
+                              max_new_tokens=args.max_new,
+                              deadline_s=deadline))
+    print(f"SERVE_START host={args.host} incarnation={args.incarnation} "
+          f"replayed={recovered['replayed']} "
+          f"already_completed={len(recovered['completed'])} "
+          f"submitted={max(len(prompts) - start, 0)}", flush=True)
+
+    def _linger():
+        # the linger exists to keep a fast host's telemetry endpoint
+        # alive while a slow peer drifts — an incarnation that had
+        # nothing to do (everything already journaled complete) has no
+        # series worth holding open; exiting lets the fleet wind down
+        if args.linger_s <= 0 or (not recovered["replayed"]
+                                  and start >= len(prompts)):
+            return
+        import time
+        from torchacc_tpu.resilience.preemption import (
+            preemption_requested,
+        )
+        t0 = time.monotonic()
+        while (time.monotonic() - t0 < args.linger_s
+               and not preemption_requested()):
+            time.sleep(0.1)
+
+    ctx = plan if armed else contextlib.nullcontext()
+    try:
+        with ctx:
+            engine.run()
+    except Exception as e:  # noqa: BLE001 - exit code is the channel
+        print(f"SERVE_ABORT type={type(e).__name__}: {e}", flush=True)
+        _linger()
+        return 1
+    report = engine.drain_report()
+    print("SERVE_DONE " + json.dumps({
+        "host": args.host, "incarnation": args.incarnation,
+        "completed": report["completed"], "shed": report["shed"],
+        "unserved": report["unserved"], "draining": report["draining"],
+    }), flush=True)
+    _linger()
+    engine.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,51 @@ def newest_valid_step(directory: Optional[str]) -> int:
     return max(valid_steps(directory), default=-1)
 
 
+#: the serve journal filename (one home for the rule is
+#: serve/journal.py JOURNAL_NAME; duplicated as a literal because the
+#: supervisor must not import the jax-backed serve package)
+JOURNAL_NAME = "journal.jsonl"
+
+
+def serve_progress(run_dir: Optional[str]) -> int:
+    """Total finished (completed + shed) journal records across every
+    ``journal.jsonl`` under ``run_dir`` (one or two levels deep — the
+    fixture keeps per-host journal dirs inside the run dir).  The
+    serve-role analogue of :func:`newest_valid_step`: the daemon's
+    durable-progress signal that resets the crash-loop streak.  Pure
+    filesystem, tolerant of torn tail lines."""
+    if not run_dir:
+        return 0
+    paths: List[str] = []
+    try:
+        for root, dirs, names in os.walk(run_dir):
+            # bound the walk: journals live at the run dir or one
+            # per-host dir below it, never deeper
+            if os.path.relpath(root, run_dir).count(os.sep) > 1:
+                dirs[:] = []
+                continue
+            if JOURNAL_NAME in names:
+                paths.append(os.path.join(root, JOURNAL_NAME))
+    except OSError:
+        return 0
+    done = 0
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                raw = f.read()
+        except OSError:
+            continue
+        for line in raw.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") in ("completed",
+                                                             "shed"):
+                done += 1
+    return done
+
+
 def read_exit_disposition(run_dir: str, since: float
                           ) -> Optional[ExitDisposition]:
     """The decisive ``exit_disposition`` among the ``flight_*.json``

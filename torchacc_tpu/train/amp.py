@@ -67,6 +67,17 @@ def select_tree(pred: jax.Array, on_true: Any, on_false: Any) -> Any:
 # bf16 compute-params shadow (Megatron-style "fp32 main params")
 # ---------------------------------------------------------------------------
 
+def shadow_cast(tree):
+    """THE shadow cast policy — one home, shared by
+    :func:`bf16_param_shadow`'s update, ``Trainer.swap_params``'s
+    re-derivation, and ``Trainer._shadow_consistent``'s probe (the
+    three must agree or the swap invariant silently rots): floating
+    leaves cast to bf16, everything else untouched."""
+    return jax.tree.map(
+        lambda p: p.astype(jnp.bfloat16)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, tree)
+
+
 def bf16_param_shadow(inner):
     """Wrap an optax transform so its state carries a bf16 copy of the
     f32 master params, refreshed every update.
@@ -91,13 +102,20 @@ def bf16_param_shadow(inner):
     State is ``(inner_state, shadow)``: embeds the params tree, so
     `state_logical_axes`' trailing-path match shards each shadow leaf
     like its master and checkpointing needs no new machinery.
+
+    **Invariant — stale-shadow hazard**: the shadow is refreshed ONLY
+    by this transform's ``update``, so at every step boundary
+    ``shadow == cast(params)`` holds *if and only if* params change
+    exclusively through optimizer updates.  Replacing ``state.params``
+    directly (loading converted weights into an initialised trainer)
+    leaves a stale shadow the forward silently trains against — use
+    ``Trainer.swap_params()``, which re-derives (or re-inits) the
+    shadow atomically with the params and asserts the invariant on the
+    debug path (``Trainer._shadow_consistent``).
     """
     import optax
 
-    def _cast(tree):
-        return jax.tree.map(
-            lambda p: p.astype(jnp.bfloat16)
-            if jnp.issubdtype(p.dtype, jnp.floating) else p, tree)
+    _cast = shadow_cast
 
     def init(params):
         return (inner.init(params), _cast(params))

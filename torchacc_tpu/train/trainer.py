@@ -330,6 +330,115 @@ class Trainer:
         self._host_step = 0
         return self.state
 
+    def swap_params(self, params: Any, *, reinit_opt: bool = True,
+                    verify_shadow: bool = False) -> TrainState:
+        """Replace ``state.params`` and refresh everything derived from
+        them ATOMICALLY — the only supported way to load new weights
+        into an initialised trainer.
+
+        Assigning ``state = state.replace(params=...)`` by hand is a
+        silent-corruption hazard under ``compute.bf16_compute_params``:
+        the bf16 forward shadow lives in ``opt_state`` and is refreshed
+        only by ``optimizer.update`` (train/amp.bf16_param_shadow), so a
+        bare swap leaves the forward silently training against the OLD
+        weights.  This helper upholds the invariant ``shadow ==
+        cast(params)`` at every step boundary:
+
+        - in-flight steps drain first (their verdicts belong to the old
+          weights);
+        - ``reinit_opt=True`` (default) rebuilds ``opt_state`` from the
+          new params — moments restart, the shadow is fresh by
+          construction (the right call for externally converted
+          weights);
+        - ``reinit_opt=False`` keeps the optimizer moments and
+          re-derives only the shadow (fine-tuning warm-starts where the
+          new params are a small perturbation);
+        - ``verify_shadow=True`` fetches and asserts the invariant
+          bitwise over EVERY leaf after the swap (and holds under
+          ``python -O``); an ordinary interpreter run (``__debug__``)
+          asserts a small leaf sample for free.
+
+        The new params must match the current state's tree structure,
+        shapes and dtypes; they are placed into the existing shardings.
+        ``step``/``scaler``/``quant`` are preserved."""
+        if self.state is None:
+            raise TrainerStateError(
+                "swap_params needs an initialised trainer — call "
+                "init()/init_from_params()/restore() first")
+        self.drain()
+        old = jax.tree.structure(self.state.params)
+        new = jax.tree.structure(params)
+        if old != new:
+            raise TrainerStateError(
+                f"swap_params: new params tree does not match the "
+                f"live state ({new} vs {old})")
+        # structure alone is not enough: a shape/dtype drift would pass
+        # device_put and surface later as a jit recompile/shape error
+        # deep in the train step (or a silent dtype change) — fail HERE
+        # with the offending leaves named
+        bad = []
+        for (path, live), (_, cand) in zip(
+                jax.tree_util.tree_leaves_with_path(self.state.params),
+                jax.tree_util.tree_leaves_with_path(params)):
+            ls, cs = jnp.shape(live), jnp.shape(cand)
+            ld = jnp.asarray(live).dtype if not hasattr(live, "dtype") \
+                else live.dtype
+            cd = jnp.asarray(cand).dtype if not hasattr(cand, "dtype") \
+                else cand.dtype
+            if ls != cs or ld != cd:
+                bad.append(f"{jax.tree_util.keystr(path)}: "
+                           f"{cs}/{cd} vs live {ls}/{ld}")
+        if bad:
+            raise TrainerStateError(
+                "swap_params: new params do not match the live state's "
+                "leaf shapes/dtypes — " + "; ".join(bad[:8])
+                + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""))
+        sh = (self.state_shardings.params
+              if self.state_shardings is not None else None)
+        with jax.sharding.set_mesh(self.mesh):
+            if sh is not None:
+                params = jax.device_put(params, sh)
+            if reinit_opt:
+                opt_sh = (self.state_shardings.opt_state
+                          if self.state_shardings is not None else None)
+                opt_state = jax.jit(
+                    self.optimizer.init, out_shardings=opt_sh)(params)
+            elif self._shadow_on:
+                from torchacc_tpu.train.amp import shadow_cast
+                inner_state, _stale = self.state.opt_state
+                opt_state = (inner_state, jax.jit(shadow_cast)(params))
+            else:
+                opt_state = self.state.opt_state
+        self.state = self.state.replace(params=params,
+                                        opt_state=opt_state)
+        # verify_shadow=True checks every leaf (and must hold under
+        # `python -O` too — explicit raise, not `assert`); the ambient
+        # __debug__ path samples a few leaves so routine swaps on
+        # multi-GB models do not pay a host sync per leaf
+        check = (None if verify_shadow else 4) if (verify_shadow
+                                                   or __debug__) else 0
+        if check != 0 and not self._shadow_consistent(sample=check):
+            raise AssertionError(
+                "bf16 shadow != cast(params) after swap_params — "
+                "report: the atomic-swap invariant is broken")
+        return self.state
+
+    def _shadow_consistent(self, sample: Optional[int] = None) -> bool:
+        """Debug probe for the bf16-shadow invariant: every shadow leaf
+        equals its master cast to the compute dtype, bitwise
+        (``sample=N`` checks an evenly-strided N leaves — the cheap
+        ambient-__debug__ mode).  True when the shadow is off (nothing
+        to hold)."""
+        if not self._shadow_on or self.state is None:
+            return True
+        from torchacc_tpu.train.amp import shadow_cast, shadow_params
+        shadow = shadow_params(self.state.opt_state)
+        want = shadow_cast(self.state.params)
+        pairs = list(zip(jax.tree.leaves(shadow), jax.tree.leaves(want)))
+        if sample is not None and 0 < sample < len(pairs):
+            pairs = pairs[::max(1, len(pairs) // sample)]
+        return all(bool(jnp.all(a == b)) for a, b in pairs)
+
     # -- train step ---------------------------------------------------------
     @property
     def _attn_dropout_on(self) -> bool:
