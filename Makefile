@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc serve-smoke serve-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc chaos-replace serve-smoke serve-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -113,6 +113,20 @@ fleet-smoke:
 serve-chaos:
 	JAX_PLATFORMS=cpu python scripts/serve_chaos_smoke.py
 
+# host-replacement gate (docs/resilience.md "Host replacement &
+# grow-back"): (1) a 2-process dp=2 worker SIGKILLs itself (no flight
+# bundle — the hardware-loss signature) -> crash-replace -> the hot-
+# spare pool refills the slot -> the pod relaunches at FULL width and
+# the post-rejoin loss trajectory is bitwise identical to an
+# uninterrupted dp=2 reference; (2) provisioning is armed to fail ->
+# replace-fallback-shrink (dp=1) -> a preemption boundary later the
+# daemon's grow-back re-provisions the excluded slot, readmits it, and
+# the run finishes back at world=2 — with the provisioning windows
+# attributed to down:provisioning in a goodput ledger that still sums
+# to wall clock, and the fleet-history CLI replaying the timeline
+chaos-replace:
+	JAX_PLATFORMS=cpu python scripts/chaos_replace_smoke.py
+
 # fault-injection suite (docs/resilience.md) under 3 seeds: CHAOS_SEED
 # shifts where the NaN losses / preemptions / I/O faults / injected
 # hangs land, so three different fault schedules exercise the same
@@ -136,6 +150,7 @@ chaos:
 	$(MAKE) supervisor-smoke
 	$(MAKE) fleet-smoke
 	$(MAKE) serve-chaos
+	$(MAKE) chaos-replace
 
 # multi-host robustness proof: 2-process jax.distributed fixtures
 # (cross-host resume consensus with divergent quarantine, preemption

@@ -1,0 +1,345 @@
+#!/usr/bin/env python
+"""`make chaos-replace`: the end-to-end gate for host replacement and
+elastic grow-back (docs/resilience.md "Host replacement & grow-back").
+
+Two scenarios, zero human intervention, all on CPU:
+
+1. **SIGKILL -> warm replace -> full-width bitwise rejoin** (2
+   jax.distributed processes, dp=2): incarnation 0's host 1 SIGKILLs
+   itself before feeding batch 3 — no flight bundle, no emergency
+   save, the hardware-loss signature.  The supervisor's exit-grace
+   sweep takes the stalled peer down, `decide()` fires
+   `crash-replace`, the hot-spare pool refills slot 1 warm, and the
+   pod relaunches at the SAME world (dp=2, nothing excluded).  The
+   replacement incarnation resumes from the newest durable tier and
+   its post-rejoin loss trajectory is **bitwise identical** to an
+   uninterrupted dp=2 reference at equal global batch (same world,
+   same reduction order — not just within tolerance).
+2. **provisioning failure -> fallback shrink -> grow-back** (world=2):
+   the backend is armed to fail the first provision, so the same kill
+   turns into `crash-replace` -> `replace-fallback-shrink` (host 1
+   excluded, dp=1).  Incarnation 1 is preempted mid-run; at the
+   decision boundary the daemon's grow-back re-provisions the excluded
+   slot (the one-cycle holdoff after the failed attempt has passed),
+   readmits host 1, and incarnation 2 relaunches at the restored
+   world=2 with elastic resume re-expanding dp to it.  The whole
+   trajectory matches a world=1 reference within the elastic
+   tolerance (the stream is world-size-independent).
+
+Both scenarios scrape the supervisor's `/fleet` endpoint afterwards:
+goodput buckets must sum to wall clock (`check_sum`) with the
+provisioning window attributed to `down:provisioning`, and the
+`fleet-history` CLI must replay the provisioning timeline.
+
+FAILS (exit 1) unless every assertion above holds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from torchacc_tpu.obs.goodput import check_sum  # noqa: E402
+from torchacc_tpu.supervisor import (  # noqa: E402
+    LocalProvisioner,
+    RestartPolicy,
+    SparePool,
+    Supervisor,
+    WorkerSpec,
+    free_port,
+)
+
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+FIXTURE = [sys.executable, "-m", "torchacc_tpu.supervisor.fixture"]
+# dp=2 prefix resumed at dp=1: different psum reduction order, same
+# math — the elastic fixtures bound the drift far below this
+LOSS_ATOL = 2e-3
+MAX_STEPS = 8
+
+
+def check(ok, msg):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {msg}", flush=True)
+    if not ok:
+        raise SystemExit(f"chaos-replace FAILED: {msg}")
+
+
+def fixture_argv(max_steps, ckpt_every, chaos):
+    return FIXTURE + [
+        "--run-dir", "{run_dir}", "--world", "{world}",
+        "--host", "{host}", "--coord-port", "{coord_port}",
+        "--obs-port", "{obs_port}", "--incarnation", "{incarnation}",
+        "--max-steps", str(max_steps),
+        "--checkpoint-every", str(ckpt_every),
+        "--chaos", json.dumps(chaos),
+    ]
+
+
+def parse_worker_log(run_dir, incarnation, host):
+    """(resume_candidate, {step: loss}) from a fixture worker log."""
+    path = os.path.join(run_dir, "supervisor_logs",
+                        f"inc{incarnation}_host{host}.log")
+    cand, recs = None, {}
+    with open(path) as f:
+        for line in f:
+            if line.startswith("SUPERVISOR_RESUME_CANDIDATE="):
+                cand = int(line.strip().split("=", 1)[1])
+            elif line.startswith("SUPERVISOR_REC "):
+                r = json.loads(line[len("SUPERVISOR_REC "):])
+                recs[int(r["step"])] = float(r["loss"])
+    return cand, recs
+
+
+def _parse_recs(stdout):
+    recs = {}
+    for line in stdout.splitlines():
+        if line.startswith("SUPERVISOR_REC "):
+            r = json.loads(line[len("SUPERVISOR_REC "):])
+            recs[int(r["step"])] = float(r["loss"])
+    return recs
+
+
+def reference_run_world1(tmp, max_steps):
+    """Uninterrupted world=1 run on the same stream (the elastic
+    tolerance baseline for the shrunken window)."""
+    d = os.path.join(tmp, "ref_w1")
+    os.makedirs(d)
+    env = dict(os.environ, **WORKER_ENV)
+    argv = FIXTURE + ["--run-dir", d, "--world", "1", "--host", "0",
+                      "--max-steps", str(max_steps),
+                      "--checkpoint-every", "2"]
+    out = subprocess.run(argv, env=env, capture_output=True, text=True,
+                         timeout=600)
+    if out.returncode != 0:
+        print(out.stdout[-3000:], out.stderr[-3000:])
+        raise SystemExit("world=1 reference run failed")
+    return _parse_recs(out.stdout)
+
+
+def reference_run_world2(tmp, max_steps):
+    """Uninterrupted dp=2 run on the same stream: the BITWISE baseline
+    the replaced pod must reproduce (same world, same psum order)."""
+    d = os.path.join(tmp, "ref_w2")
+    os.makedirs(d)
+    env = dict(os.environ, **WORKER_ENV)
+    port = free_port()
+    procs = []
+    for host in (0, 1):
+        argv = FIXTURE + ["--run-dir", d, "--world", "2",
+                          "--host", str(host),
+                          "--coord-port", str(port),
+                          "--max-steps", str(max_steps),
+                          "--checkpoint-every", "2"]
+        procs.append(subprocess.Popen(
+            argv, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = [p.communicate(timeout=600) for p in procs]
+    if any(p.returncode != 0 for p in procs):
+        for (o, e), p in zip(outs, procs):
+            print(f"-- ref_w2 host rc={p.returncode}")
+            print(o[-2000:], e[-2000:])
+        raise SystemExit("world=2 reference run failed")
+    return _parse_recs(outs[0][0])
+
+
+def fleet_summary(obs_port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{obs_port}/fleet", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def scenario_replace(tmp):
+    print("== scenario A: SIGKILL host 1 -> warm spare replace -> "
+          "full-width bitwise rejoin ==", flush=True)
+    run_dir = os.path.join(tmp, "replace")
+    obs_port = free_port()
+    # per-incarnation chaos map: only incarnation 0 loses a host
+    chaos = {"0": {"kill": {"host": 1, "after": 3}}}
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=2,
+        argv=fixture_argv(MAX_STEPS, 2, chaos),
+        env=WORKER_ENV,
+        # short grace: the surviving peer is wedged in a collective
+        # the moment its partner dies — sweep it fast
+        exit_grace_s=10.0,
+        incarnation_timeout_s=600.0)
+    prov = SparePool(LocalProvisioner(), spares=1)
+    sup = Supervisor(spec,
+                     RestartPolicy(max_restarts=3, replace=True,
+                                   replace_budget=2),
+                     obs_port=obs_port, provisioner=prov)
+    t0 = time.time()
+    rep = sup.run()
+    print(f"  report: "
+          f"{json.dumps({k: v for k, v in rep.items() if k != 'decisions'})}"
+          f" ({time.time() - t0:.0f}s)", flush=True)
+    check(rep["status"] == "completed", "run completed unattended")
+    d0 = rep["decisions"][0]
+    check(d0["rule"] == "crash-replace",
+          f"decision 0 = crash-replace (got {d0['rule']})")
+    check(rep["replacements_used"] == 1 and 1 in rep["replaced"],
+          f"one replacement decision charged, slot 1 refilled "
+          f"(used={rep['replacements_used']} replaced={rep['replaced']})")
+    check(rep["excluded"] == [] and rep["world"] == 2,
+          f"pod healed at FULL width — nothing excluded "
+          f"(world={rep['world']} excluded={rep['excluded']})")
+    st = prov.stats()
+    check(st["warm_hits"] >= 1 and st["spares_left"] == 0,
+          f"replacement came from the hot-spare pool ({st})")
+    # the replacement incarnation resumed from a durable tier and its
+    # post-rejoin trajectory is BITWISE the uninterrupted dp=2 run's
+    cand, recs = parse_worker_log(run_dir, 1, 0)
+    steps = sorted(recs)
+    check(steps and steps[-1] == MAX_STEPS - 1
+          and (cand is None or cand < 0 or steps[0] == cand),
+          f"replacement incarnation resumed at {cand} and finished "
+          f"({steps})")
+    ref2 = reference_run_world2(tmp, MAX_STEPS)
+    exact = all(recs[s] == ref2[s] for s in steps)
+    check(exact, "post-rejoin losses BITWISE-identical to the "
+                 "uninterrupted dp=2 reference at equal global batch")
+    # quarantine must not refuse the replacement hardware
+    qpath = os.path.join(run_dir, "sdc_quarantine.json")
+    if os.path.exists(qpath):
+        q = json.load(open(qpath))
+        check(not q.get("hosts"), f"quarantine cleared for the "
+                                  f"replaced slot ({q})")
+    # goodput: buckets sum to wall, the healing windows are visible
+    doc = fleet_summary(obs_port)
+    g = doc.get("goodput_supervisor") or {}
+    ok, gap = check_sum(g)
+    check(ok, f"goodput buckets sum to wall clock (gap {gap:.3f})")
+    buckets = g.get("buckets", {})
+    check("down:provisioning" in buckets and "up:replaced" in buckets,
+          f"provisioning + post-replacement windows attributed "
+          f"({sorted(buckets)})")
+    sup_doc = doc.get("supervisor", {})
+    check(sup_doc.get("provisioner", {}).get("warm_hits", 0) >= 1,
+          "/fleet carries the provisioner accounting")
+    lifecycle = sup_doc.get("lifecycle", {})
+    check(lifecycle.get("0") == "active" and lifecycle.get("1") == "active",
+          f"lifecycle settles active/active ({lifecycle})")
+    # the fleet-history CLI replays the provisioning timeline
+    out = subprocess.run(
+        [sys.executable, "-m", "torchacc_tpu.checkpoint.cli",
+         "fleet-history", run_dir],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    check(out.returncode == 0 and "provision_ok" in out.stdout
+          and "crash-replace" in out.stdout,
+          f"fleet-history CLI replays the replacement "
+          f"(rc={out.returncode})")
+
+
+def scenario_growback(tmp):
+    print("== scenario B: provisioning fails -> fallback shrink -> "
+          "grow-back to full width ==", flush=True)
+    run_dir = os.path.join(tmp, "growback")
+    obs_port = free_port()
+    # inc 0: host 1 dies; inc 1 (shrunken): preempted mid-run — the
+    # decision boundary where grow-back fires; inc 2: clean finish
+    chaos = {"0": {"kill": {"host": 1, "after": 3}},
+             "1": {"preempt": {"after": 2}}}
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=2,
+        argv=fixture_argv(MAX_STEPS, 2, chaos),
+        env=WORKER_ENV, exit_grace_s=10.0,
+        incarnation_timeout_s=600.0)
+    backend = LocalProvisioner(delay_s=0.3, fail_next=1)
+    sup = Supervisor(spec,
+                     RestartPolicy(max_restarts=4, replace=True,
+                                   replace_budget=2),
+                     obs_port=obs_port, provisioner=backend)
+    t0 = time.time()
+    rep = sup.run()
+    print(f"  report: "
+          f"{json.dumps({k: v for k, v in rep.items() if k != 'decisions'})}"
+          f" ({time.time() - t0:.0f}s)", flush=True)
+    check(rep["status"] == "completed", "run completed unattended")
+    rules = [d["rule"] for d in rep["decisions"]]
+    check(rules[:2] == ["crash-replace", "replace-fallback-shrink"],
+          f"replace fell back to the classic shrink ({rules})")
+    check("preempt-resume" in rules,
+          f"shrunken incarnation preempted then resumed ({rules})")
+    check(rep["world"] == 2 and rep["excluded"] == [],
+          f"grow-back restored FULL width (world={rep['world']} "
+          f"excluded={rep['excluded']})")
+    check(rep["replacements_used"] == 2,
+          f"both the failed attempt and the grow-back charged the "
+          f"replace budget ({rep['replacements_used']}/2)")
+    check(rep["replaced"] == [1], f"slot 1 readmitted ({rep['replaced']})")
+    # incarnation 1 ran SHRUNKEN (host 0 only, dp=1); incarnation 2
+    # ran at the restored width — both hold the elastic equivalence
+    _, recs1 = parse_worker_log(run_dir, 1, 0)
+    check(bool(recs1), "shrunken incarnation made progress")
+    check(not os.path.exists(os.path.join(
+              run_dir, "supervisor_logs", "inc1_host1.log")),
+          "shrunken incarnation really ran without host 1")
+    _, recs2 = parse_worker_log(run_dir, 2, 0)
+    steps2 = sorted(recs2)
+    check(steps2 and steps2[-1] == MAX_STEPS - 1,
+          f"restored-width incarnation finished ({steps2})")
+    ref = reference_run_world1(tmp, MAX_STEPS)
+    merged = {}
+    for r in ({}, recs1, recs2):
+        merged.update(r)
+    worst = max(abs(merged[s] - ref[s]) for s in merged)
+    check(worst < LOSS_ATOL,
+          f"dp2 -> dp1 -> dp2 trajectory matches the reference "
+          f"(max |delta| {worst:.2e} < {LOSS_ATOL})")
+    # the timeline names the whole arc: failed provision, fallback,
+    # grow-back readmission
+    events = [json.loads(line) for line in open(
+        os.path.join(run_dir, "supervisor_events.jsonl"))]
+    kinds = [e.get("event") for e in events]
+    check("provision_failed" in kinds and "grow_back" in kinds,
+          f"event timeline carries provision_failed + grow_back "
+          f"({kinds})")
+    gb = next(e for e in events if e.get("event") == "grow_back")
+    check(gb.get("slot") == 1 and gb.get("world") == 2,
+          f"grow_back event names slot 1 / world 2 ({gb})")
+    # goodput: the 0.3s cold provision window is real, attributed
+    # downtime — and the ledger still sums to wall clock
+    doc = fleet_summary(obs_port)
+    g = doc.get("goodput_supervisor") or {}
+    ok, gap = check_sum(g)
+    check(ok, f"goodput buckets sum to wall clock (gap {gap:.3f})")
+    buckets = g.get("buckets", {})
+    check(buckets.get("down:provisioning", 0.0) >= 0.25,
+          f"cold provisioning window (>=0.3s injected) lands in "
+          f"down:provisioning ({buckets.get('down:provisioning')})")
+    check(buckets.get("up:replaced", 0.0) > 0.0,
+          f"post-grow-back relaunch attributed to up:replaced "
+          f"({sorted(buckets)})")
+
+
+def main() -> int:
+    t0 = time.time()
+    with tempfile.TemporaryDirectory(prefix="chaos_replace_") as tmp:
+        scenario_replace(tmp)
+        # the telemetry server is process-global and outlives run()
+        # (deliberately — the scrape-after-completion contract); drop
+        # it so scenario B's supervisor serves /fleet on its own port
+        from torchacc_tpu.obs import server as obs_server
+        obs_server.stop()
+        obs_server.clear_registries()
+        scenario_growback(tmp)
+    print(f"chaos-replace PASSED in {time.time() - t0:.0f}s",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Host-replacement unit tests (torchacc_tpu/supervisor/provisioner.py
++ the policy replace rules + the shard-owner election + the
+coordination-service barrier — docs/resilience.md "Host replacement &
+grow-back").
+
+The contracts under test:
+
+- ``LocalProvisioner``: capacity accounting, injected failures
+  (``fail_next`` — the chaos hook), release returning capacity;
+- ``SparePool``: pre-warm at construction, O(1) warm pop, cold
+  fallthrough on exhaustion, prewarm shortfall recorded not fatal,
+  close releasing unspent spares;
+- the policy engine's replace rules: ``crash-replace`` on the
+  kill -9 signature (nonzero exit, NO disposition bundle, a named
+  failed slot), ``sdc-replace`` preferred over exclusion while budget
+  lasts, ``fallback_exclude`` when provisioning fails (shrink, or
+  give-up below min_world), ``charge_replacement``/``readmit`` for
+  grow-back;
+- ``assign_shard_owners``: minimal-host election over the allgathered
+  (world, regions) holder matrix — deterministic pod-wide, -1 marks
+  an uncoverable region;
+- ``rendezvous_barrier``: filesystem rendezvous with NO device
+  collective — releases when all ranks arrive, times out NAMING the
+  missing ranks (the asymmetric-membership failure a device barrier
+  turns into a silent wedge).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from torchacc_tpu.checkpoint.tiered import assign_shard_owners
+from torchacc_tpu.resilience.coordination import (
+    fs_barrier_sync_fn,
+    rendezvous_barrier,
+)
+from torchacc_tpu.supervisor import (
+    LocalProvisioner,
+    PolicyEngine,
+    ProvisionError,
+    ProvisionRequest,
+    RestartPolicy,
+    SparePool,
+    build_provisioner,
+)
+
+pytestmark = pytest.mark.supervisor
+
+
+def _req(slot=1, rule="crash-replace"):
+    return ProvisionRequest(slot=slot, rule=rule, incarnation=0)
+
+
+# -- LocalProvisioner ---------------------------------------------------------
+
+def test_local_provisioner_capacity_exhaustion_and_release():
+    p = LocalProvisioner(capacity=1)
+    g = p.provision(_req())
+    assert g.slot == 1 and g.origin == "local" and not g.warm
+    with pytest.raises(ProvisionError, match="capacity exhausted"):
+        p.provision(_req(slot=2))
+    assert p.capacity() == 0
+    p.release(g)
+    assert p.capacity() == 1
+    assert p.provision(_req(slot=2)).slot == 2
+
+
+def test_local_provisioner_fail_next_injected_failures():
+    p = LocalProvisioner()
+    p.fail_next(2)
+    for _ in range(2):
+        with pytest.raises(ProvisionError, match="injected failure"):
+            p.provision(_req())
+    g = p.provision(_req())
+    assert g.slot == 1
+    assert p.stats()["failures"] == 2 and p.stats()["granted"] == 1
+
+
+def test_local_provisioner_delay_uses_injected_sleep():
+    slept = []
+    p = LocalProvisioner(delay_s=0.7, sleep=slept.append)
+    g = p.provision(_req())
+    assert slept == [0.7] and g.latency_s == 0.7
+
+
+# -- SparePool ----------------------------------------------------------------
+
+def test_spare_pool_warm_pop_then_cold_fallthrough():
+    pool = SparePool(LocalProvisioner(), spares=1)
+    assert pool.spares_left() == 1
+    warm = pool.provision(_req())
+    assert warm.warm and pool.spares_left() == 0
+    cold = pool.provision(_req(slot=2))
+    assert not cold.warm
+    st = pool.stats()
+    assert st["warm_hits"] == 1 and st["cold_provisions"] == 1
+    assert st["spares_prewarmed"] == 1
+
+
+def test_spare_pool_prewarm_shortfall_is_recorded_not_fatal():
+    pool = SparePool(LocalProvisioner(capacity=1), spares=3)
+    st = pool.stats()
+    assert st["spares_requested"] == 3 and st["spares_prewarmed"] == 1
+    # the one prewarmed spare serves warm; then the backend (capacity
+    # fully consumed by the prewarm) fails the cold path
+    assert pool.provision(_req()).warm
+    with pytest.raises(ProvisionError):
+        pool.provision(_req(slot=2))
+
+
+def test_spare_pool_close_releases_unspent_spares():
+    backend = LocalProvisioner(capacity=2)
+    pool = SparePool(backend, spares=2)
+    assert backend.capacity() == 0
+    pool.close()
+    assert backend.capacity() == 2
+
+
+def test_build_provisioner_kinds():
+    assert isinstance(build_provisioner("local"), LocalProvisioner)
+    pool = build_provisioner("local", spares=1)
+    assert isinstance(pool, SparePool) and pool.spares_left() == 1
+    with pytest.raises(NotImplementedError):
+        build_provisioner("gke").provision(_req())
+    with pytest.raises(ValueError):
+        build_provisioner("nonesuch")
+
+
+# -- policy replace rules -----------------------------------------------------
+
+def _engine(**kw):
+    kw.setdefault("replace", True)
+    return PolicyEngine(RestartPolicy(**kw), 4)
+
+
+def test_policy_crash_replace_on_kill_signature():
+    e = _engine()
+    a = e.decide(None, exit_code=-9, failed_hosts=[2])
+    assert a.kind == "replace" and a.rule == "crash-replace"
+    assert a.hosts == (2,)
+    assert e.replacements_used == 1 and e.world == 4
+    e.note_replaced(a.hosts)
+    assert e.replaced == {2} and not e.excluded
+
+
+def test_policy_crash_replace_requires_no_disposition():
+    # a typed error wrote a bundle on the way out: software, not
+    # vanished hardware — the crash path, never a replacement
+    from torchacc_tpu.supervisor import ExitDisposition
+    e = _engine()
+    d = ExitDisposition(reason="CheckpointError",
+                        error_type="CheckpointError")
+    a = e.decide(d, exit_code=1, failed_hosts=[2])
+    assert a.rule == "crash-backoff" and e.replacements_used == 0
+
+
+def test_policy_crash_replace_budget_then_crash_path():
+    e = _engine(replace_budget=1)
+    assert e.decide(None, exit_code=-9,
+                    failed_hosts=[1]).rule == "crash-replace"
+    # budget spent: the same signature degrades to the crash bound
+    a = e.decide(None, exit_code=-9, failed_hosts=[1])
+    assert a.rule == "crash-backoff"
+
+
+def test_policy_replace_off_keeps_classic_behaviour():
+    e = PolicyEngine(RestartPolicy(), 4)
+    a = e.decide(None, exit_code=-9, failed_hosts=[1])
+    assert a.rule == "crash-backoff" and e.replacements_used == 0
+
+
+def test_policy_sdc_replace_preferred_then_fallback_exclude():
+    from torchacc_tpu.supervisor import ExitDisposition
+    e = _engine()
+    d = ExitDisposition(reason="SDCError", error_type="SDCError",
+                        flagged_step=3, hosts=[1],
+                        quarantine_delta=[1])
+    a = e.decide(d, exit_code=1)
+    assert a.kind == "replace" and a.rule == "sdc-replace"
+    assert a.hosts == (1,) and e.world == 4
+    # provisioning failed: the daemon takes the budget-bounded
+    # fallback — the classic exclude+shrink under its own rule
+    fb = e.fallback_exclude(a.hosts, why="no capacity")
+    assert fb.kind == "restart_excluding"
+    assert fb.rule == "replace-fallback-shrink"
+    assert e.excluded == {1} and e.world == 3
+
+
+def test_policy_fallback_exclude_below_min_world_gives_up():
+    e = _engine(min_world=4)
+    a = e.decide(None, exit_code=-9, failed_hosts=[0])
+    assert a.kind == "replace"
+    fb = e.fallback_exclude(a.hosts, why="no capacity")
+    assert fb.kind == "give_up" and "min_world" in fb.reason
+
+
+def test_policy_charge_replacement_and_readmit_grow_back():
+    e = _engine(replace_budget=2)
+    a = e.decide(None, exit_code=-9, failed_hosts=[3])
+    fb = e.fallback_exclude(a.hosts, why="boom")
+    assert fb.kind == "restart_excluding" and e.world == 3
+    # grow-back: one budget unit left — charge it, then readmit
+    assert e.charge_replacement()
+    assert e.readmit([3]) == 4
+    assert e.world == 4 and not e.excluded and e.replaced == {3}
+    # budget exhausted: no further grow-back attempts
+    assert not e.charge_replacement()
+
+
+# -- shard-owner election -----------------------------------------------------
+
+def test_assign_shard_owners_minimal_host_election():
+    # 3 hosts x 4 regions; region 2 held by hosts {1, 2} -> min = 1;
+    # region 3 held by nobody -> -1
+    m = np.array([[1, 0, 0, 0],
+                  [0, 1, 1, 0],
+                  [1, 0, 1, 0]], dtype=bool)
+    assert assign_shard_owners(m) == [0, 1, 1, -1]
+
+
+def test_assign_shard_owners_shapes():
+    assert assign_shard_owners(np.zeros((2, 0), dtype=bool)) == []
+    with pytest.raises(ValueError):
+        assign_shard_owners(np.zeros(3, dtype=bool))
+
+
+# -- coordination-service barrier ---------------------------------------------
+
+def test_rendezvous_barrier_releases_when_all_arrive(tmp_path):
+    root = str(tmp_path)
+    errs = []
+
+    def arrive(rank):
+        try:
+            rendezvous_barrier(root, "commit-1", world=3, rank=rank,
+                               timeout_s=30.0, poll_s=0.01)
+        except Exception as e:  # noqa: BLE001 - collected for assert
+            errs.append(e)
+
+    ts = [threading.Thread(target=arrive, args=(r,)) for r in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert not errs and not any(t.is_alive() for t in ts)
+
+
+def test_rendezvous_barrier_timeout_names_missing_ranks(tmp_path):
+    from torchacc_tpu.errors import CoordinationError
+    with pytest.raises(CoordinationError,
+                       match=r"rank\(s\) \[1, 2\] never arrived"):
+        rendezvous_barrier(str(tmp_path), "commit-2", world=3, rank=0,
+                           timeout_s=0.2, poll_s=0.01)
+
+
+def test_rendezvous_barrier_reuses_key_across_steps(tmp_path):
+    # the SAME key must be usable again (later checkpoint steps reuse
+    # orbax's barrier names): each rendezvous cleans up after itself
+    root = str(tmp_path)
+    for _ in range(2):
+        ts = [threading.Thread(
+            target=rendezvous_barrier, args=(root, "commit"),
+            kwargs=dict(world=2, rank=r, timeout_s=30.0, poll_s=0.01))
+            for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not any(t.is_alive() for t in ts)
+
+
+def test_fs_barrier_sync_fn_single_process_noop(tmp_path):
+    sync = fs_barrier_sync_fn(str(tmp_path), world=1, rank=0)
+    sync(key="orbax-commit-0", timeout_ms=50)
+    assert not os.listdir(str(tmp_path))

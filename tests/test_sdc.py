@@ -163,6 +163,55 @@ def test_divergence_report_names_first_leaf():
     assert "1/3 leaves" in lines[0]
 
 
+def _dp2_monitor(tmp_path):
+    from torchacc_tpu.resilience.sdc import SDCMonitor
+    cfg = ta.Config(dist=ta.DistConfig(dp=ta.DPConfig(size=2)),
+                    resilience=ta.ResilienceConfig(
+                        sdc_check_interval_steps=1))
+    mesh = cfg.get_mesh(jax.devices()[:2])
+    return SDCMonitor(cfg.resilience, mesh, ["a", "b"],
+                      run_dir=str(tmp_path))
+
+
+def test_dp2_tie_third_execution_localizes_flaky_replica(
+        devices, tmp_path):
+    # dp=2 even split where in-step digest and recompute AGREE per
+    # replica (neither self-localizes): the third execution gives
+    # three samples — the replica whose three runs are not unanimous
+    # is the intermittently flaky one, majority-voted and quarantined
+    mon = _dp2_monitor(tmp_path)
+    d = np.repeat(np.arange(6, dtype=np.uint32).reshape(1, 2, 3),
+                  2, axis=0)
+    d[1, 0, 0] ^= 0x40                        # 1-vs-1 tie
+    runs = [d.copy(), d.copy()]               # redo, then third
+    runs[1][1, 0, 0] ^= 0x7                   # replica 1 flakes again
+    calls = iter(runs)
+    with pytest.raises(SDCError) as ei:
+        mon.observe(5, d, check=True, spot=False,
+                    recompute=lambda: next(calls))
+    assert counters.get("sdc_third_executions") == 1
+    assert ei.value.kind == "replica"
+    assert ei.value.hosts == sorted({h for h in mon.replica_hosts[1]})
+    assert read_quarantined_hosts(str(tmp_path))  # localized verdict
+
+
+def test_dp2_tie_three_way_unanimous_stays_unlocalized(
+        devices, tmp_path):
+    # every execution of every replica reproduces its own digests:
+    # persistent, unattributed corruption — named, never quarantined
+    mon = _dp2_monitor(tmp_path)
+    d = np.repeat(np.arange(6, dtype=np.uint32).reshape(1, 2, 3),
+                  2, axis=0)
+    d[1, 0, 0] ^= 0x40
+    with pytest.raises(SDCError) as ei:
+        mon.observe(5, d, check=True, spot=False,
+                    recompute=lambda: d.copy())
+    assert counters.get("sdc_third_executions") == 1
+    assert ei.value.hosts == [0, 1]           # the whole divergent set
+    assert "NOT localized" in str(ei.value)
+    assert read_quarantined_hosts(str(tmp_path)) == {}
+
+
 def test_flip_operands_inactive_without_plan():
     ops = flip_operands(3, 4, [[0], [1], [2], [3]], ["a", "b"], "step")
     assert not ops["mask"].any() and int(ops["leaf"]) == -1

@@ -25,6 +25,14 @@ SDC triage (docs/resilience.md "SDC defense"):
   step and printing the *gradient* digests) is
   ``Trainer.fit(replay_step=N)``, which needs the model; this command
   needs only the checkpoint.
+
+Fleet operations (docs/resilience.md "Host replacement & grow-back"):
+
+- ``supervise``: run the jax-free supervisor daemon (launch, sense,
+  decide, restart — and with ``--replace``, provision replacement
+  hosts / grow a shrunk pod back).
+- ``fleet-history``: print a supervised run's quarantine/replacement
+  timeline from the daemon's event journal, jax-free.
 """
 
 from __future__ import annotations
@@ -219,6 +227,72 @@ def _cmd_replay(args) -> int:
     return 0
 
 
+def _cmd_fleet_history(args) -> int:
+    """The quarantine/replacement timeline of a supervised run — the
+    daemon's decision/provision/grow-back event journal plus the
+    current quarantine file, rendered oldest-first.  Deliberately
+    jax-free (filename literals match supervisor/daemon.py
+    EVENTS_FILE / QUARANTINE_FILE)."""
+    events_path = os.path.join(args.run_dir, "supervisor_events.jsonl")
+    quarantine_path = os.path.join(args.run_dir, "sdc_quarantine.json")
+    events = []
+    try:
+        with open(events_path, "rb") as f:
+            for line in f.read().splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    events.append(rec)
+    except OSError:
+        pass
+    quarantine = {}
+    try:
+        with open(quarantine_path) as f:
+            q = json.load(f)
+        if isinstance(q, dict):
+            quarantine = q
+    except (OSError, ValueError):
+        pass
+    if args.json:
+        print(json.dumps({"run_dir": args.run_dir, "events": events,
+                          "quarantine": quarantine}, indent=2,
+                         sort_keys=True))
+        return 0
+    if not events and not quarantine:
+        print(f"no fleet history under {args.run_dir} (no "
+              f"supervisor_events.jsonl, no quarantine file)")
+        return 0
+    print(f"fleet history of {args.run_dir} ({len(events)} event(s)):")
+    for rec in events:
+        t = rec.get("time")
+        try:
+            import datetime
+            stamp = datetime.datetime.fromtimestamp(
+                float(t)).strftime("%H:%M:%S") if t else "--:--:--"
+        except (TypeError, ValueError, OverflowError):
+            stamp = "--:--:--"
+        inc = rec.get("incarnation", "?")
+        kind = rec.get("event", "?")
+        detail = {k: v for k, v in rec.items()
+                  if k not in ("time", "incarnation", "event")}
+        body = " ".join(f"{k}={v}" for k, v in sorted(detail.items()))
+        print(f"  {stamp} inc={inc:<3} {kind:<18} {body}")
+    if quarantine:
+        print(f"quarantined now ({len(quarantine)} host(s)):")
+        for h in sorted(quarantine, key=str):
+            info = quarantine[h]
+            body = (" ".join(f"{k}={v}" for k, v in sorted(info.items()))
+                    if isinstance(info, dict) else str(info))
+            print(f"  host {h}: {body}")
+    else:
+        print("quarantined now: none")
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "replay":
@@ -277,6 +351,33 @@ def main(argv=None) -> int:
         p.add_argument("--obs-port", type=int, default=None,
                        help="serve the supervisor's own /metrics "
                             "(supervisor_* counters) here")
+        p.add_argument("--replace", action="store_true",
+                       help="answer crash/SDC host loss by "
+                            "PROVISIONING a replacement (budget-"
+                            "bounded) before falling back to "
+                            "exclude+shrink, and grow excluded slots "
+                            "back when capacity allows "
+                            "(docs/resilience.md 'Host replacement & "
+                            "grow-back')")
+        p.add_argument("--replace-budget", type=int, default=2,
+                       help="total replacement/grow-back attempts "
+                            "charged across the run")
+        p.add_argument("--no-grow-back", action="store_true",
+                       help="replace failed hosts but never re-expand "
+                            "a previously shrunk pod")
+        p.add_argument("--provisioner", default="local",
+                       choices=("local", "gke", "ray"),
+                       help="where replacement capacity comes from "
+                            "(gke/ray are typed stubs)")
+        p.add_argument("--spares", type=int, default=0,
+                       help="pre-warm this many hot-spare hosts at "
+                            "startup (SparePool)")
+        p.add_argument("--provision-capacity", type=int, default=None,
+                       help="local provisioner: total grants before "
+                            "capacity exhaustion (default unbounded)")
+        p.add_argument("--provision-delay-s", type=float, default=0.0,
+                       help="local provisioner: simulated cold "
+                            "acquisition latency")
         p.add_argument("--env", action="append", default=[],
                        metavar="KEY=VALUE",
                        help="extra worker environment (repeatable; "
@@ -296,6 +397,18 @@ def main(argv=None) -> int:
         # never initialises a device backend
         from torchacc_tpu.supervisor.daemon import main_from_args
         return main_from_args(args)
+    if argv and argv[0] == "fleet-history":
+        p = argparse.ArgumentParser(
+            prog="consolidate_and_reshard_ckpts fleet-history",
+            description="Print the quarantine/replacement timeline of "
+                        "a supervised run: the daemon's event journal "
+                        "(decisions, provision attempts, grow-backs, "
+                        "quarantine clears) plus the current "
+                        "quarantine file.  Pure filesystem, jax-free.")
+        p.add_argument("run_dir", help="the supervisor --run-dir")
+        p.add_argument("--json", action="store_true",
+                       help="machine-readable output")
+        return _cmd_fleet_history(p.parse_args(argv[1:]))
     if argv and argv[0] == "inspect":
         p = argparse.ArgumentParser(
             prog="consolidate_and_reshard_ckpts inspect",

@@ -364,6 +364,22 @@ def _restack_legacy_layers(tree: Any) -> tuple[Any, bool]:
     return walk(tree), changed
 
 
+def supports_custom_barrier() -> bool:
+    """Whether the installed orbax exposes the
+    ``AsyncOptions(barrier_sync_fn=...)`` seam the coordination-service
+    barrier threads through (present since orbax 0.5.x; probed rather
+    than version-compared so a vendored/backported orbax answers
+    honestly)."""
+    try:
+        import inspect
+
+        from orbax.checkpoint import options as ocp_options
+        return ("barrier_sync_fn"
+                in inspect.signature(ocp_options.AsyncOptions).parameters)
+    except Exception:  # noqa: BLE001 - any import/introspection failure
+        return False
+
+
 class CheckpointManager:
     """Step-tracked checkpoint directory with retention, commit markers,
     integrity validation, and retried I/O.
@@ -396,7 +412,11 @@ class CheckpointManager:
                  save_interval_steps: int = 1,
                  retry_policy: Optional[RetryPolicy] = None,
                  coord_timeout_s: Optional[float] = None,
-                 elastic_resume: bool = False):
+                 elastic_resume: bool = False,
+                 barrier: str = "device"):
+        if barrier not in ("device", "fs"):
+            raise ValueError(
+                f"barrier must be 'device' or 'fs', got {barrier!r}")
         self._dir = os.path.abspath(directory)
         self._retry = (retry_policy if retry_policy is not None
                        else RetryPolicy(max_retries=3))
@@ -416,12 +436,56 @@ class CheckpointManager:
         # manager while healthy peers reuse theirs — the tiered
         # peer-restore path, checkpoint/tiered.py)
         os.makedirs(self._dir, exist_ok=True)
+        # coordination-service barrier (docs/resilience.md "Host
+        # replacement & grow-back"): with barrier="fs", none of this
+        # manager's cross-process synchronisation runs a DEVICE
+        # collective — the async-commit/finalize barrier becomes the
+        # filesystem rendezvous (resilience/coordination.py, keyed
+        # under the checkpoint dir itself) and the remaining orbax
+        # save-path barriers are routed to the jax.distributed
+        # coordination client (gRPC) by naming the active process set.
+        # That makes save() legal from a background thread while the
+        # training loop owns the devices (the tiered trickle path) and
+        # keeps a commit from wedging the mesh when pod membership is
+        # asymmetric mid-replacement.  Capability-probed: an orbax
+        # without the AsyncOptions seam falls back to device barriers
+        # with a warning (tiered keeps its pump() fallback).
+        self._barrier = barrier
+        extra_options: Dict[str, Any] = {}
+        if barrier == "fs":
+            if supports_custom_barrier():
+                from orbax.checkpoint import options as ocp_options
+
+                from torchacc_tpu.resilience.coordination import (
+                    fs_barrier_sync_fn,
+                    process_count,
+                )
+                extra_options["async_options"] = ocp_options.AsyncOptions(
+                    barrier_sync_fn=fs_barrier_sync_fn(self._dir))
+                pc = process_count()
+                if pc > 1:
+                    extra_options["multiprocessing_options"] = (
+                        ocp_options.MultiprocessingOptions(
+                            active_processes=set(range(pc))))
+            else:
+                logger.warning(
+                    "checkpoint: this orbax has no "
+                    "AsyncOptions(barrier_sync_fn=...) seam — falling "
+                    "back to device barriers (barrier='device')")
+                self._barrier = "device"
         self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             create=False,
+            **extra_options,
         )
         self._mgr = ocp.CheckpointManager(self._dir, options=self._options)
+
+    @property
+    def barrier_kind(self) -> str:
+        """The EFFECTIVE barrier backend: 'fs' only when requested AND
+        the installed orbax supports the custom-barrier seam."""
+        return self._barrier
 
     # -- save ---------------------------------------------------------------
     def should_save(self, step: int) -> bool:

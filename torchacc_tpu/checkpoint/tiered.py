@@ -58,6 +58,7 @@ from torchacc_tpu.checkpoint.io import (
     LOADER_STATE,
     MANIFEST,
     CheckpointManager,
+    supports_custom_barrier,
 )
 from torchacc_tpu.checkpoint.schema import tree_digest
 from torchacc_tpu.errors import (
@@ -78,6 +79,92 @@ TIERED_STATUS = "_TIERED"
 _STOP = object()
 
 
+class _ConsensusFallback(CheckpointError):
+    """RAM restore declined by a POD-WIDE agreed decision (the
+    allgathered holder matrix showed uncovered regions): every host
+    raises this from the same branch, so catching it multi-host and
+    falling back to the durable tiers keeps collectives aligned —
+    unlike an arbitrary per-host exception, which must propagate."""
+
+
+class _ShardSnap:
+    """Tier-0 capture of ONE leaf on a host that cannot address the
+    full array: only the shards local devices hold, keyed by the
+    canonical ``(start, stop)``-per-dim region tuple.  Restore
+    reassembles the global array from every host's holdings
+    (shard-aware donor selection in
+    :meth:`TieredCheckpointManager._restore_from_ram`)."""
+
+    __slots__ = ("shape", "dtype", "shards")
+
+    def __init__(self, shape, dtype, shards):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.shards = shards       # Dict[region key, np.ndarray]
+
+
+def _region_key(index, shape):
+    """Canonical hashable key for a shard region: ``(start, stop)`` per
+    dimension with Nones resolved against ``shape`` — identical on
+    every host for the same global slice regardless of how jax spelled
+    it."""
+    key = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        key.append((start, stop))
+    return tuple(key)
+
+
+def _leaf_regions(a) -> List[tuple]:
+    """Distinct shard regions of an abstract leaf's target sharding, in
+    canonical sorted order.  Derived from ``devices_indices_map``,
+    which is GLOBAL (identical on every host), so the pod-wide holder
+    matrix indexes the same region list everywhere."""
+    idx_map = a.sharding.devices_indices_map(tuple(a.shape))
+    return sorted({_region_key(ix, a.shape) for ix in idx_map.values()})
+
+
+def _fetch_addressable_shards(snap):
+    """Per-leaf fallback capture when the whole-tree ``device_get``
+    fails (multi-host: non-addressable shards).  Returns a tree with
+    :class:`_ShardSnap` leaves — a *partial* tier-0 snapshot that
+    gives real pods a RAM tier for the first time — or None when even
+    the local shards cannot be read."""
+    try:
+        import jax
+
+        def grab(x):
+            if x is None:
+                return None
+            shards = {}
+            for sh in x.addressable_shards:
+                shards[_region_key(sh.index, x.shape)] = \
+                    np.asarray(sh.data)
+            return _ShardSnap(x.shape, x.dtype, shards)
+        return jax.tree.map(grab, snap, is_leaf=lambda v: v is None)
+    except Exception:  # noqa: BLE001 - no RAM tier beats a dead writer
+        return None
+
+
+def assign_shard_owners(holder_matrix) -> List[int]:
+    """Donor selection, pure and jax-free (unit-testable): given a
+    ``(world, regions)`` bool matrix of who holds what, the owner of
+    each region is the SMALLEST holding host — every host computes the
+    same assignment from the same allgathered matrix, so each donor
+    broadcasts exactly the regions assigned to it and nothing twice.
+    ``-1`` marks an uncovered region (the pod then falls back to the
+    durable tiers, together)."""
+    m = np.asarray(holder_matrix, dtype=bool)
+    if m.ndim != 2:
+        raise ValueError("holder matrix must be (world, regions)")
+    owners: List[int] = []
+    for r in range(m.shape[1]):
+        holders = np.flatnonzero(m[:, r])
+        owners.append(int(holders[0]) if holders.size else -1)
+    return owners
+
+
 @dataclasses.dataclass
 class _Entry:
     """One submitted save riding the trickle."""
@@ -88,6 +175,7 @@ class _Entry:
     loader_state: Optional[Dict[str, Any]] = None
     guard_state: Any = None        # device tree / callable / dict
     host: Any = None               # tier-0 numpy tree (writer-filled)
+    host_partial: bool = False     # host is a per-shard partial capture
     verdicted: bool = False
     durable: bool = False
     mirrored: bool = False
@@ -137,15 +225,27 @@ class TieredCheckpointManager:
         # peers whose managers already exist (consensus probing below
         # reads manifests straight off the filesystem instead).
         self._inner: Optional[CheckpointManager] = None
+        # Multi-host, the inner managers run their cross-process commit
+        # barriers over the coordination service (filesystem/gRPC
+        # rendezvous, io.py ``barrier="fs"``) instead of device
+        # collectives whenever this orbax supports pluggable barriers.
+        # Two things fall out: the writer-THREAD tier-1 commit becomes
+        # legal on a pod (no device collective to interleave with
+        # training — see ``_defer_t1_to_main`` below), and the barrier
+        # keeps working under asymmetric membership (a replacement host
+        # joining mid-history has no shared device-collective past).
+        t1_barrier = ("fs" if coord.process_count() > 1
+                      and supports_custom_barrier() else "device")
         self._inner_kwargs = dict(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
             retry_policy=retry_policy, coord_timeout_s=coord_timeout_s,
-            elastic_resume=elastic_resume)
+            elastic_resume=elastic_resume, barrier=t1_barrier)
         self._mirror_inner: Optional[CheckpointManager] = None
         self._mirror_kwargs = dict(retry_policy=retry_policy,
                                    coord_timeout_s=coord_timeout_s,
-                                   elastic_resume=elastic_resume)
+                                   elastic_resume=elastic_resume,
+                                   barrier=t1_barrier)
         # writer machinery: entries flow FIFO through a queue; _cond
         # guards _entries/_watermark and wakes gate-waiters
         self._queue: "queue.Queue[Any]" = queue.Queue()
@@ -155,16 +255,20 @@ class TieredCheckpointManager:
         self._watermark = -1        # verdicts resolved through this step
         self._last_submitted = -1
         self._thread: Optional[threading.Thread] = None
-        # multi-process: the tier-1 orbax write carries cross-process
-        # barriers that this orbax implements as DEVICE collectives —
-        # issuing them from a background thread while the main thread
-        # trains interleaves two collective streams differently per
-        # process and deadlocks the pod.  So on a pod the main thread
-        # pumps the tier-1 write at deterministic step boundaries
-        # (watermark-gated, identical on every host); the writer thread
-        # keeps the collective-free work (tier-0 host fetch, tier-2
-        # file mirroring).  Single-process keeps the fully-async path.
-        self._defer_t1_to_main = coord.process_count() > 1
+        # multi-process: with the DEVICE barrier, the tier-1 orbax
+        # write carries cross-process barriers implemented as device
+        # collectives — issuing them from a background thread while the
+        # main thread trains interleaves two collective streams
+        # differently per process and deadlocks the pod, so the main
+        # thread pumps the write at deterministic step boundaries.
+        # With the coordination-service barrier (``t1_barrier == "fs"``
+        # above) the commit carries NO device collectives: writer
+        # threads process identical FIFO step sequences pod-wide and
+        # rendezvous through the filesystem/gRPC barrier, so the fully
+        # async path is legal on pods too and ``pump`` degrades to the
+        # fallback for orbax builds without pluggable barriers.
+        self._defer_t1_to_main = (coord.process_count() > 1
+                                  and t1_barrier != "fs")
 
     # -- save side (hot path) ------------------------------------------------
     def should_save(self, step: int) -> bool:
@@ -252,16 +356,27 @@ class TieredCheckpointManager:
         # in the save path — and it runs on THIS thread
         failpoint("tiered.tier0", step=e.step)
         host = None
+        partial = False
         try:
             import jax
             with tracing.span("ckpt/tier0_fetch", step=e.step):
                 host = jax.device_get(e.snap)
         except Exception as err:  # noqa: BLE001 - multi-host shards not
-            # fully addressable here: no RAM tier for this step; tier 1
-            # writes straight from the device snapshot via orbax's own
-            # sharded-array path
-            logger.debug(f"tiered checkpoint: tier-0 host fetch of step "
-                         f"{e.step} unavailable ({err!r})")
+            # fully addressable here: fall back to capturing only THIS
+            # host's addressable shards, which gives real pods a RAM
+            # tier at all — restore reassembles the global state from
+            # every host's holdings (shard-aware donor selection in
+            # _restore_from_ram).  Tier 1 still writes straight from
+            # the device snapshot via orbax's own sharded-array path.
+            with tracing.span("ckpt/tier0_shard_fetch", step=e.step):
+                host = _fetch_addressable_shards(e.snap)
+            partial = host is not None
+            if partial:
+                counters.inc("tier0_shard_captures")
+            else:
+                logger.debug(
+                    f"tiered checkpoint: tier-0 host fetch of step "
+                    f"{e.step} unavailable ({err!r})")
         if callable(e.guard_state):
             try:
                 e.guard_state = e.guard_state()
@@ -288,6 +403,7 @@ class TieredCheckpointManager:
                 e.guard_state = None
         with self._cond:
             e.host = host
+            e.host_partial = partial
         # verdict gate: tier 1 must not commit a step whose lagged
         # guard/SDC verdict is still pending.  An abort never advances
         # the watermark past the flagged step, so this entry is later
@@ -315,10 +431,13 @@ class TieredCheckpointManager:
                 if not was_durable:
                     return
         else:
-            e.snap = None if host is not None else e.snap
-            # tier 1 from the host tree fetched above (the device
-            # snapshot was released; a failed fetch keeps it as src)
-            self._write_tier1(e, host if host is not None else e.snap)
+            full_host = host is not None and not partial
+            e.snap = None if full_host else e.snap
+            # tier 1 from the host tree fetched above when it is a FULL
+            # capture; a partial (per-shard) capture keeps the device
+            # snapshot as src — orbax's sharded-array path writes the
+            # global array, which a per-host shard dict is not
+            self._write_tier1(e, host if full_host else e.snap)
             e.snap = None
         # tier 2: mirror the committed step dir, marker last — pure
         # file I/O, safe on this thread in every topology.  Isolated
@@ -635,6 +754,12 @@ class TieredCheckpointManager:
                                                ram_local)
                 self._rewind(best_ram)
                 return state, best_ram
+            except _ConsensusFallback as e:
+                # the decline came from the allgathered holder matrix —
+                # identical on every host, so the whole pod leaves the
+                # RAM tier together and the durable consensus below
+                # stays collective-aligned
+                logger.warning(str(e))
             except Exception as e:  # noqa: BLE001
                 if coord.process_count() > 1:
                     # a divergent per-host fallback would wedge the pod
@@ -677,62 +802,99 @@ class TieredCheckpointManager:
     def _restore_from_ram(self, abstract_state: Any, best_ram: int,
                           ram_local: int):
         """Place a verdicted tier-0 snapshot into the target shardings
-        through the compiled layout-transfer engine; multi-host, the
-        donor's snapshot is broadcast to the pod first (peer restore)."""
+        through the compiled layout-transfer engine.  Multi-host, every
+        host first reports what it holds (a full tree, or per-shard
+        regions from the partial capture) over one
+        :func:`~torchacc_tpu.resilience.coordination.allgather_flags`;
+        a full-tree holder donates the whole state (the fast path),
+        otherwise shard-aware donor selection assigns each region of
+        the target layout to its smallest holder and each donor
+        broadcasts ONLY its owned regions — so a replacement host
+        hydrates from healthy peers even when no single peer can
+        address the whole state."""
         me = coord.process_index()
         nprocs = coord.process_count()
+        with self._cond:
+            entry = self._entries.get(best_ram)
+        payload = entry.host if entry is not None else None
+        partial = bool(entry.host_partial) if entry is not None else False
         if nprocs == 1:
-            with self._cond:
-                entry = self._entries.get(best_ram)
-            host = entry.host if entry is not None else None
-            if host is None:
+            if payload is None:
                 raise CheckpointError(
                     f"tiered checkpoint: tier-0 snapshot of step "
                     f"{best_ram} is gone")
-            ok = tree_digest(host) == tree_digest(abstract_state)
-        else:
-            # donor = smallest process index holding the step; peers
-            # vote the donated structure matches the target before the
-            # state-sized broadcast runs
-            big = 1 << 30
-            donor = coord.min_over_hosts(
-                me if ram_local == best_ram else big,
-                timeout_s=self._coord_timeout, name="tiered-peer-donor")
-            if donor >= big:
+            if partial or tree_digest(payload) \
+                    != tree_digest(abstract_state):
                 raise CheckpointError(
-                    "tiered checkpoint: RAM step vanished before the "
-                    "peer restore (donor lost)")
-            is_src = me == donor
-            if is_src:
-                with self._cond:
-                    entry = self._entries.get(best_ram)
-                payload = entry.host if entry is not None else None
-                my_ok = (payload is not None
+                    f"tiered checkpoint: tier-0 snapshot of step "
+                    f"{best_ram} does not match the target state "
+                    "structure")
+            host = payload
+        else:
+            import jax
+            leaves, treedef = jax.tree.flatten(
+                abstract_state, is_leaf=lambda v: v is None)
+            # canonical (leaf, region) list from the TARGET sharding's
+            # devices_indices_map — global, hence identical pod-wide,
+            # so every host's flags index the same region list
+            regions = [(_leaf_regions(a) if a is not None else [])
+                       for a in leaves]
+            flat_regions = [(li, r) for li, rs in enumerate(regions)
+                            for r in rs]
+            my_leaves: Optional[List[Any]] = None
+            if payload is not None:
+                p_leaves, p_def = jax.tree.flatten(
+                    payload, is_leaf=lambda v: v is None)
+                if p_def == treedef and len(p_leaves) == len(leaves):
+                    my_leaves = p_leaves
+            have_full = (my_leaves is not None and not partial
                          and tree_digest(payload)
                          == tree_digest(abstract_state))
-            else:
-                import jax
-                payload = jax.tree.map(
+
+            def holds(li: int, r: tuple) -> bool:
+                if have_full:
+                    return True
+                if my_leaves is None or not partial:
+                    return False
+                leaf = my_leaves[li]
+                return (isinstance(leaf, _ShardSnap)
+                        and tuple(leaf.shape) == tuple(leaves[li].shape)
+                        and r in leaf.shards)
+
+            flags = [have_full] + [holds(li, r)
+                                   for li, r in flat_regions]
+            matrix = coord.allgather_flags(
+                flags, timeout_s=self._coord_timeout,
+                name="tiered-shard-holdings")
+            full_holders = np.flatnonzero(matrix[:, 0])
+            owners = assign_shard_owners(matrix[:, 1:])
+            if full_holders.size:
+                # fast path: a host holds a digest-verified FULL tree —
+                # one whole-state broadcast from the smallest such host
+                # (the pre-shard-aware protocol, kept for topologies
+                # where the whole-tree device_get succeeds)
+                donor = int(full_holders[0])
+                is_src = me == donor
+                src_tree = payload if is_src else jax.tree.map(
                     lambda a: (None if a is None
                                else np.zeros(a.shape, a.dtype)),
                     abstract_state, is_leaf=lambda x: x is None)
-                my_ok = True
-            ok = coord.all_agree(bool(my_ok),
-                                 timeout_s=self._coord_timeout,
-                                 name="tiered-peer-vote")
-            if not ok:
-                raise CheckpointError(
-                    "tiered checkpoint: peer tier-0 snapshot does not "
-                    "match the target state structure")
-            host = coord.broadcast_from_host(
-                payload, is_source=is_src,
-                timeout_s=self._coord_timeout, name="tiered-peer-restore")
-            if not is_src:
-                counters.inc("peer_restores")
-        if nprocs == 1 and not ok:
-            raise CheckpointError(
-                f"tiered checkpoint: tier-0 snapshot of step {best_ram} "
-                "does not match the target state structure")
+                host = coord.broadcast_from_host(
+                    src_tree, is_source=is_src,
+                    timeout_s=self._coord_timeout,
+                    name="tiered-peer-restore")
+                if not is_src:
+                    counters.inc("peer_restores")
+            elif flat_regions and all(o >= 0 for o in owners):
+                host = self._assemble_from_donors(
+                    leaves, treedef, flat_regions, owners, my_leaves)
+            else:
+                uncovered = sum(1 for o in owners if o < 0)
+                raise _ConsensusFallback(
+                    "tiered checkpoint: no host holds a full tier-0 "
+                    f"snapshot of step {best_ram} and {uncovered} shard "
+                    "region(s) of the target layout are unowned — "
+                    "falling back to the durable tiers, pod-wide")
         # exact placement, no compute and no compile: each process
         # builds its addressable shards straight from the host copy
         # (works identically single- and multi-process — unlike a
@@ -754,6 +916,49 @@ class TieredCheckpointManager:
             + ("host RAM" if nprocs == 1 or ram_local == best_ram
                else "a peer's host RAM") + " (no storage read)")
         return state
+
+    def _assemble_from_donors(self, leaves, treedef, flat_regions,
+                              owners, my_leaves):
+        """Shard-aware peer restore: each owner broadcasts ONLY the
+        regions the holder matrix assigned to it (one batched broadcast
+        per donor), and every host assembles the full numpy leaves from
+        the union.  Closes the PR-9 remainder — a replacement host
+        hydrates from healthy peers' partial tier-0 captures even when
+        NO single peer can address the whole state."""
+        me = coord.process_index()
+        by_owner: Dict[int, List[int]] = {}
+        for i, o in enumerate(owners):
+            by_owner.setdefault(o, []).append(i)
+        full_np: List[Any] = [
+            None if a is None else np.zeros(tuple(a.shape), a.dtype)
+            for a in leaves]
+        received = 0
+        for o in sorted(by_owner):
+            idxs = by_owner[o]
+            is_src = me == o
+            if is_src:
+                parts = [np.ascontiguousarray(
+                    my_leaves[li].shards[r])
+                    for li, r in (flat_regions[i] for i in idxs)]
+            else:
+                parts = [np.zeros(tuple(b - a for a, b in r),
+                                  leaves[li].dtype)
+                         for li, r in (flat_regions[i] for i in idxs)]
+            parts = coord.broadcast_from_host(
+                parts, is_source=is_src,
+                timeout_s=self._coord_timeout,
+                name=f"tiered-shard-restore-{o}")
+            for i, data in zip(idxs, parts):
+                li, r = flat_regions[i]
+                sl = tuple(slice(a, b) for a, b in r)
+                full_np[li][sl] = data
+            if not is_src:
+                received += 1
+        if received:
+            counters.inc("peer_restores")
+        counters.inc("shard_assembled_restores")
+        import jax
+        return jax.tree.unflatten(treedef, full_np)
 
     def begin_run(self, start_step: int) -> None:
         """A new fit starting at ``start_step`` is a new timeline from

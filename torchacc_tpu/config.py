@@ -502,6 +502,15 @@ class ServeConfig:
     # (survives a process kill, not host power loss) when per-request
     # fsync cost matters.
     journal_fsync: bool = True
+    # journal rotation + compaction (serve/journal.py): when the active
+    # journal.jsonl crosses either bound at an append boundary it is
+    # rotated out, terminal records are compacted into
+    # journal-archive.jsonl and pending admissions carry forward into
+    # the fresh active file — bounding replay cost for long-lived
+    # engines.  None/0 (default) = never rotate (pre-rotation layout,
+    # byte-identical).
+    journal_rotate_bytes: Optional[int] = None
+    journal_rotate_age_s: Optional[float] = None
     # deadline shedding (docs/serving.md "Deadline shedding"): a queued
     # request whose deadline has already passed — provably unmeetable,
     # it still needs >= 1 decode step — gets a typed 'shed' result

@@ -591,6 +591,33 @@ class FleetAggregator:
             except Exception as e:  # noqa: BLE001 - a broken context
                 # degrades the payload, never the endpoint
                 doc["context_error"] = repr(e)
+        # goodput normalized over the CURRENT active world: the raw
+        # host-summed fraction divides by every host's wall time,
+        # including hosts long since excluded — after a shrink it
+        # under-reports forever, and after a replacement/grow-back the
+        # denominator must re-expand.  The supervisor context carries
+        # the live world size (exclusions already subtracted) and its
+        # own uptime, so the capacity denominator here tracks what the
+        # pod can actually deliver NOW, not what it was provisioned
+        # with.
+        sup = doc.get("supervisor")
+        if isinstance(sup, dict):
+            gw = doc.get("goodput_workers") or {}
+            try:
+                active = int(sup.get("world") or 0)
+                uptime_ms = float(sup.get("uptime_s") or 0.0) * 1000.0
+                productive = float(gw.get("productive_ms") or 0.0)
+            except (TypeError, ValueError):
+                active, uptime_ms, productive = 0, 0.0, 0.0
+            capacity_ms = active * uptime_ms
+            doc["goodput_active_world"] = {
+                "active_world": max(active, 0),
+                "productive_ms": productive,
+                "capacity_ms": capacity_ms,
+                "goodput_fraction_active_world": (
+                    min(productive / capacity_ms, 1.0)
+                    if capacity_ms > 0 else 0.0),
+            }
         return doc
 
     def merged_histogram(self, name: str) -> Optional[Histogram]:

@@ -66,6 +66,7 @@ class GoodputLedger:
         self._lock = threading.Lock()
         self._t0: Optional[float] = None
         self._mark: Optional[float] = None
+        self._frozen: Optional[float] = None
         self._buckets: Dict[str, float] = {}
         self._sub: Dict[str, float] = {}
         self._published: Dict[str, int] = {}
@@ -81,6 +82,17 @@ class GoodputLedger:
     def started(self) -> bool:
         return self._t0 is not None
 
+    def freeze(self) -> None:
+        """Pin the wall clock at NOW (end of run).  A ledger that
+        outlives its run — the supervisor's ``/fleet`` endpoint stays
+        served after ``run()`` returns so the smoke gates can scrape
+        it — would otherwise keep growing an unattributed tail
+        forever; frozen, every later scrape reports the run's final
+        breakdown.  Idempotent; laps after freeze attribute nothing."""
+        with self._lock:
+            if self._t0 is not None and self._frozen is None:
+                self._frozen = self._clock()
+
     def lap(self, bucket: str) -> float:
         """Attribute the time since the previous lap (or start) to
         ``bucket``; returns the attributed seconds (0.0 before
@@ -88,7 +100,8 @@ class GoodputLedger:
         with self._lock:
             if self._mark is None:
                 return 0.0
-            now = self._clock()
+            now = (self._clock() if self._frozen is None
+                   else self._frozen)
             dt = max(now - self._mark, 0.0)
             self._mark = now
             self._buckets[bucket] = self._buckets.get(bucket, 0.0) + dt
@@ -114,11 +127,19 @@ class GoodputLedger:
 
     def wall_s(self) -> float:
         with self._lock:
-            return 0.0 if self._t0 is None else self._clock() - self._t0
+            if self._t0 is None:
+                return 0.0
+            end = self._clock() if self._frozen is None else self._frozen
+            return end - self._t0
 
     def _snapshot(self) -> Tuple[float, Dict[str, float], Dict[str, float]]:
         with self._lock:
-            wall = 0.0 if self._t0 is None else self._clock() - self._t0
+            if self._t0 is None:
+                wall = 0.0
+            else:
+                end = (self._clock() if self._frozen is None
+                       else self._frozen)
+                wall = end - self._t0
             return wall, dict(self._buckets), dict(self._sub)
 
     def productive_s(self) -> float:

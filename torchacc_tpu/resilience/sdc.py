@@ -515,6 +515,30 @@ class SDCMonitor:
                         report = divergence_report(
                             digests, redo[bad[0]], bad, self.leaf_paths,
                             self.replica_hosts)
+                    elif recompute is not None:
+                        # third execution tie-breaker (the dp<=2 even
+                        # split): in-step digest and recompute agree
+                        # per-replica, so neither can self-localize —
+                        # one more execution gives three samples to
+                        # majority-vote.  A replica whose three runs
+                        # are not unanimous is intermittently flaky
+                        # (the two agreeing runs are the majority) and
+                        # IS localized; three-way-unanimous replicas
+                        # that still diverge across replicas remain
+                        # persistent, unattributed corruption.
+                        counters.inc("sdc_third_executions")
+                        third = np.asarray(recompute())
+                        bad = [r for r in suspects
+                               if len({_row_key(digests[r]),
+                                       _row_key(redo[r]),
+                                       _row_key(third[r])}) > 1]
+                        if bad:
+                            report = divergence_report(
+                                digests, third[bad[0]], bad,
+                                self.leaf_paths, self.replica_hosts)
+                        else:
+                            bad = list(suspects)
+                            localized = False
                     else:
                         # persistent corruption: both executions equally
                         # wrong — cannot self-localize; name the whole

@@ -175,9 +175,12 @@ class ServeEngine:
         # durable request journal + replay (serve/journal.py,
         # docs/serving.md "Serving under the supervisor"): None = off,
         # serve path byte-identical to the journal-free engine
-        self._journal = (RequestJournal(config.serve.journal_dir,
-                                        fsync=config.serve.journal_fsync)
-                         if config.serve.journal_dir else None)
+        self._journal = (RequestJournal(
+            config.serve.journal_dir,
+            fsync=config.serve.journal_fsync,
+            rotate_bytes=config.serve.journal_rotate_bytes,
+            rotate_age_s=config.serve.journal_rotate_age_s)
+            if config.serve.journal_dir else None)
         self._journal_fold = None
         if self._journal is not None:
             # one read at construction serves both consumers: the id
@@ -188,8 +191,10 @@ class ServeEngine:
             # which consumes and releases it.  Records this engine
             # appends after construction never matter to either — its
             # own requests live in self._all and recover() skips them.
+            # read the DIR, not just the active file: a predecessor may
+            # have rotated, leaving history in the archive/segments
             pending, completed, shed = replay_state(
-                read_journal(self._journal.path))
+                read_journal(self._journal.dir))
             # keep only what recover() needs: the pending records
             # (bounded by outstanding work, not history) and the
             # terminal ID sets — never the terminal bodies (full token

@@ -51,12 +51,47 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from torchacc_tpu.utils.logger import logger
 
-#: the journal file inside ``serve.journal_dir`` (one engine = one
-#: journal; co-located engines need distinct dirs)
+#: the ACTIVE journal file inside ``serve.journal_dir`` (one engine =
+#: one journal; co-located engines need distinct dirs)
 JOURNAL_NAME = "journal.jsonl"
+
+#: compacted terminal records from rotated-out segments land here —
+#: the long-lived dedupe/accounting history that never grows a line
+#: per *pending* request
+ARCHIVE_NAME = "journal-archive.jsonl"
+
+#: rotated-out segments are ``journal-<seq:05d>.jsonl`` (they exist
+#: only transiently: compaction removes a segment once its records are
+#: durably re-homed in the archive / the new active file)
+SEGMENT_PREFIX = "journal-"
 
 #: record kinds a journal line may carry
 KINDS = ("accepted", "completed", "shed")
+
+
+def journal_files(journal_dir: str) -> List[str]:
+    """Every journal file under ``journal_dir`` in REPLAY order:
+    archive first (oldest terminal records), then rotated segments by
+    sequence number, then the active file.  Replay folds are
+    order-tolerant for terminal records (last wins, and terminals never
+    conflict) and first-accepted-wins for admissions, so this order
+    keeps the original admission authoritative."""
+    try:
+        names = os.listdir(journal_dir)
+    except OSError:
+        return []
+    segments = sorted(
+        n for n in names
+        if n.startswith(SEGMENT_PREFIX) and n.endswith(".jsonl")
+        and n != ARCHIVE_NAME
+        and n[len(SEGMENT_PREFIX):-len(".jsonl")].isdigit())
+    ordered: List[str] = []
+    if ARCHIVE_NAME in names:
+        ordered.append(ARCHIVE_NAME)
+    ordered.extend(segments)
+    if JOURNAL_NAME in names:
+        ordered.append(JOURNAL_NAME)
+    return [os.path.join(journal_dir, n) for n in ordered]
 
 
 def prompt_digest(prompt_ids) -> str:
@@ -77,14 +112,45 @@ class RequestJournal:
     have read have a ``completed`` record.  ``fsync=False`` keeps the
     flush (OS-buffered: survives a process kill, not a host power
     loss) for deployments where the per-request fsync dominates.
+
+    **Rotation + compaction** (``rotate_bytes`` / ``rotate_age_s``):
+    without it a long-lived engine's journal grows one line per event
+    forever, and every restart replays the full history.  When the
+    active file crosses either bound at an append boundary, it is
+    renamed to ``journal-<seq>.jsonl``, a fresh active file opens, the
+    segment's TERMINAL records (completed/shed — the dedupe set) are
+    compacted into ``journal-archive.jsonl``, its still-pending
+    ``accepted`` records are re-appended into the new active file
+    (first-accepted-wins makes the duplicate admission harmless on any
+    crash in between), and only then is the segment deleted.  Every
+    crash point leaves either the segment or its compacted successor
+    (or briefly both) on disk — never neither — so accounting across a
+    rotation boundary stays 100%.  Readers take the union via
+    :func:`journal_files`.
     """
 
-    def __init__(self, journal_dir: str, *, fsync: bool = True):
+    def __init__(self, journal_dir: str, *, fsync: bool = True,
+                 rotate_bytes: Optional[int] = None,
+                 rotate_age_s: Optional[float] = None):
         self.dir = journal_dir
         self.path = os.path.join(journal_dir, JOURNAL_NAME)
         self.fsync = bool(fsync)
+        self.rotate_bytes = (None if not rotate_bytes
+                             else max(int(rotate_bytes), 1))
+        self.rotate_age_s = (None if not rotate_age_s
+                             else max(float(rotate_age_s), 0.001))
+        self.rotations = 0
         os.makedirs(journal_dir, exist_ok=True)
         self._f = open(self.path, "ab")
+        try:
+            st = os.fstat(self._f.fileno())
+            # age of the active segment: the existing file's mtime on
+            # restart (close enough — rotation bounds are coarse), now
+            # for a fresh file
+            self._active_since = (st.st_mtime if st.st_size > 0
+                                  else time.time())
+        except OSError:
+            self._active_since = time.time()
         # a failed append (this process) or a kill -9 mid-append (a
         # previous incarnation) may have left PARTIAL bytes with no
         # trailing newline; the next successful append must not
@@ -128,6 +194,87 @@ class RequestJournal:
         except OSError:
             self._torn = True
             raise
+        self._maybe_rotate()
+
+    # -- rotation + compaction -----------------------------------------------
+
+    def _maybe_rotate(self) -> None:
+        """Roll the active file over at an append boundary when it
+        crosses the size/age bound.  Best-effort: a failed rotation
+        never fails the append that triggered it (the active file keeps
+        growing; the next append retries)."""
+        if self.rotate_bytes is None and self.rotate_age_s is None:
+            return
+        try:
+            size = self._f.tell()
+        except OSError:
+            return
+        over_size = (self.rotate_bytes is not None
+                     and size >= self.rotate_bytes)
+        over_age = (self.rotate_age_s is not None
+                    and time.time() - self._active_since
+                    >= self.rotate_age_s)
+        if not (over_size or over_age) or size == 0:
+            return
+        try:
+            self._rotate()
+        except OSError as e:
+            logger.warning(f"request journal {self.path}: rotation "
+                           f"failed ({e!r}); the active file keeps "
+                           "growing until the next append retries")
+
+    def _next_segment_path(self) -> str:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            names = []
+        seqs = [int(n[len(SEGMENT_PREFIX):-len(".jsonl")])
+                for n in names
+                if n.startswith(SEGMENT_PREFIX) and n.endswith(".jsonl")
+                and n != ARCHIVE_NAME
+                and n[len(SEGMENT_PREFIX):-len(".jsonl")].isdigit()]
+        return os.path.join(
+            self.dir, f"{SEGMENT_PREFIX}{max(seqs, default=0) + 1:05d}"
+            ".jsonl")
+
+    def _rotate(self) -> None:
+        """active → segment → (archive terminals + re-admitted
+        pendings) → delete segment.  Durability order guarantees no
+        crash point loses a record: the segment is removed only after
+        its compacted successors are fsync'd."""
+        seg = self._next_segment_path()
+        self._f.close()
+        os.rename(self.path, seg)
+        self._f = open(self.path, "ab")
+        self._torn = False
+        self._active_since = time.time()
+        records = read_journal(seg)
+        pending, completed, shed = replay_state(records)
+        # terminal records -> archive (append; duplicates across a
+        # crashed compaction are folded away by replay_state)
+        with open(os.path.join(self.dir, ARCHIVE_NAME), "ab") as ar:
+            for rec in list(completed.values()) + list(shed.values()):
+                ar.write((json.dumps(rec, allow_nan=False,
+                                     separators=(",", ":"))
+                          + "\n").encode())
+            ar.flush()
+            os.fsync(ar.fileno())
+        # still-pending admissions -> new active file, in original
+        # acceptance order (monotone progress: a request admitted in
+        # segment N is replayable from segment N+1 on)
+        for rec in pending.values():
+            self._f.write((json.dumps(rec, allow_nan=False,
+                                      separators=(",", ":"))
+                           + "\n").encode())
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        os.unlink(seg)
+        self.rotations += 1
+        logger.info(
+            f"request journal {self.path}: rotated segment "
+            f"{os.path.basename(seg)} — {len(completed) + len(shed)} "
+            f"terminal record(s) archived, {len(pending)} pending "
+            "admission(s) carried forward")
 
     def accepted(self, *, rid: int, trace_id: str, prompt_ids,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -174,13 +321,18 @@ class RequestJournal:
 
 
 def read_journal(path: str) -> List[Dict[str, Any]]:
-    """Records from a journal file (or a journal DIR containing one).
-    Unparseable lines are skipped with a warning — the single-appender
-    write discipline means only the tail can be torn (a mid-write
-    ``kill -9``), and a torn completion record merely re-serves one
-    request (token-identical for greedy)."""
+    """Records from a journal file — or, given a journal DIR, from
+    EVERY journal file in it (archive, rotated segments, active) in
+    replay order, so recovery across a rotation boundary sees the full
+    history.  Unparseable lines are skipped with a warning — the
+    single-appender write discipline means only the tail can be torn
+    (a mid-write ``kill -9``), and a torn completion record merely
+    re-serves one request (token-identical for greedy)."""
     if os.path.isdir(path):
-        path = os.path.join(path, JOURNAL_NAME)
+        records = []
+        for p in journal_files(path):
+            records.extend(read_journal(p))
+        return records
     records: List[Dict[str, Any]] = []
     try:
         with open(path, "rb") as f:

@@ -15,10 +15,19 @@ from torchacc_tpu.supervisor.policy import (
     PolicyEngine,
     RestartPolicy,
 )
-from torchacc_tpu.supervisor.probe import (
+from torchacc_tpu.supervisor.probe import (  # noqa: I001
     ProbeClient,
     ProbeResult,
     WorkerProber,
+)
+from torchacc_tpu.supervisor.provisioner import (
+    LocalProvisioner,
+    ProvisionError,
+    ProvisionRequest,
+    ProvisionedHost,
+    Provisioner,
+    SparePool,
+    build_provisioner,
 )
 from torchacc_tpu.supervisor.worker import (
     WorkerHandle,
@@ -31,15 +40,22 @@ from torchacc_tpu.supervisor.worker import (
 __all__ = [
     "Action",
     "ExitDisposition",
+    "LocalProvisioner",
     "PolicyEngine",
     "ProbeClient",
     "ProbeResult",
+    "ProvisionError",
+    "ProvisionRequest",
+    "ProvisionedHost",
+    "Provisioner",
     "RestartPolicy",
+    "SparePool",
     "StragglerWatch",
     "Supervisor",
     "WorkerHandle",
     "WorkerProber",
     "WorkerSpec",
+    "build_provisioner",
     "free_port",
     "newest_valid_step",
     "read_exit_disposition",

@@ -56,6 +56,12 @@ from torchacc_tpu.supervisor.policy import (
     RestartPolicy,
 )
 from torchacc_tpu.supervisor.probe import ProbeClient, WorkerProber
+from torchacc_tpu.supervisor.provisioner import (
+    ProvisionError,
+    Provisioner,
+    ProvisionRequest,
+    SparePool,
+)
 from torchacc_tpu.supervisor.worker import (
     WorkerHandle,
     newest_valid_step,
@@ -71,6 +77,18 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+#: the SDC quarantine file (one home for the rule is
+#: resilience/sdc.py QUARANTINE_FILE; duplicated as a literal because
+#: the supervisor must not import the jax-backed resilience stack)
+QUARANTINE_FILE = "sdc_quarantine.json"
+
+#: the durable supervisor timeline: one strict-JSON line per decision/
+#: provisioning/grow-back event, appended in real time so
+#: ``checkpoint.cli fleet-history`` can reconstruct the quarantine/
+#: replacement story after every process is gone
+EVENTS_FILE = "supervisor_events.jsonl"
 
 
 @dataclass
@@ -185,6 +203,7 @@ class Supervisor:
                  drift_hist: Optional[str] = None,
                  rng=None,
                  sleep: Callable[[float], None] = time.sleep,
+                 provisioner: Optional[Provisioner] = None,
                  prober_factory: Optional[
                      Callable[[int, int], WorkerProber]] = None):
         self.spec = spec
@@ -192,6 +211,19 @@ class Supervisor:
         self.engine = PolicyEngine(self.policy, spec.world_size, rng=rng)
         self.poll_interval_s = float(poll_interval_s)
         self._sleep = sleep
+        #: replacement capacity (supervisor/provisioner.py); required
+        #: for the policy's replace rules to act — replace on with no
+        #: provisioner falls back to exclude+shrink immediately
+        self.provisioner = provisioner
+        #: host slots mid-replacement (lifecycle state "replacing":
+        #: between the replace/grow-back decision and the relaunch)
+        self._replacing: set = set()
+        #: set when a replace decision just fell back to shrink:
+        #: capacity proved unavailable THIS cycle, so the grow-back
+        #: retry waits for the next incarnation boundary instead of
+        #: burning more budget on the same dead provisioner
+        self._growback_holdoff = False
+        self._events_path = os.path.join(spec.run_dir, EVENTS_FILE)
         self._prober_factory = (prober_factory if prober_factory
                                 is not None else self._default_prober)
         self.decisions: List[Dict[str, Any]] = []
@@ -212,9 +244,12 @@ class Supervisor:
         #: restart/rejoin downtime ledger (obs/goodput.py): `active`
         #: vs `down:<policy rule>` buckets over the run's wall clock
         self._fleet_ledger = None
-        #: policy rule the NEXT between-incarnation gap is attributed
-        #: to (the first launch's cost is `down:startup`)
-        self._pending_rule = "startup"
+        #: goodput bucket the NEXT between-incarnation gap is
+        #: attributed to (the first launch's cost is ``down:startup``;
+        #: ordinary restarts ``down:<policy rule>``; the relaunch
+        #: window after a successful replacement ``up:replaced`` —
+        #: healing time, visible but distinguished from downtime)
+        self._pending_bucket = "down:startup"
         if obs_port is not None:
             # the daemon's own /metrics endpoint: the supervisor_*
             # counters ride it automatically (torchacc_*_total), and
@@ -291,15 +326,54 @@ class Supervisor:
                 "excluded": sorted(self.engine.excluded),
                 "restarts_used": self.engine.restarts_used,
                 "max_restarts": self.policy.max_restarts,
+                "replacements_used": self.engine.replacements_used,
+                "replace_budget": self.policy.replace_budget,
+                "replaced": sorted(self.engine.replaced),
+                "lifecycle": self._lifecycle(),
                 "newest_durable_step": self._last_durable,
                 "alive": {str(h.host): bool(h.running())
                           for h in self._handles},
             },
             "decisions": list(self.decisions),
         }
+        if self.provisioner is not None:
+            d["supervisor"]["provisioner"] = self.provisioner.stats()
         if self._fleet_ledger is not None:
             d["goodput_supervisor"] = self._fleet_ledger.summary()
         return d
+
+    def _lifecycle(self) -> Dict[str, str]:
+        """Per-host lifecycle state over the ORIGINAL pod slots
+        (``spare|active|replacing|excluded`` — docs/resilience.md
+        "Host replacement & grow-back").  Pre-warmed standbys appear
+        as synthetic slots past the pod (state ``spare``): they hold
+        capacity, not workers."""
+        states: Dict[str, str] = {}
+        for slot in range(self.spec.world_size):
+            if slot in self._replacing:
+                states[str(slot)] = "replacing"
+            elif slot in self.engine.excluded:
+                states[str(slot)] = "excluded"
+            else:
+                states[str(slot)] = "active"
+        if isinstance(self.provisioner, SparePool):
+            for i in range(self.provisioner.spares_left()):
+                states[str(self.spec.world_size + i)] = "spare"
+        return states
+
+    def _event(self, kind: str, **fields: Any) -> None:
+        """Append one strict-JSON line to the durable supervisor
+        timeline (``supervisor_events.jsonl``) — best-effort: the
+        timeline is an artefact, never a failure source."""
+        rec = {"time": time.time(), "incarnation": self.incarnation,
+               "event": kind}
+        rec.update(fields)
+        try:
+            os.makedirs(self.spec.run_dir, exist_ok=True)
+            with open(self._events_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
 
     def _hosts_prom_text(self) -> str:
         """Per-host alive/excluded gauges (labeled series the scalar
@@ -318,6 +392,18 @@ class Supervisor:
             lines.append(
                 f'torchacc_fleet_host_excluded{{host="{host}"}} '
                 f'{1 if host in self.engine.excluded else 0}')
+        # lifecycle enum as a one-hot labeled gauge (ORIGINAL slot ids
+        # + synthetic spare slots), mirroring the /fleet JSON block
+        lines.append("# TYPE torchacc_fleet_host_state gauge")
+        for host, state in sorted(self._lifecycle().items(),
+                                  key=lambda kv: int(kv[0])):
+            lines.append(
+                f'torchacc_fleet_host_state{{host="{host}",'
+                f'state="{state}"}} 1')
+        if isinstance(self.provisioner, SparePool):
+            lines.append("# TYPE torchacc_fleet_spares_left gauge")
+            lines.append(f"torchacc_fleet_spares_left "
+                         f"{self.provisioner.spares_left()}")
         return "\n".join(lines) + "\n"
 
     def _ledger_lap(self, bucket: str) -> None:
@@ -351,6 +437,8 @@ class Supervisor:
                                List[Optional[WorkerProber]]]:
         s = self.spec
         world = self.engine.world
+        # the launch fills every slot: replacement windows are over
+        self._replacing.clear()
         coord_port = free_port()
         handles, probers = [], []
         worker_urls: Dict[int, str] = {}
@@ -398,6 +486,157 @@ class Supervisor:
         for h in handles:
             h.close()
 
+    # -- replacement & grow-back ---------------------------------------------
+
+    def _replace_hosts(self, action: Action,
+                       disposition: Optional[ExitDisposition],
+                       exit_code: Optional[int],
+                       probe_verdict: Optional[str]) -> Action:
+        """Execute a ``replace`` decision: acquire capacity for every
+        named slot (spare pool first, backend cold path second),
+        attributing the window to the ``down:provisioning`` goodput
+        bucket.  Success keeps the action as-is (same-world restart,
+        the slots refilled); failure releases partial grants and
+        returns the policy's budget-bounded fallback
+        (``replace-fallback-shrink``), recorded as its own decision."""
+        hosts = list(action.hosts)
+        self._replacing.update(hosts)
+        granted = []
+        failure: Optional[str] = None
+        if self.provisioner is None:
+            failure = "no provisioner configured"
+        else:
+            for h in hosts:
+                t0 = time.monotonic()
+                try:
+                    g = self.provisioner.provision(ProvisionRequest(
+                        slot=h, rule=action.rule,
+                        incarnation=self.incarnation))
+                except ProvisionError as e:
+                    failure = str(e)
+                    counters.inc("supervisor_provision_failures")
+                    self._event("provision_failed", slot=h,
+                                rule=action.rule, error=str(e))
+                    break
+                granted.append(g)
+                counters.inc("supervisor_replacements")
+                if g.warm:
+                    counters.inc("supervisor_spare_hits")
+                self._event("provision_ok", slot=h, rule=action.rule,
+                            origin=g.origin, warm=g.warm,
+                            latency_s=round(g.latency_s, 6),
+                            took_s=round(time.monotonic() - t0, 6))
+        # the provisioning window (successful or not) is healing
+        # downtime, never hidden inside the restart rule's bucket
+        self._ledger_lap("down:provisioning")
+        if failure is None:
+            self.engine.note_replaced(hosts)
+            self._clear_quarantine(hosts)
+            logger.info(
+                f"supervisor: replaced host(s) {hosts} "
+                f"[{action.rule}] — relaunching at the SAME world "
+                f"({self.engine.world})")
+            return action
+        for g in granted:
+            self.provisioner.release(g)
+        self._replacing.difference_update(hosts)
+        self._growback_holdoff = True
+        fallback = self.engine.fallback_exclude(hosts, why=failure)
+        logger.warning(
+            f"supervisor: provisioning failed for host(s) {hosts} "
+            f"({failure}) — falling back [{fallback.rule}]")
+        self._record(fallback, disposition, exit_code, probe_verdict)
+        return fallback
+
+    def _try_grow_back(self) -> None:
+        """Between incarnations: a shrunken pod (non-empty exclusion
+        set) retries provisioning for its excluded slots and readmits
+        the ones that succeed, so the NEXT incarnation launches at the
+        grown world and elastic resume re-expands dp/fsdp to it.
+        Budget-bounded by the same ``replace_budget`` (a failed
+        attempt is charged too — a dead provisioner is never retried
+        forever)."""
+        if (self.provisioner is None or not self.policy.replace
+                or not self.policy.grow_back
+                or not self.engine.excluded):
+            return
+        if self._growback_holdoff:
+            self._growback_holdoff = False
+            return
+        attempted = False
+        readmitted: List[int] = []
+        for slot in sorted(self.engine.excluded):
+            if not self.engine.charge_replacement():
+                break
+            attempted = True
+            self._replacing.add(slot)
+            try:
+                g = self.provisioner.provision(ProvisionRequest(
+                    slot=slot, rule="grow-back",
+                    incarnation=self.incarnation))
+            except ProvisionError as e:
+                self._replacing.discard(slot)
+                counters.inc("supervisor_provision_failures")
+                self._event("provision_failed", slot=slot,
+                            rule="grow-back", error=str(e))
+                continue
+            counters.inc("supervisor_replacements")
+            counters.inc("supervisor_growbacks")
+            if g.warm:
+                counters.inc("supervisor_spare_hits")
+            self.engine.readmit([slot])
+            self._clear_quarantine([slot])
+            readmitted.append(slot)
+            self._event("grow_back", slot=slot, origin=g.origin,
+                        warm=g.warm, world=self.engine.world)
+        if attempted:
+            self._ledger_lap("down:provisioning")
+        if readmitted:
+            # the relaunch window after a successful grow-back is
+            # healing, not plain downtime
+            self._pending_bucket = "up:replaced"
+            if self.fleet is not None:
+                for h in readmitted:
+                    # the readmitted slot is NEW hardware: no drift
+                    # baseline carries over
+                    self.fleet.drift.forget(h)
+                    if self._straggler is not None:
+                        self._straggler.forget(h)
+            logger.info(
+                f"supervisor: grow-back readmitted host(s) "
+                f"{readmitted} — world restored to "
+                f"{self.engine.world}")
+
+    def _clear_quarantine(self, hosts) -> None:
+        """A replaced slot is NEW hardware: its quarantine record (the
+        old hardware's verdict) must not refuse the replacement worker
+        (``resilience.refuse_quarantined``).  Atomic rewrite of
+        ``sdc_quarantine.json`` dropping the replaced host ids."""
+        path = os.path.join(self.spec.run_dir, QUARANTINE_FILE)
+        try:
+            with open(path) as f:
+                q = json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(q, dict):
+            return
+        dropped = [int(h) for h in hosts if str(h) in q]
+        if not dropped:
+            return
+        for h in dropped:
+            q.pop(str(h), None)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(q, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            return
+        self._event("quarantine_cleared", hosts=dropped)
+        logger.info(
+            f"supervisor: cleared quarantine record(s) for replaced "
+            f"host(s) {dropped}")
+
     # -- sensing -------------------------------------------------------------
 
     def _straggler_ready(self) -> Optional[int]:
@@ -426,35 +665,49 @@ class Supervisor:
 
     def _watch(self, handles: List[WorkerHandle],
                probers: List[Optional[WorkerProber]]
-               ) -> Tuple[Optional[int], Optional[str], Optional[int]]:
+               ) -> Tuple[Optional[int], Optional[str], Optional[int],
+                          List[int]]:
         """Block until the incarnation resolves.  Returns
-        ``(exit_code, probe_verdict, straggler_host)``: exit_code is 0
-        only when every worker exited 0, the first nonzero code when
-        one failed, and None when the supervisor killed the workers
-        (the probe verdict / deadline / straggler host names why)."""
+        ``(exit_code, probe_verdict, straggler_host, failed_hosts)``:
+        exit_code is 0 only when every worker exited 0, the first
+        nonzero code when one failed, and None when the supervisor
+        killed the workers (the probe verdict / deadline / straggler
+        host names why).  ``failed_hosts`` are the slots whose workers
+        exited nonzero — the replace rules act on them even when the
+        dead worker left no disposition (the kill -9 signature)."""
         s = self.spec
         t0 = time.monotonic()
         first_exit_at: Optional[float] = None
         next_probe = t0
+
+        def _failed() -> List[int]:
+            return [h.host for h in handles
+                    if h.poll() not in (None, 0)]
+
         while True:
             codes = [h.poll() for h in handles]
             exited = [c for c in codes if c is not None]
             nonzero = [c for c in exited if c != 0]
             if len(exited) == len(handles):
-                return (0 if not nonzero else nonzero[0]), None, None
+                return ((0 if not nonzero else nonzero[0]), None, None,
+                        _failed())
             if exited and first_exit_at is None:
                 first_exit_at = time.monotonic()
             if nonzero and first_exit_at is not None \
                     and time.monotonic() - first_exit_at > s.exit_grace_s:
                 # one worker failed and the rest did not follow it out
                 # within the grace — stop them; the failure verdict is
-                # the nonzero code + whatever bundle was written
+                # the nonzero code + whatever bundle was written.
+                # failed_hosts snapshots BEFORE the stop: the healthy
+                # stragglers the supervisor kills here exit by signal
+                # too, and counting them would replace live hardware
+                failed = _failed()
                 logger.warning(
                     "supervisor: worker failure did not propagate "
                     f"pod-wide within {s.exit_grace_s:.0f}s — "
                     "stopping the stragglers")
                 self._stop_all(handles)
-                return nonzero[0], None, None
+                return nonzero[0], None, None, failed
             if not nonzero and first_exit_at is not None \
                     and time.monotonic() - first_exit_at > s.exit_grace_s:
                 # clean exits that never completed pod-wide: the
@@ -465,7 +718,7 @@ class Supervisor:
                     f"still running after {s.exit_grace_s:.0f}s; "
                     "killing and treating as hung")
                 self._stop_all(handles)
-                return None, "dead", None
+                return None, "dead", None, []
             if s.incarnation_timeout_s is not None \
                     and time.monotonic() - t0 > s.incarnation_timeout_s:
                 logger.warning(
@@ -473,7 +726,7 @@ class Supervisor:
                     f"exceeded {s.incarnation_timeout_s:.0f}s — "
                     "killing (deadline hang detector)")
                 self._stop_all(handles)
-                return None, "dead", None
+                return None, "dead", None, []
             straggler = self._straggler_ready()
             if straggler is not None:
                 logger.warning(
@@ -483,7 +736,7 @@ class Supervisor:
                     f"window — stopping the incarnation for eviction")
                 counters.inc("supervisor_straggler_stops")
                 self._stop_all(handles)
-                return None, None, straggler
+                return None, None, straggler, []
             if s.probe and time.monotonic() >= next_probe:
                 next_probe = time.monotonic() + s.probe_interval_s
                 for h, pr in zip(handles, probers):
@@ -509,7 +762,7 @@ class Supervisor:
                             "the incarnation")
                         counters.inc("supervisor_probe_kills")
                         self._stop_all(handles)
-                        return None, v, None
+                        return None, v, None, []
             self._sleep(self.poll_interval_s)
 
     # -- the loop ------------------------------------------------------------
@@ -532,11 +785,14 @@ class Supervisor:
                     self._straggler.reset()
                 # everything since the previous incarnation ended (the
                 # decision, the backoff sleep, the relaunch) is restart
-                # downtime attributed to the policy rule that caused it
-                self._ledger_lap(f"down:{self._pending_rule}")
+                # downtime attributed to the policy rule that caused
+                # it — except provisioning windows (lapped separately
+                # into down:provisioning) and post-replacement
+                # relaunches (up:replaced)
+                self._ledger_lap(self._pending_bucket)
                 try:
-                    exit_code, probe_verdict, straggler = self._watch(
-                        handles, probers)
+                    (exit_code, probe_verdict, straggler,
+                     failed_hosts) = self._watch(handles, probers)
                 finally:
                     self._stop_all(handles)
                 self._ledger_lap("active")
@@ -550,10 +806,19 @@ class Supervisor:
                 action = self.engine.decide(disposition,
                                             exit_code=exit_code,
                                             probe_verdict=probe_verdict,
-                                            straggler_host=straggler)
+                                            straggler_host=straggler,
+                                            failed_hosts=failed_hosts)
                 self._record(action, disposition, exit_code,
                              probe_verdict)
-                self._pending_rule = action.rule
+                if action.kind == "replace":
+                    # provision now; on failure this returns the
+                    # budget-bounded fallback (exclude+shrink or
+                    # give-up) which is recorded as its own decision
+                    action = self._replace_hosts(
+                        action, disposition, exit_code, probe_verdict)
+                self._pending_bucket = ("up:replaced"
+                                        if action.kind == "replace"
+                                        else f"down:{action.rule}")
                 if self.fleet is not None and action.hosts:
                     for h in action.hosts:
                         # an excluded index may be reused by the
@@ -578,6 +843,10 @@ class Supervisor:
                     counters.inc("supervisor_giveups")
                     return self._report("gave_up")
                 self._account(action)
+                # grow-back: a shrunken pod re-expands between
+                # incarnations when the provisioner can refill an
+                # excluded slot (budget shared with replacement)
+                self._try_grow_back()
                 if action.delay_s > 0:
                     logger.info(
                         f"supervisor: waiting {action.delay_s:.2f}s "
@@ -586,6 +855,11 @@ class Supervisor:
                 self.incarnation += 1
         finally:
             self._stop_all(self._handles)
+            if self.provisioner is not None:
+                try:
+                    self.provisioner.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
             if self.fleet is not None:
                 # one last sweep so a fast-exiting worker's final
                 # counters land before the endpoints die, then stop
@@ -600,7 +874,7 @@ class Supervisor:
     # -- bookkeeping ---------------------------------------------------------
 
     def _account(self, action: Action) -> None:
-        if action.kind in ("restart", "restart_excluding"):
+        if action.kind in ("restart", "restart_excluding", "replace"):
             counters.inc("supervisor_restarts")
         if action.kind == "restart_excluding":
             counters.inc("supervisor_exclusions", len(action.hosts))
@@ -637,8 +911,13 @@ class Supervisor:
             "resumable": dict(d.resumable) if d else {},
             "world_after": self.engine.world,
             "restarts_used": self.engine.restarts_used,
+            "replacements_used": self.engine.replacements_used,
         }
         self.decisions.append(entry)
+        # the durable twin: the timeline survives the daemon process
+        # (checkpoint.cli fleet-history replays it)
+        self._event("decision", **{k: v for k, v in entry.items()
+                                   if k != "time"})
         # the acceptance contract: EVERY decision is logged with the
         # typed error and the policy rule that produced it
         logger.warning(
@@ -654,12 +933,20 @@ class Supervisor:
               f"/{self.policy.max_restarts}): {action.reason}")
 
     def _report(self, status: str) -> Dict[str, Any]:
+        if self._fleet_ledger is not None:
+            # pin the goodput wall clock: the /fleet endpoint outlives
+            # run() (the smoke gates scrape it afterwards) and must
+            # keep reporting the run's FINAL breakdown, not a
+            # forever-growing unattributed tail
+            self._fleet_ledger.freeze()
         return {
             "status": status,
             "incarnations": self.incarnation + 1,
             "excluded": sorted(self.engine.excluded),
             "world": self.engine.world,
             "restarts_used": self.engine.restarts_used,
+            "replacements_used": self.engine.replacements_used,
+            "replaced": sorted(self.engine.replaced),
             "newest_durable_step": self._last_durable,
             "decisions": list(self.decisions),
             "final_bundle": self.final_bundle_path,
@@ -705,7 +992,18 @@ def main_from_args(args) -> int:
         backoff_max_s=args.backoff_max_s,
         backoff_jitter=args.backoff_jitter,
         min_world=args.min_world,
+        replace=getattr(args, "replace", False),
+        replace_budget=getattr(args, "replace_budget", 2),
+        grow_back=not getattr(args, "no_grow_back", False),
     )
+    provisioner = None
+    if policy.replace:
+        from torchacc_tpu.supervisor.provisioner import build_provisioner
+        provisioner = build_provisioner(
+            getattr(args, "provisioner", "local"),
+            spares=getattr(args, "spares", 0),
+            capacity=getattr(args, "provision_capacity", None),
+            delay_s=getattr(args, "provision_delay_s", 0.0))
     env = {}
     for kv in args.env or []:
         if "=" not in kv:
@@ -721,7 +1019,8 @@ def main_from_args(args) -> int:
         incarnation_timeout_s=args.incarnation_timeout_s,
         exit_grace_s=args.exit_grace_s,
     )
-    sup = Supervisor(spec, policy, obs_port=args.obs_port)
+    sup = Supervisor(spec, policy, obs_port=args.obs_port,
+                     provisioner=provisioner)
     report = sup.run()
     print(json.dumps(report, indent=2))
     return 0 if report["status"] == "completed" else 3

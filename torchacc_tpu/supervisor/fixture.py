@@ -25,7 +25,15 @@ faulty simply by passing ``{incarnation}`` through:
   CheckpointError (the unrecoverable-crash-loop stand-in);
 - ``{"preempt": {"after": 3}}`` — programmatic SIGTERM-equivalent
   after 3 batches -> emergency save + clean return, disposition
-  reason "preemption".
+  reason "preemption";
+- ``{"kill": {"host": 1, "after": 2}}`` — that host SIGKILLs ITSELF
+  before feeding batch 2: the hardware-loss stand-in (no flight
+  bundle, no emergency save, exit code -9) the replace path senses.
+
+A spec whose top-level keys are all digit strings is a
+PER-INCARNATION map — ``{"0": {"kill": ...}, "2": {"preempt": ...}}``
+gives each incarnation its own fault (``--chaos-incarnation`` is
+ignored), which is what multi-phase gates like ``chaos-replace`` need.
 
 Exit code 0 = ran to --max-steps (or a handled preemption); 1 = typed
 framework error (the flight bundle carries the exit_disposition the
@@ -102,6 +110,21 @@ def _global_batches(args, mesh, n):
         yield {"input_ids": arr}
 
 
+def _kill_after(inner, after: int):
+    """SIGKILL self right before feeding batch index ``after`` — the
+    hardware-loss stand-in: no flight bundle, no emergency save, exit
+    code -SIGKILL.  Peers stall in collectives until the supervisor's
+    exit-grace sweep takes them down."""
+    import signal
+
+    def gen():
+        for i, b in enumerate(inner):
+            if i == after:
+                os.kill(os.getpid(), signal.SIGKILL)
+            yield b
+    return gen()
+
+
 def main(argv=None) -> int:
     args = _parse(sys.argv[1:] if argv is None else list(argv))
     try:
@@ -109,9 +132,14 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"fixture: bad --chaos JSON: {e}", file=sys.stderr)
         return 2
-    apply_chaos = (args.chaos_incarnation < 0
-                   or args.incarnation == args.chaos_incarnation)
-    chaos = chaos if apply_chaos else {}
+    if chaos and all(isinstance(k, str) and k.isdigit() for k in chaos):
+        # per-incarnation chaos map (module docstring): each
+        # incarnation picks its own spec; --chaos-incarnation ignored
+        chaos = chaos.get(str(args.incarnation), {})
+    else:
+        apply_chaos = (args.chaos_incarnation < 0
+                       or args.incarnation == args.chaos_incarnation)
+        chaos = chaos if apply_chaos else {}
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -173,6 +201,9 @@ def main(argv=None) -> int:
     if "preempt" in chaos:
         loader = ChaosLoader(
             loader, preempt_after_step=int(chaos["preempt"]["after"]))
+    kill = chaos.get("kill")
+    if kill and int(kill.get("host", 0)) == args.host:
+        loader = _kill_after(loader, int(kill.get("after", 0)))
 
     # machine-checkable resume expectation for the smoke driver: the
     # newest commit-marked step BEFORE this incarnation restores

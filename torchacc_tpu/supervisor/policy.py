@@ -33,6 +33,20 @@ sustained              (opt-in ``straggler_evict``) restart EXCLUDING
 fleet_straggler        the named host — the decide half of the PR-14
 verdict                drift detector; patience window + bounded
                        eviction budget, never below ``min_world``
+host vanished          (opt-in ``replace``) ask the provisioner for a
+(nonzero exit, NO      replacement, restart at the SAME world — the
+typed disposition)     kill -9/VM-loss signature: hardware died before
+                       the runtime could write any verdict.  Budget-
+                       bounded (``replace_budget``); when provisioning
+                       FAILS the daemon takes
+                       :meth:`PolicyEngine.fallback_exclude` — the
+                       exclude+shrink row under rule
+                       ``replace-fallback-shrink``
+SDCError with          same opt-in, but the bad host is NAMED: replace
+``replace`` on         it instead of shrinking (the daemon clears its
+                       quarantine record once new hardware fills the
+                       slot); replace budget spent -> the classic
+                       sdc-exclude shrink
 =====================  =============================================
 
 Every restart except a preemption resume consumes one unit of the
@@ -156,6 +170,22 @@ class RestartPolicy:
     straggler_evict: bool = False
     straggler_evict_budget: int = 1
     straggler_patience_s: float = 10.0
+    #: host replacement (opt-in; docs/resilience.md "Host replacement
+    #: & grow-back"): answer a hardware loss (host vanished with no
+    #: typed disposition, or a named SDC host) by asking the daemon's
+    #: provisioner for a replacement and restarting at the SAME world
+    #: instead of excluding + shrinking.  ``replace_budget`` bounds
+    #: total replacement grants per run (failed provisioning attempts
+    #: count too — a dead provisioner cannot be retried forever); the
+    #: fallback when provisioning fails is the classic exclude+shrink.
+    replace: bool = False
+    replace_budget: int = 2
+    #: with ``replace`` on, also try to GROW a previously shrunken pod
+    #: back: between incarnations the daemon re-provisions excluded
+    #: slots (same replace budget) and readmits them, so the next
+    #: incarnation relaunches at the restored world and elastic resume
+    #: re-expands dp/fsdp to it
+    grow_back: bool = True
 
     def validate(self) -> None:
         if self.max_restarts < 0:
@@ -174,6 +204,8 @@ class RestartPolicy:
             raise ValueError("straggler_evict_budget must be >= 0")
         if self.straggler_patience_s < 0:
             raise ValueError("straggler_patience_s must be >= 0")
+        if self.replace_budget < 0:
+            raise ValueError("replace_budget must be >= 0")
 
 
 class PolicyEngine:
@@ -197,6 +229,12 @@ class PolicyEngine:
         self.restarts_used = 0
         self.crash_streak = 0
         self.straggler_evictions = 0
+        #: replacement grants consumed — charged when a replace
+        #: decision is made (or a grow-back attempt starts), success
+        #: or not, so a dead provisioner cannot be retried forever
+        self.replacements_used = 0
+        #: host slots ever refilled by a provisioner (reporting)
+        self.replaced: set = set()
         self._rng = rng if rng is not None else random.Random(0)
 
     # -- state ---------------------------------------------------------------
@@ -218,7 +256,8 @@ class PolicyEngine:
     def decide(self, disposition: Optional[ExitDisposition], *,
                exit_code: Optional[int] = None,
                probe_verdict: Optional[str] = None,
-               straggler_host: Optional[int] = None) -> Action:
+               straggler_host: Optional[int] = None,
+               failed_hosts: Optional[List[int]] = None) -> Action:
         """Map one incarnation's outcome to an action.
 
         ``disposition``: the newest exit-disposition bundle written
@@ -232,7 +271,10 @@ class PolicyEngine:
         sustained past the policy's patience window) — decided FIRST,
         since the supervisor's own SIGTERM makes the stopped workers
         write preemption bundles that must not be mistaken for a
-        scheduler eviction."""
+        scheduler eviction.  ``failed_hosts``: the host slots whose
+        workers exited nonzero (daemon-observed) — the replace rules
+        need the SLOT even when the dead worker left no disposition
+        at all (the kill -9 signature)."""
         d = disposition
         # 0. straggler eviction (opt-in): the daemon stopped a healthy-
         # but-slow incarnation on the sustained drift verdict — exclude
@@ -304,6 +346,25 @@ class PolicyEngine:
             want = set(d.hosts) | set(d.quarantine_delta)
             fresh = tuple(sorted(want - self.excluded))
             if fresh:
+                # replace-first (opt-in): the bad host is NAMED — with
+                # replace budget left, refill the slot instead of
+                # shrinking; the daemon provisions and, on failure,
+                # calls fallback_exclude() for the classic shrink
+                if (self.policy.replace and self.replacements_used
+                        < self.policy.replace_budget):
+                    budget = self._consume_budget("sdc-replace", etype)
+                    if budget is not None:
+                        return budget
+                    self.replacements_used += 1
+                    self.crash_streak = 0
+                    return Action(
+                        "replace", "sdc-replace", hosts=fresh,
+                        delay_s=self.policy.restart_delay_s,
+                        reason=f"{etype} at step {d.flagged_step}: "
+                               f"replacing host(s) {list(fresh)} "
+                               f"instead of shrinking (replacement "
+                               f"{self.replacements_used}"
+                               f"/{self.policy.replace_budget})")
                 if self.world - len(fresh) < self.policy.min_world:
                     return self._give_up(
                         "sdc-exclude",
@@ -341,11 +402,96 @@ class PolicyEngine:
                           delay_s=self.policy.restart_delay_s,
                           reason=f"{why}: kill + restart the same "
                                  f"world ({self.world})")
-        # 5. everything else: bounded crash loop
+        # 5. host vanished (opt-in replace): a worker exited nonzero
+        # and left NO typed disposition — the kill -9/VM-loss
+        # signature (a software failure writes a flight bundle on the
+        # way out; dead hardware cannot).  Refill the slot at the same
+        # world instead of burning the crash-backoff curve on capacity
+        # that is simply gone.  Peers' preemption bundles (the daemon's
+        # exit-grace drain) are collateral and do not veto this —
+        # rule 1 already rejected them on the nonzero exit code.
+        fresh_failed = tuple(sorted(set(failed_hosts or ())
+                                    - self.excluded))
+        if (self.policy.replace and fresh_failed and etype is None
+                and exit_code not in (None, 0)
+                and self.replacements_used
+                < self.policy.replace_budget):
+            budget = self._consume_budget(
+                "crash-replace", f"exit_code={exit_code}")
+            if budget is not None:
+                return budget
+            self.replacements_used += 1
+            self.crash_streak = 0
+            return Action(
+                "replace", "crash-replace", hosts=fresh_failed,
+                delay_s=self.policy.restart_delay_s,
+                reason=f"host(s) {list(fresh_failed)} exited "
+                       f"{exit_code} with no disposition bundle — "
+                       f"hardware-loss signature, replacing "
+                       f"(replacement {self.replacements_used}"
+                       f"/{self.policy.replace_budget})")
+        # 6. everything else: bounded crash loop
         return self._crash(
             "crash-backoff",
             f"{etype or 'unknown crash'} "
             f"(exit_code={exit_code}, no further diagnosis)")
+
+    # -- replacement bookkeeping (the daemon's half of the replace
+    # rules: decide() returns kind="replace", the daemon provisions,
+    # then reports the outcome here) ----------------------------------------
+
+    def note_replaced(self, hosts) -> None:
+        """Provisioning succeeded: the slots are refilled (reporting
+        only — a replaced slot was never excluded, the world is
+        unchanged)."""
+        self.replaced.update(int(h) for h in hosts)
+
+    def fallback_exclude(self, hosts, *, why: str = "") -> Action:
+        """Provisioning FAILED after a replace decision: take the
+        budget-bounded fallback — the classic exclude+shrink, under
+        rule ``replace-fallback-shrink``.  The replace decision
+        already consumed the restart unit, so none is charged here;
+        shrinking below ``min_world`` still gives up."""
+        rule = "replace-fallback-shrink"
+        fresh = tuple(sorted(set(int(h) for h in hosts)
+                             - self.excluded))
+        if not fresh:
+            # nothing new to exclude (replaced slot already gone):
+            # restart whatever world is left under the crash bound
+            return self._crash(rule, why or "provisioning failed, no "
+                                            "fresh host to exclude")
+        if self.world - len(fresh) < self.policy.min_world:
+            return self._give_up(
+                rule,
+                f"provisioning failed ({why or 'no capacity'}) and "
+                f"excluding host(s) {list(fresh)} would shrink the "
+                f"pod below min_world={self.policy.min_world}")
+        self.excluded.update(fresh)
+        return Action(
+            "restart_excluding", rule, hosts=fresh,
+            delay_s=self.policy.restart_delay_s,
+            reason=f"provisioning failed ({why or 'no capacity'}): "
+                   f"falling back to exclude+shrink of host(s) "
+                   f"{list(fresh)}, world={self.world}")
+
+    def charge_replacement(self) -> bool:
+        """Spend one replace-budget unit for a grow-back provisioning
+        attempt (between incarnations, no decide() involved).  False
+        when the budget is gone — the caller must not attempt."""
+        if (not self.policy.replace
+                or self.replacements_used >= self.policy.replace_budget):
+            return False
+        self.replacements_used += 1
+        return True
+
+    def readmit(self, hosts) -> int:
+        """Grow-back: previously excluded slots are refilled — remove
+        them from the exclusion set so the next incarnation launches
+        at the grown world.  Returns the new world size."""
+        for h in hosts:
+            self.excluded.discard(int(h))
+            self.replaced.add(int(h))
+        return self.world
 
     # -- helpers -------------------------------------------------------------
 

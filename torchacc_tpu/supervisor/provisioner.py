@@ -1,0 +1,318 @@
+"""Pluggable host provisioning: where replacement capacity comes from.
+
+The policy engine can now answer a hardware loss with *replace* instead
+of exclude+shrink (policy.py rules ``crash-replace``/``sdc-replace``),
+but someone has to actually produce the replacement host.  That someone
+is a :class:`Provisioner`: a narrow, jax-free capacity interface the
+daemon calls between incarnations.  Three backends:
+
+- :class:`LocalProvisioner` — the fully-testable one.  For local
+  subprocess pods the daemon itself respawns the worker in the failed
+  slot, so "provisioning" reduces to a capacity/latency model: does a
+  replacement slot exist, how long does acquiring it take, and when
+  does the supply run out.  Failure injection (``fail_next``) and a
+  deterministic acquisition delay make every policy path (success,
+  fallback-to-shrink, pool exhaustion) reproducible in unit tests and
+  the ``make chaos-replace`` gate.
+- :class:`GKEProvisioner` / :class:`RayProvisioner` — typed stubs
+  naming the real-cluster integration points (node-pool resize /
+  ``ray.autoscaler`` request).  They raise :class:`ProvisionError`
+  subtype ``NotImplementedError`` so a misconfigured production run
+  fails loudly at the first replacement attempt, not silently.
+
+Layered on top, :class:`SparePool` pre-warms N standby hosts at
+construction so a replacement costs seconds (pop a warm spare) instead
+of scheduler latency (cold-provision through the backend); when the
+pool runs dry it falls through to the backend's cold path, and only
+when THAT fails does the daemon take the policy's budget-bounded
+fallback to exclude+shrink.
+
+No jax, no subprocess management here — the daemon owns processes;
+this module only answers "may host slot ``h`` be refilled, and at what
+cost".
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+class ProvisionError(RuntimeError):
+    """A provisioning attempt failed (capacity exhausted, backend
+    unreachable, injected fault).  The daemon catches exactly this and
+    falls back to the policy's exclude+shrink path — anything else is
+    a supervisor bug and propagates."""
+
+
+@dataclass(frozen=True)
+class ProvisionRequest:
+    """Why the daemon wants a host: the slot being refilled, the policy
+    rule that asked, and the incarnation the failure happened in —
+    backends log/label capacity with it."""
+
+    slot: int
+    rule: str = ""
+    incarnation: int = -1
+
+
+@dataclass(frozen=True)
+class ProvisionedHost:
+    """A granted replacement.  ``warm`` marks a pre-warmed spare (the
+    pool hit); ``latency_s`` is what acquisition actually cost, so the
+    goodput ledger's ``down:provisioning`` bucket can be cross-checked
+    against the provisioner's own accounting."""
+
+    slot: int
+    origin: str                   # "local" | "spare-pool" | backend name
+    warm: bool = False
+    latency_s: float = 0.0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class Provisioner(abc.ABC):
+    """The capacity interface (docs/resilience.md "Host replacement &
+    grow-back").  Implementations must be thread-compatible with the
+    daemon's single decision loop — no reentrancy needed — and must
+    raise :class:`ProvisionError` (never return None) on failure."""
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def provision(self, request: ProvisionRequest) -> ProvisionedHost:
+        """Produce a replacement for ``request.slot`` or raise
+        :class:`ProvisionError`."""
+
+    def release(self, host: ProvisionedHost) -> None:
+        """Return capacity (a replaced host that was itself replaced,
+        or teardown).  Default: no-op."""
+
+    def capacity(self) -> Optional[int]:
+        """Remaining grants, or None when unknown/unbounded."""
+        return None
+
+    def close(self) -> None:
+        """Teardown (spare pools drain here).  Default: no-op."""
+
+    def stats(self) -> Dict[str, Any]:
+        """Strict-JSON accounting block for the ``/fleet`` payload."""
+        return {"backend": self.name, "capacity": self.capacity()}
+
+
+class LocalProvisioner(Provisioner):
+    """Capacity/latency model for local subprocess slots.
+
+    ``capacity``: total replacement grants available (None =
+    unbounded).  ``delay_s``: simulated acquisition latency, slept via
+    the injectable ``sleep`` so tests pin it to a fake clock.
+    ``fail_next``: the next N :meth:`provision` calls raise
+    :class:`ProvisionError` — the chaos hook the fallback-to-shrink
+    tests and the ``chaos-replace`` gate's scenario B lean on."""
+
+    name = "local"
+
+    def __init__(self, capacity: Optional[int] = None, *,
+                 delay_s: float = 0.0, fail_next: int = 0,
+                 sleep: Callable[[float], None] = time.sleep):
+        if capacity is not None and capacity < 0:
+            raise ValueError("capacity must be >= 0 or None")
+        self._capacity = capacity
+        self._delay_s = float(delay_s)
+        self._fail_next = int(fail_next)
+        self._sleep = sleep
+        self._granted = 0
+        self._failures = 0
+        self._lock = threading.Lock()
+
+    def provision(self, request: ProvisionRequest) -> ProvisionedHost:
+        with self._lock:
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                self._failures += 1
+                raise ProvisionError(
+                    f"local provisioner: injected failure for slot "
+                    f"{request.slot} (rule {request.rule or '?'})")
+            if (self._capacity is not None
+                    and self._granted >= self._capacity):
+                self._failures += 1
+                raise ProvisionError(
+                    f"local provisioner: capacity exhausted "
+                    f"({self._granted}/{self._capacity}) — cannot "
+                    f"refill slot {request.slot}")
+            self._granted += 1
+        if self._delay_s > 0:
+            self._sleep(self._delay_s)
+        return ProvisionedHost(slot=request.slot, origin=self.name,
+                               warm=False, latency_s=self._delay_s)
+
+    def release(self, host: ProvisionedHost) -> None:
+        with self._lock:
+            self._granted = max(self._granted - 1, 0)
+
+    def capacity(self) -> Optional[int]:
+        with self._lock:
+            if self._capacity is None:
+                return None
+            return max(self._capacity - self._granted, 0)
+
+    def fail_next(self, n: int = 1) -> None:
+        """Arm ``n`` injected failures (tests / chaos gates)."""
+        with self._lock:
+            self._fail_next = int(n)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"backend": self.name,
+                    "capacity": (None if self._capacity is None else
+                                 max(self._capacity - self._granted, 0)),
+                    "granted": self._granted,
+                    "failures": self._failures}
+
+
+class SparePool(Provisioner):
+    """Hot-spare pool over a backend: pre-warm ``spares`` hosts at
+    construction so a replacement is an O(1) pop, fall through to the
+    backend's cold path on exhaustion.
+
+    A prewarm shortfall (the backend could not fill the pool) is
+    recorded, not fatal — a smaller pool still beats none.  ``close``
+    releases unspent spares back to the backend."""
+
+    name = "spare-pool"
+
+    def __init__(self, backend: Provisioner, spares: int = 0):
+        if spares < 0:
+            raise ValueError("spares must be >= 0")
+        self.backend = backend
+        self._lock = threading.Lock()
+        self._pool: List[ProvisionedHost] = []
+        self._requested = int(spares)
+        self._warm_hits = 0
+        self._cold = 0
+        self._failures = 0
+        for i in range(spares):
+            try:
+                h = backend.provision(
+                    ProvisionRequest(slot=-1, rule="prewarm"))
+            except ProvisionError:
+                break
+            self._pool.append(h)
+        self._prewarmed = len(self._pool)
+
+    def provision(self, request: ProvisionRequest) -> ProvisionedHost:
+        with self._lock:
+            if self._pool:
+                spare = self._pool.pop()
+                self._warm_hits += 1
+                return ProvisionedHost(
+                    slot=request.slot, origin=self.name, warm=True,
+                    latency_s=0.0, meta={"backend": spare.origin})
+        try:
+            cold = self.backend.provision(request)
+        except ProvisionError:
+            with self._lock:
+                self._failures += 1
+            raise
+        with self._lock:
+            self._cold += 1
+        return cold
+
+    def release(self, host: ProvisionedHost) -> None:
+        self.backend.release(host)
+
+    def capacity(self) -> Optional[int]:
+        backend = self.backend.capacity()
+        with self._lock:
+            if backend is None:
+                return None
+            return backend + len(self._pool)
+
+    def spares_left(self) -> int:
+        with self._lock:
+            return len(self._pool)
+
+    def close(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, []
+        for h in pool:
+            self.backend.release(h)
+        self.backend.close()
+
+    def stats(self) -> Dict[str, Any]:
+        backend_cap = self.backend.capacity()
+        with self._lock:
+            cap = (None if backend_cap is None
+                   else backend_cap + len(self._pool))
+            return {"backend": f"{self.name}({self.backend.name})",
+                    "spares_requested": self._requested,
+                    "spares_prewarmed": self._prewarmed,
+                    "spares_left": len(self._pool),
+                    "warm_hits": self._warm_hits,
+                    "cold_provisions": self._cold,
+                    "failures": self._failures,
+                    "capacity": cap}
+
+
+class GKEProvisioner(Provisioner):
+    """Typed stub: GKE node-pool backed replacement.  The real
+    implementation resizes the TPU node pool (``gcloud container
+    node-pools resize`` / the container API) and waits for the
+    replacement VM to join the pod's instance group; the supervisor
+    then relaunches the worker slot against the new endpoint.  Left as
+    a stub — the local backend is the testable surface; wiring cluster
+    credentials into CI is out of scope."""
+
+    name = "gke"
+
+    def __init__(self, node_pool: str = "", zone: str = ""):
+        self.node_pool = node_pool
+        self.zone = zone
+
+    def provision(self, request: ProvisionRequest) -> ProvisionedHost:
+        raise NotImplementedError(
+            "GKEProvisioner is a typed stub: implement node-pool "
+            "resize + instance-group join for slot "
+            f"{request.slot} (node_pool={self.node_pool!r}, "
+            f"zone={self.zone!r})")
+
+
+class RayProvisioner(Provisioner):
+    """Typed stub: Ray-cluster backed replacement (the TorchAcc
+    lineage's orchestration layer).  The real implementation asks the
+    Ray autoscaler for a node with the pod's resource bundle and
+    schedules the worker actor there."""
+
+    name = "ray"
+
+    def __init__(self, address: str = "auto"):
+        self.address = address
+
+    def provision(self, request: ProvisionRequest) -> ProvisionedHost:
+        raise NotImplementedError(
+            "RayProvisioner is a typed stub: implement autoscaler "
+            f"request + actor placement for slot {request.slot} "
+            f"(address={self.address!r})")
+
+
+def build_provisioner(kind: str, *, spares: int = 0,
+                      capacity: Optional[int] = None,
+                      delay_s: float = 0.0) -> Provisioner:
+    """CLI/daemon factory: ``kind`` is ``local``/``gke``/``ray``;
+    ``spares > 0`` wraps the backend in a :class:`SparePool`."""
+    if kind == "local":
+        backend: Provisioner = LocalProvisioner(capacity=capacity,
+                                                delay_s=delay_s)
+    elif kind == "gke":
+        backend = GKEProvisioner()
+    elif kind == "ray":
+        backend = RayProvisioner()
+    else:
+        raise ValueError(
+            f"unknown provisioner kind {kind!r} "
+            "(expected local|gke|ray)")
+    if spares > 0:
+        return SparePool(backend, spares=spares)
+    return backend

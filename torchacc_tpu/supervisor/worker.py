@@ -52,19 +52,33 @@ def newest_valid_step(directory: Optional[str]) -> int:
     return max(valid_steps(directory), default=-1)
 
 
-#: the serve journal filename (one home for the rule is
-#: serve/journal.py JOURNAL_NAME; duplicated as a literal because the
-#: supervisor must not import the jax-backed serve package)
+#: the serve journal filenames (one home for the rule is
+#: serve/journal.py JOURNAL_NAME/ARCHIVE_NAME/SEGMENT_PREFIX;
+#: duplicated as literals because the supervisor must not import the
+#: jax-backed serve package).  Rotation (PR 16) splits one journal
+#: into active + rotated segments + a compacted archive — progress is
+#: the union over all of them.
 JOURNAL_NAME = "journal.jsonl"
+JOURNAL_GLOB_PREFIX = "journal"
+
+
+def _is_journal_file(name: str) -> bool:
+    return (name == JOURNAL_NAME
+            or (name.startswith(JOURNAL_GLOB_PREFIX + "-")
+                and name.endswith(".jsonl")))
 
 
 def serve_progress(run_dir: Optional[str]) -> int:
     """Total finished (completed + shed) journal records across every
-    ``journal.jsonl`` under ``run_dir`` (one or two levels deep — the
-    fixture keeps per-host journal dirs inside the run dir).  The
-    serve-role analogue of :func:`newest_valid_step`: the daemon's
-    durable-progress signal that resets the crash-loop streak.  Pure
-    filesystem, tolerant of torn tail lines."""
+    journal file (active ``journal.jsonl``, rotated ``journal-*.jsonl``
+    segments, compacted archive) under ``run_dir`` (one or two levels
+    deep — the fixture keeps per-host journal dirs inside the run
+    dir).  The serve-role analogue of :func:`newest_valid_step`: the
+    daemon's durable-progress signal that resets the crash-loop
+    streak.  Counts a request id at most once per journal dir (a
+    terminal record may transiently exist in both a segment and the
+    archive mid-compaction).  Pure filesystem, tolerant of torn tail
+    lines."""
     if not run_dir:
         return 0
     paths: List[str] = []
@@ -75,8 +89,9 @@ def serve_progress(run_dir: Optional[str]) -> int:
             if os.path.relpath(root, run_dir).count(os.sep) > 1:
                 dirs[:] = []
                 continue
-            if JOURNAL_NAME in names:
-                paths.append(os.path.join(root, JOURNAL_NAME))
+            for n in names:
+                if _is_journal_file(n):
+                    paths.append(os.path.join(root, n))
     except OSError:
         return 0
     done = 0
