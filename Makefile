@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc chaos-replace serve-smoke serve-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc chaos-replace serve-smoke serve-chaos router-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -113,6 +113,20 @@ fleet-smoke:
 serve-chaos:
 	JAX_PLATFORMS=cpu python scripts/serve_chaos_smoke.py
 
+# routing-tier fault-tolerance gate (docs/serving.md "Router tier"):
+# (A) SIGKILL a serve replica mid-decode behind the router -> the
+# circuit breaker opens on consecutive probe failures, the journal-
+# named remainder fails over to the survivor under the original rids
+# (greedy tokens identical to a single-engine reference), and the
+# router's breaker/failover/goodput series surface on the daemon's
+# aggregated /metrics + /fleet; (B) SIGKILL the ROUTER mid-wave ->
+# restart replays the assignment journal and reconciles against the
+# workers' journals — 100% accounting, no duplicate completions;
+# (C) a same-template wave pins the warm replica (prefix_hit_rate)
+# vs a routing-off control that spreads it cold
+router-chaos:
+	JAX_PLATFORMS=cpu python scripts/router_chaos_smoke.py
+
 # host-replacement gate (docs/resilience.md "Host replacement &
 # grow-back"): (1) a 2-process dp=2 worker SIGKILLs itself (no flight
 # bundle — the hardware-loss signature) -> crash-replace -> the hot-
@@ -144,12 +158,14 @@ chaos:
 			tests/test_obs.py tests/test_profiling.py \
 			tests/test_supervisor.py tests/test_fleet.py \
 			tests/test_serve_resilience.py \
+			tests/test_router.py \
 			-m "not slow" \
 			-q || exit 1; \
 	done
 	$(MAKE) supervisor-smoke
 	$(MAKE) fleet-smoke
 	$(MAKE) serve-chaos
+	$(MAKE) router-chaos
 	$(MAKE) chaos-replace
 
 # multi-host robustness proof: 2-process jax.distributed fixtures

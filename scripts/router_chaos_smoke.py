@@ -1,0 +1,501 @@
+#!/usr/bin/env python
+"""`make router-chaos`: the end-to-end routing-tier fault-tolerance
+gate (docs/serving.md "Router tier").
+
+Three scenarios, zero human intervention, all on CPU:
+
+A. **worker kill -9 mid-decode -> breaker -> journal-backed failover**:
+   two supervised serve workers (HTTP mode, stable ports via
+   ``WorkerSpec.obs_port_base``) behind the router; a ChaosPlan
+   SIGKILLs worker 0 mid-decode.  The router's breaker opens on
+   consecutive probe failures, the journal-named remainder fails over
+   to the survivor under the original rids, and the supervisor heals
+   the pod in parallel.  The gate FAILS unless 100% of requests are
+   accounted (completed greedy tokens identical to a single-engine
+   reference, or typed shed), zero pending — and the router's
+   routed/failover counters, route-decision histogram and
+   degraded-goodput bucket all surface on the DAEMON's aggregated
+   /metrics + /fleet under reserved host -1.
+B. **kill -9 the ROUTER mid-wave -> restart -> assignment replay**: a
+   ChaosPlan SIGKILLs the router at the Nth route.  The restarted
+   router replays its assignment journal, adopts/harvests in-flight
+   work from the workers' journals, and the client resubmits only the
+   requests that never got a rid.  Same 100% accounting, and the
+   journal carries EXACTLY one terminal record per rid — no duplicate
+   completions.
+C. **steady-state prefix affinity**: a same-template wave through an
+   affinity-on router lands on ONE replica whose /admission reports a
+   warm prefix_hit_rate; the routing-off control spreads the wave and
+   every control replica hits colder.
+
+FAILS (exit 1) unless every assertion holds.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from torchacc_tpu.serve.router_client import RouterClient  # noqa: E402
+from torchacc_tpu.supervisor import (  # noqa: E402
+    RestartPolicy,
+    Supervisor,
+    WorkerSpec,
+    free_port,
+)
+
+WORKER_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+}
+FIXTURE = [sys.executable, "-m", "torchacc_tpu.supervisor.serve_fixture"]
+ROUTER = [sys.executable, "-m", "torchacc_tpu.serve.router"]
+JOURNAL_NAME = "journal.jsonl"
+
+
+def check(ok, msg):
+    tag = "ok" if ok else "FAIL"
+    print(f"  [{tag}] {msg}", flush=True)
+    if not ok:
+        raise SystemExit(f"router-chaos FAILED: {msg}")
+
+
+def free_port_pair():
+    """Two CONSECUTIVE free ports (obs_port_base wants base..base+1)."""
+    import socket
+    for _ in range(50):
+        base = free_port()
+        try:
+            s = socket.socket()
+            s.bind(("127.0.0.1", base + 1))
+        except OSError:
+            continue
+        s.close()
+        return base
+    raise SystemExit("no consecutive free port pair")
+
+
+def fetch_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def fetch_text(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def post_json(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def wait_healthz(port, timeout_s=180.0, what="worker"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            fetch_json(port, "/healthz")
+            return
+        except (OSError, ValueError):
+            time.sleep(0.25)
+    raise SystemExit(f"{what} on port {port} never served /healthz")
+
+
+def read_jdir(jdir):
+    """(pending, completed, shed, terminal_counts) from one journal
+    dir's active file — stdlib-only, same shape the serve gate uses."""
+    accepted, completed, shed, terminals = {}, {}, {}, {}
+    try:
+        with open(os.path.join(jdir, JOURNAL_NAME), "rb") as f:
+            raw = f.read()
+    except OSError:
+        return accepted, completed, shed, terminals
+    for line in raw.splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        rid, kind = rec.get("rid"), rec.get("kind")
+        if kind == "accepted":
+            accepted.setdefault(rid, rec)
+        elif kind == "completed":
+            completed[rid] = rec
+            terminals[rid] = terminals.get(rid, 0) + 1
+        elif kind == "shed":
+            shed[rid] = rec
+            terminals[rid] = terminals.get(rid, 0) + 1
+    pending = {r: v for r, v in accepted.items()
+               if r not in completed and r not in shed}
+    return pending, completed, shed, terminals
+
+
+def prompts_for(seed, n):
+    rng = random.Random(seed * 7919 + 3)
+    return [[rng.randrange(1, 64) for _ in range(rng.randrange(10, 21))]
+            for _ in range(n)]
+
+
+def start_worker(run_dir, host, port, *, serve_for_s=90.0, max_new=16,
+                 prefix_cache=False):
+    argv = FIXTURE + ["--run-dir", run_dir, "--host", str(host),
+                      "--obs-port", str(port), "--serve-http",
+                      "--serve-for-s", str(serve_for_s),
+                      "--max-new", str(max_new)]
+    if prefix_cache:
+        argv += ["--prefix-cache"]
+    log = open(os.path.join(run_dir, f"worker_h{host}.log"), "w")
+    proc = subprocess.Popen(argv, env=dict(os.environ, **WORKER_ENV),
+                            stdout=log, stderr=subprocess.STDOUT)
+    return proc, log
+
+
+def start_router(port, jdir, workers, *, affinity=True, chaos=None,
+                 log_path=None):
+    argv = ROUTER + ["--port", str(port), "--journal-dir", jdir,
+                     "--block-size", "8", "--breaker-failures", "2",
+                     "--breaker-cooldown-s", "1.0",
+                     "--health-interval-s", "0.25", "--seed", "0",
+                     "--no-fsync"]
+    for host, (wport, wjdir) in sorted(workers.items()):
+        argv += ["--worker",
+                 f"{host}=http://127.0.0.1:{wport};{wjdir}"]
+    if not affinity:
+        argv += ["--no-affinity"]
+    if chaos:
+        argv += ["--chaos", json.dumps(chaos)]
+    log = open(log_path or os.devnull, "a")
+    proc = subprocess.Popen(argv, env=dict(os.environ, **WORKER_ENV),
+                            stdout=log, stderr=subprocess.STDOUT)
+    wait_healthz(port, what="router")
+    return proc, log
+
+
+def reference_tokens(tmp, prompts, max_new):
+    """Single-engine reference: one clean worker serves the same
+    prompts directly (no router) — the greedy tokens every failover /
+    replay path must reproduce."""
+    d = os.path.join(tmp, "reference")
+    os.makedirs(d, exist_ok=True)
+    port = free_port()
+    proc, log = start_worker(d, 0, port, serve_for_s=120.0,
+                             max_new=max_new)
+    try:
+        wait_healthz(port, what="reference worker")
+        rids = [post_json(port, "/submit",
+                          {"prompt_ids": p, "max_new_tokens": max_new,
+                           "trace_id": f"ref-{i}"})["rid"]
+                for i, p in enumerate(prompts)]
+        out = {}
+        t0 = time.monotonic()
+        while len(out) < len(rids) and time.monotonic() - t0 < 120:
+            for i, rid in enumerate(rids):
+                if i in out:
+                    continue
+                r = post_json(port, "/result", {"rid": rid})
+                if r["status"] == "completed":
+                    out[i] = r["tokens"]
+            time.sleep(0.1)
+        check(len(out) == len(prompts), "reference run served all")
+        return out
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+        log.close()
+
+
+def scenario_worker_kill(tmp, obs_port):
+    print("== scenario A: SIGKILL worker 0 mid-decode -> breaker opens "
+          "-> journal-backed failover ==", flush=True)
+    run_dir = os.path.join(tmp, "kill")
+    os.makedirs(run_dir)
+    base = free_port_pair()
+    router_port = free_port()
+    n_req, max_new = 10, 8
+    spec = WorkerSpec(
+        run_dir=run_dir, world_size=2, role="serve",
+        argv=FIXTURE + [
+            "--run-dir", "{run_dir}", "--world", "{world}",
+            "--host", "{host}", "--obs-port", "{obs_port}",
+            "--incarnation", "{incarnation}", "--serve-http",
+            "--serve-for-s", "25", "--max-new", str(max_new),
+            "--chaos", json.dumps({"kill": {"after": 8, "host": 0}}),
+            "--chaos-incarnation", "0"],
+        env=WORKER_ENV, obs_port_base=base,
+        exit_grace_s=600.0, incarnation_timeout_s=600.0)
+    sup = Supervisor(spec, RestartPolicy(max_restarts=3,
+                                         backoff_initial_s=0.2),
+                     obs_port=obs_port, fleet_poll_interval_s=1.0,
+                     router_url=f"http://127.0.0.1:{router_port}")
+    box = {}
+    th = threading.Thread(target=lambda: box.update(report=sup.run()),
+                          daemon=True)
+    th.start()
+    wait_healthz(base)
+    wait_healthz(base + 1)
+    jdir = os.path.join(run_dir, "router_journal")
+    rproc, rlog = start_router(
+        router_port, jdir,
+        {0: (base, os.path.join(run_dir, "journal_h0")),
+         1: (base + 1, os.path.join(run_dir, "journal_h1"))},
+        log_path=os.path.join(run_dir, "router.log"))
+    try:
+        client = RouterClient(f"http://127.0.0.1:{router_port}",
+                              timeout_s=10.0, retries=1)
+        prompts = prompts_for(1, n_req)
+        rids = {}
+        for i, p in enumerate(prompts):
+            out = client.submit(p, max_new_tokens=max_new,
+                                trace_id=f"gate-{i}")
+            check(out.get("status") in ("routed", "queued"),
+                  f"request {i} admitted ({out})")
+            rids[i] = out["rid"]
+        shed_out = client.submit(prompts[0], max_new_tokens=max_new,
+                                 deadline_s=-1.0)
+        check(shed_out.get("status") == "shed",
+              f"unmeetable deadline shed at the front door ({shed_out})")
+        results = {}
+        for i, rid in rids.items():
+            r = client.await_result(rid, timeout_s=90.0)
+            check(r.get("status") == "completed",
+                  f"request {i} (rid {rid}) completed after failover "
+                  f"({r.get('status')})")
+            results[i] = r["tokens"]
+        ref = reference_tokens(tmp, prompts, max_new)
+        bad = [i for i in results if results[i] != ref[i]]
+        check(not bad, f"all {n_req} completions token-identical to "
+                       f"the single-engine reference"
+                       + (f"; MISMATCH {bad}" if bad else ""))
+        rm = fetch_text(router_port, "/metrics")
+        check("torchacc_router_breaker_opens_total" in rm,
+              "breaker opened on the dead replica")
+        check("torchacc_router_requests_failover_total" in rm,
+              "failover counter on the router's /metrics")
+        check("torchacc_router_goodput_degraded_ms_total" in rm,
+              "breaker flap attributed to the degraded goodput bucket")
+        acc = client.state()["accounting"]
+        check(acc["pending"] == [] and acc["completed"] == n_req
+              and acc["shed"] == 1,
+              f"router accounting: 100% of {acc['routed']} rids "
+              f"terminal ({acc})")
+        pending, completed, shed, _ = read_jdir(jdir)
+        check(not pending and len(completed) == n_req and len(shed) == 1,
+              "assignment journal agrees (zero silent losses)")
+        time.sleep(2.5)            # >= 2 fleet scrape rounds
+        fleet = fetch_json(obs_port, "/fleet")
+        check("-1" in fleet["hosts"],
+              "router scraped under reserved host -1 on /fleet")
+        dm = fetch_text(obs_port, "/metrics")
+        for series in ("torchacc_fleet_router_requests_routed_total",
+                       "torchacc_fleet_router_requests_failover_total",
+                       "torchacc_fleet_router_goodput_degraded_ms_total",
+                       "torchacc_fleet_router_route_decision_ms"):
+            check(series in dm,
+                  f"{series} rides the daemon's aggregated /metrics")
+    finally:
+        rproc.terminate()
+        rproc.wait(timeout=30)
+        rlog.close()
+    th.join(timeout=180)
+    check(not th.is_alive() and box["report"]["status"] == "completed",
+          "supervisor healed the pod and completed unattended")
+    rules = [d["rule"] for d in box["report"]["decisions"]]
+    check("crash-backoff" in rules,
+          f"supervisor recorded the crash restart ({rules})")
+
+
+def scenario_router_kill(tmp):
+    print("== scenario B: SIGKILL the ROUTER mid-wave -> restart -> "
+          "assignment-journal replay ==", flush=True)
+    run_dir = os.path.join(tmp, "rkill")
+    os.makedirs(run_dir)
+    p0, p1 = free_port(), free_port()
+    w0, l0 = start_worker(run_dir, 0, p0)
+    w1, l1 = start_worker(run_dir, 1, p1)
+    router_port = free_port()
+    jdir = os.path.join(run_dir, "router_journal")
+    workers = {0: (p0, os.path.join(run_dir, "journal_h0")),
+               1: (p1, os.path.join(run_dir, "journal_h1"))}
+    n_req, max_new = 8, 16
+    prompts = prompts_for(2, n_req)
+    rproc = rlog = None
+    try:
+        wait_healthz(p0)
+        wait_healthz(p1)
+        rproc, rlog = start_router(
+            router_port, jdir, workers,
+            chaos={"kill": {"after": 5}},
+            log_path=os.path.join(run_dir, "router.log"))
+        client = RouterClient(f"http://127.0.0.1:{router_port}",
+                              timeout_s=10.0, retries=0)
+        rids, unacked = {}, []
+        for i, p in enumerate(prompts):
+            try:
+                out = client.submit(p, max_new_tokens=max_new,
+                                    trace_id=f"gate-{i}")
+                rids[i] = out["rid"]
+            except (OSError, ValueError):
+                unacked.append(i)
+        check(unacked, f"router died mid-wave as planned "
+                       f"({len(rids)} acked, {len(unacked)} unacked)")
+        rproc.wait(timeout=30)
+        check(rproc.returncode not in (0, None),
+              f"router exited by SIGKILL ({rproc.returncode})")
+        rlog.close()
+        # restart on the SAME journal: replay + worker reconciliation
+        rproc, rlog = start_router(
+            router_port, jdir, workers,
+            log_path=os.path.join(run_dir, "router.log"))
+        rm = fetch_text(router_port, "/metrics")
+        check("torchacc_router_requests_replayed_total" in rm,
+              "restarted router replayed pending assignments")
+        client = RouterClient(f"http://127.0.0.1:{router_port}",
+                              timeout_s=10.0, retries=1)
+        for i in unacked:
+            out = client.submit(prompts[i], max_new_tokens=max_new,
+                                trace_id=f"gate-{i}-retry")
+            rids[i] = out["rid"]
+        results = {}
+        for i, rid in sorted(rids.items()):
+            r = client.await_result(rid, timeout_s=90.0)
+            check(r.get("status") == "completed",
+                  f"request {i} (rid {rid}) completed across the "
+                  f"router restart ({r.get('status')})")
+            results[i] = r["tokens"]
+        ref = reference_tokens(tmp, prompts, max_new)
+        bad = [i for i in results if results[i] != ref[i]]
+        check(not bad, "completions token-identical to the reference"
+                       + (f"; MISMATCH {bad}" if bad else ""))
+        acc = client.state()["accounting"]
+        pending, completed, shed, terminals = read_jdir(jdir)
+        check(acc["pending"] == [] and not pending,
+              f"no request lost across the router kill ({acc})")
+        check(set(completed) == set(range(acc["routed"])) and not shed,
+              f"every journaled rid completed exactly once "
+              f"(routed={acc['routed']})")
+        dup = {r: n for r, n in terminals.items() if n != 1}
+        check(not dup, f"no duplicate completions in the journal "
+                       f"({dup})")
+    finally:
+        if rproc is not None:
+            rproc.terminate()
+            try:
+                rproc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                rproc.kill()
+            rlog.close()
+        for proc, log in ((w0, l0), (w1, l1)):
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
+
+
+def _affinity_wave(run_dir, tmp_seed, *, affinity):
+    p0, p1 = free_port(), free_port()
+    os.makedirs(run_dir)
+    w0, l0 = start_worker(run_dir, 0, p0, prefix_cache=True)
+    w1, l1 = start_worker(run_dir, 1, p1, prefix_cache=True)
+    router_port = free_port()
+    rproc = rlog = None
+    try:
+        wait_healthz(p0)
+        wait_healthz(p1)
+        rproc, rlog = start_router(
+            router_port, os.path.join(run_dir, "router_journal"),
+            {0: (p0, os.path.join(run_dir, "journal_h0")),
+             1: (p1, os.path.join(run_dir, "journal_h1"))},
+            affinity=affinity,
+            log_path=os.path.join(run_dir, "router.log"))
+        client = RouterClient(f"http://127.0.0.1:{router_port}",
+                              timeout_s=10.0, retries=1)
+        rng = random.Random(tmp_seed)
+        template = [rng.randrange(1, 64) for _ in range(16)]
+        routed_by = []
+        for i in range(10):
+            out = client.submit(template + [i + 1],
+                                max_new_tokens=4)
+            routed_by.append(out.get("routed_by"))
+            r = client.await_result(out["rid"], timeout_s=60.0)
+            check(r.get("status") == "completed",
+                  f"wave request {i} completed")
+        admissions = {h: fetch_json(p, "/admission")
+                      for h, p in ((0, p0), (1, p1))}
+        return routed_by, admissions
+    finally:
+        if rproc is not None:
+            rproc.terminate()
+            rproc.wait(timeout=30)
+            rlog.close()
+        for proc, log in ((w0, l0), (w1, l1)):
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            log.close()
+
+
+def scenario_affinity(tmp):
+    print("== scenario C: same-template wave -> prefix affinity pins "
+          "the warm replica (vs routing-off control) ==", flush=True)
+    routed_by, adm = _affinity_wave(os.path.join(tmp, "affine"), 5,
+                                    affinity=True)
+    check(routed_by.count("affinity") >= 9,
+          f"wave routed by affinity after the first request "
+          f"({routed_by})")
+    served = {h: a["requests"] for h, a in adm.items()}
+    affine = max(served, key=served.get)
+    check(served[affine] == 10 and served[1 - affine] == 0,
+          f"whole wave pinned to replica {affine} ({served})")
+    hit_rate = adm[affine]["prefix_hits"] / max(adm[affine]["requests"],
+                                                1)
+    check(hit_rate >= 0.9,
+          f"affine replica warm: prefix_hit_rate={hit_rate:.2f}")
+    _, ctl = _affinity_wave(os.path.join(tmp, "control"), 5,
+                            affinity=False)
+    ctl_served = {h: a["requests"] for h, a in ctl.items()}
+    check(all(v > 0 for v in ctl_served.values()),
+          f"routing-off control spread the wave ({ctl_served})")
+    ctl_rates = {h: a["prefix_hits"] / max(a["requests"], 1)
+                 for h, a in ctl.items()}
+    check(all(hit_rate > r for r in ctl_rates.values()),
+          f"affine hit rate {hit_rate:.2f} beats every control "
+          f"replica ({ {h: round(r, 2) for h, r in ctl_rates.items()} })")
+
+
+def main() -> int:
+    t0 = time.time()
+    # ONE daemon obs port for the gate (the telemetry server is a
+    # process-wide singleton; last-owner-wins registration)
+    obs_port = free_port()
+    with tempfile.TemporaryDirectory(prefix="router_chaos_") as tmp:
+        scenario_worker_kill(tmp, obs_port)
+        scenario_router_kill(tmp)
+        scenario_affinity(tmp)
+    print(f"router-chaos PASSED in {time.time() - t0:.0f}s", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

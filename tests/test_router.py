@@ -1,0 +1,395 @@
+"""Serve routing tier tests (serve/router.py, serve/router_client.py;
+docs/serving.md "Router tier").
+
+The contracts under test:
+
+- :func:`chain_keys` is byte-identical to ``PrefixIndex.keys`` — the
+  router's affinity map and the worker's prefix cache must hash the
+  same block chains or affinity routes cold;
+- the circuit breaker's closed -> open -> half-open -> closed state
+  machine on a fake clock: threshold opens, cooldown gates the probe,
+  a half-open failure re-opens, a success closes and resets;
+- the front door sheds provably-unmeetable deadlines (typed,
+  journaled), 429s when every breaker is open, and never loses a
+  journaled rid even when the submit itself fails (orphan reconcile);
+- the router's assignment journal replays idempotently — a restarted
+  router reports the same accounting, and failover dedupe means a
+  completion can land at most once per rid no matter how many workers
+  eventually serve it;
+- journal-backed failover harvests completions from a dead worker's
+  on-disk journal and resubmits only the true remainder to survivors
+  under the original rids;
+- prefix-affinity sends same-template traffic to the replica that saw
+  the template first; drain pins exclude a replica and resume
+  re-admits it;
+- the router module never imports the serve engine/scheduler
+  (subprocess-checked: the lazy serve package keeps the routing tier
+  jax-engine-free).
+"""
+
+import json
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from torchacc_tpu.serve.journal import RequestJournal, read_journal
+from torchacc_tpu.serve.router import (CircuitBreaker, Router,
+                                       RouterConfig, WorkerRef,
+                                       chain_keys)
+
+
+class StubWorker:
+    """A wire-level fake replica: /healthz, /admission, /submit,
+    /result — enough surface for the router, none of the engine."""
+
+    def __init__(self):
+        self.submits = []
+        self.results = {}          # wrid -> result doc override
+        self.fail_healthz = False
+        state = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, doc, code=200):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0]
+                if path == "/healthz":
+                    if state.fail_healthz:
+                        self.send_error(503)
+                    else:
+                        self._json({"status": "ok"})
+                elif path == "/admission":
+                    self._json({"queue_depth": len(state.submits),
+                                "slots_busy": 0, "free_blocks": 64,
+                                "draining": False})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/submit":
+                    state.submits.append(payload)
+                    self._json({"rid": len(state.submits) - 1})
+                elif self.path == "/result":
+                    wrid = int(payload.get("rid", -1))
+                    self._json(state.results.get(
+                        wrid, {"rid": wrid, "status": "pending"}))
+                else:
+                    self.send_error(404)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _cfg(**kw):
+    base = dict(block_size=8, breaker_failures=1, breaker_cooldown_s=5.0,
+                probe_timeout_s=0.5, http_timeout_s=2.0,
+                admission_ttl_s=0.0, journal_fsync=False)
+    base.update(kw)
+    return RouterConfig(**base)
+
+
+def _prompt(seed, n=20):
+    return np.random.default_rng(seed).integers(1, 64, size=n).tolist()
+
+
+# -- chain keys ----------------------------------------------------------------
+
+
+def test_chain_keys_match_prefix_index():
+    from torchacc_tpu.serve.kv_cache import PrefixIndex
+    for bs in (4, 8, 16):
+        idx = PrefixIndex(block_size=bs)
+        for seed, n in ((0, 3), (1, 8), (2, 29), (3, 64)):
+            prompt = _prompt(seed, n)
+            assert chain_keys(prompt, bs) == idx.keys(
+                np.asarray(prompt, np.int32))
+
+
+def test_chain_keys_partial_block_and_chaining():
+    assert chain_keys([1, 2, 3], 8) == []
+    a = chain_keys(list(range(1, 17)), 8)
+    b = chain_keys(list(range(1, 17)) + [63] * 8, 8)
+    assert len(a) == 2 and len(b) == 3
+    assert b[:2] == a                      # shared prefix, shared chain
+    assert len(set(b)) == 3                # parent digest chains
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+
+def test_breaker_state_machine_fake_clock():
+    t = [0.0]
+    b = CircuitBreaker(failure_threshold=3, cooldown_s=10.0,
+                       clock=lambda: t[0])
+    assert b.routable and b.should_probe()
+    assert not b.record_failure() and not b.record_failure()
+    assert b.state == "closed"
+    assert b.record_failure()              # third consecutive: opens
+    assert b.state == "open" and b.opens == 1 and not b.routable
+    assert not b.should_probe()            # cooldown not elapsed
+    t[0] = 9.9
+    assert not b.should_probe()
+    t[0] = 10.0
+    assert b.should_probe() and b.state == "half_open"
+    assert b.record_failure()              # half-open probe failed
+    assert b.state == "open" and b.opens == 2
+    t[0] = 25.0
+    assert b.should_probe() and b.state == "half_open"
+    assert b.record_success()              # readmission edge reported
+    assert b.state == "closed" and b.failures == 0 and b.routable
+    assert not b.record_success()          # steady-state success: quiet
+
+
+def test_breaker_success_resets_consecutive_count():
+    b = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                       clock=lambda: 0.0)
+    b.record_failure()
+    b.record_success()
+    assert not b.record_failure()          # streak restarted
+    assert b.state == "closed"
+
+
+# -- front door: shed / 429 / orphan ------------------------------------------
+
+
+def test_front_door_shed_429_and_orphan(tmp_path):
+    rt = Router(str(tmp_path / "rj"),
+                [WorkerRef(0, "http://127.0.0.1:9")], _cfg())
+    try:
+        out = rt.route({"prompt_ids": _prompt(0), "deadline_s": -0.5})
+        assert out["status"] == "shed"
+        assert out["reason"] == "deadline-unmeetable"
+        code, doc = rt.route({"prompt_ids": []})
+        assert code == 400
+        # dead worker: the submit fails but the journaled rid survives
+        # as an orphan, not a loss
+        out = rt.route({"prompt_ids": _prompt(1)})
+        assert out["status"] == "queued" and out["worker"] is None
+        rt.health_check_once()             # breaker opens (threshold 1)
+        code, doc = rt.route({"prompt_ids": _prompt(2)})
+        assert code == 429
+        acc = rt.accounting()
+        assert acc == {"routed": 2, "pending": [1], "completed": 0,
+                       "shed": 1}
+    finally:
+        rt.close()
+
+
+def test_router_draining_429(tmp_path):
+    w = StubWorker()
+    rt = Router(str(tmp_path / "rj"), [WorkerRef(0, w.url)], _cfg())
+    try:
+        rt.drain({"all": True})
+        code, doc = rt.route({"prompt_ids": _prompt(0)})
+        assert code == 429 and "draining" in doc["error"]
+        rt.drain({"all": True, "op": "resume"})
+        out = rt.route({"prompt_ids": _prompt(0)})
+        assert out["status"] == "routed"
+    finally:
+        rt.close()
+        w.close()
+
+
+# -- journal replay ------------------------------------------------------------
+
+
+def test_router_journal_replay_idempotent(tmp_path):
+    jd = str(tmp_path / "rj")
+    w = StubWorker()
+    try:
+        rt = Router(jd, [WorkerRef(0, w.url)], _cfg())
+        r0 = rt.route({"prompt_ids": _prompt(0)})
+        r1 = rt.route({"prompt_ids": _prompt(1)})
+        assert r0["status"] == r1["status"] == "routed"
+        w.results[r0["rid"]] = {"status": "completed",
+                                "tokens": [5, 6], "finish_reason": "eos"}
+        # keyed by the WORKER-side rid the stub assigned in order
+        res = rt.result(r0["rid"])
+        assert res["status"] == "completed" and res["tokens"] == [5, 6]
+        rt.route({"prompt_ids": _prompt(2), "deadline_s": 0.0})
+        acc = rt.accounting()
+        rt.close()
+
+        # restart twice: same accounting, nothing re-journaled twice
+        for _ in range(2):
+            rt = Router(jd, [WorkerRef(0, w.url)], _cfg())
+            assert rt.accounting() == acc
+            res = rt.result(r0["rid"])
+            assert res["status"] == "completed" and res["tokens"] == [5, 6]
+            rt.close()
+        terminal = [r for r in read_journal(jd)
+                    if r["kind"] in ("completed", "shed")]
+        assert len(terminal) == 2          # one completed + one shed
+    finally:
+        w.close()
+
+
+# -- journal-backed failover ---------------------------------------------------
+
+
+def _seed_router_assignments(jd, wjd, *, completed_tokens):
+    """Build the crash scene: the router journaled two assignments to
+    worker 0; worker 0's own journal shows rid 0 completed and rid 1
+    still pending when it died."""
+    rj = RequestJournal(jd, fsync=False)
+    wj = RequestJournal(wjd, fsync=False)
+    for rid in (0, 1):
+        rj.append({"kind": "accepted", "rid": rid,
+                   "trace_id": f"req-{rid}",
+                   "prompt_ids": _prompt(rid),
+                   "max_new_tokens": 8, "temperature": 0.0,
+                   "top_k": 0, "top_p": 1.0, "eos_id": None,
+                   "seed": 0, "priority": 0, "deadline_unix": None,
+                   "t_accept": 0.0, "worker": 0})
+        wj.accepted(rid=rid + 40, trace_id=f"router-{rid}",
+                    prompt_ids=_prompt(rid), max_new_tokens=8,
+                    temperature=0.0, top_k=0, top_p=1.0, eos_id=None,
+                    seed=0, priority=0, deadline_unix=None)
+    wj.completed(rid=40, tokens=completed_tokens, finish_reason="eos")
+    rj.close()
+    wj.close()
+
+
+def test_failover_harvests_completions_and_moves_remainder(tmp_path):
+    jd, wjd = str(tmp_path / "rj"), str(tmp_path / "wj0")
+    _seed_router_assignments(jd, wjd, completed_tokens=[7, 8, 9])
+    survivor = StubWorker()
+    try:
+        rt = Router(jd, [WorkerRef(0, "http://127.0.0.1:9",
+                                   journal_dir=wjd),
+                         WorkerRef(1, survivor.url)],
+                    _cfg(breaker_failures=2))
+        try:
+            # recovery harvested rid 0 from the dead worker's journal
+            # and ADOPTED rid 1 (the breaker has not yet learned the
+            # worker is gone); the failover of the remainder rides the
+            # breaker-open edge two health ticks later
+            res = rt.result(0)
+            assert res["status"] == "completed"
+            assert res["tokens"] == [7, 8, 9]
+            assert len(survivor.submits) == 0
+            rt.health_check_once()
+            states = rt.health_check_once()
+            assert states["0"] == "open"
+            assert len(survivor.submits) == 1
+            assert survivor.submits[0]["trace_id"] == "router-1"
+            acc = rt.accounting()
+            assert acc["completed"] == 1 and acc["pending"] == [1]
+            # dedupe: a late duplicate completion for rid 0 (the
+            # supervisor restarted worker 0, which replayed and
+            # re-served it) must not double-count
+            assert not rt._complete(0, [7, 8, 9], "eos")
+            assert rt.accounting()["completed"] == 1
+        finally:
+            rt.close()
+        terminal = [r for r in read_journal(jd)
+                    if r["kind"] == "completed"]
+        assert len(terminal) == 1          # exactly-once in the journal
+    finally:
+        survivor.close()
+
+
+def test_breaker_open_triggers_failover(tmp_path):
+    a, b = StubWorker(), StubWorker()
+    rt = Router(str(tmp_path / "rj"),
+                [WorkerRef(0, a.url), WorkerRef(1, b.url)],
+                _cfg(affinity=False, breaker_failures=2))
+    try:
+        rt.health_check_once()
+        routed = [rt.route({"prompt_ids": _prompt(i)}) for i in range(4)]
+        assert all(r["status"] == "routed" for r in routed)
+        a_rids = [r["rid"] for r in routed if r["worker"] == 0]
+        assert a_rids and len(a_rids) < 4  # p2c spread both ways
+        before = len(b.submits)
+        a.close()                          # replica dies mid-flight
+        rt.health_check_once()             # failure 1
+        states = rt.health_check_once()    # failure 2: opens + failover
+        assert states["0"] == "open" and states["1"] == "closed"
+        assert len(b.submits) == before + len(a_rids)
+        moved = {s["trace_id"] for s in b.submits[before:]}
+        assert moved == {f"router-{r}" for r in a_rids}
+        assert rt.accounting()["pending"] == [r["rid"] for r in routed]
+    finally:
+        rt.close()
+        b.close()
+
+
+# -- affinity ------------------------------------------------------------------
+
+
+def test_prefix_affinity_pins_template_to_replica(tmp_path):
+    a, b = StubWorker(), StubWorker()
+    rt = Router(str(tmp_path / "rj"),
+                [WorkerRef(0, a.url), WorkerRef(1, b.url)], _cfg())
+    try:
+        rt.health_check_once()
+        template = list(range(1, 17))      # two full blocks at bs=8
+        first = rt.route({"prompt_ids": template + [20, 21]})
+        hosts = {first["worker"]}
+        for tail in ([30], [31, 32], [33, 34, 35]):
+            out = rt.route({"prompt_ids": template + tail})
+            assert out["routed_by"] == "affinity"
+            hosts.add(out["worker"])
+        assert hosts == {first["worker"]}  # template never migrates
+        cold = rt.route({"prompt_ids": [9] * 3})   # no full block
+        assert cold["routed_by"] == "p2c"
+    finally:
+        rt.close()
+        a.close()
+        b.close()
+
+
+def test_drain_pin_excludes_and_resume_readmits(tmp_path):
+    a, b = StubWorker(), StubWorker()
+    rt = Router(str(tmp_path / "rj"),
+                [WorkerRef(0, a.url), WorkerRef(1, b.url)],
+                _cfg(affinity=False))
+    try:
+        rt.health_check_once()
+        rt.drain({"hosts": [0]})
+        routed = [rt.route({"prompt_ids": _prompt(i)}) for i in range(3)]
+        assert {r["worker"] for r in routed} == {1}
+        rt.drain({"hosts": [0], "op": "resume"})
+        assert 0 in [w.host for w in rt._candidates()]
+    finally:
+        rt.close()
+        a.close()
+        b.close()
+
+
+# -- import hygiene ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_router_never_imports_engine():
+    code = ("import sys\n"
+            "import torchacc_tpu.serve.router\n"
+            "import torchacc_tpu.serve.router_client\n"
+            "bad = [m for m in ('torchacc_tpu.serve.engine',"
+            " 'torchacc_tpu.serve.scheduler') if m in sys.modules]\n"
+            "assert not bad, bad\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr

@@ -351,6 +351,15 @@ def main(argv=None) -> int:
         p.add_argument("--obs-port", type=int, default=None,
                        help="serve the supervisor's own /metrics "
                             "(supervisor_* counters) here")
+        p.add_argument("--obs-port-base", type=int, default=None,
+                       help="stable worker telemetry ports: host i "
+                            "serves on base+i every incarnation (a "
+                            "fronting serve router's static worker "
+                            "registry)")
+        p.add_argument("--router-url", default=None,
+                       help="a fronting serve router (serve/router.py) "
+                            "to scrape under host -1 and notify on "
+                            "planned stops (/drain)")
         p.add_argument("--replace", action="store_true",
                        help="answer crash/SDC host loss by "
                             "PROVISIONING a replacement (budget-"

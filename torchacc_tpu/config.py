@@ -518,6 +518,15 @@ class ServeConfig:
     # Off (default): pre-PR-15 behaviour, late requests serve anyway
     # and count as deadline misses.
     shed_deadlines: bool = False
+    # deadline PREEMPTION of ADMITTED work (docs/serving.md "Deadline
+    # shedding"): shedding only covers pre-admission; with this opt-in
+    # an in-decode slot whose absolute deadline has passed is evicted
+    # immediately (blocks freed, typed finish_reason='preempted' with
+    # the partial tokens, journaled like a shed so replay never
+    # re-serves it).  The one deliberate exception to the
+    # whole-reservation guarantee — off (default) keeps "an admitted
+    # request always finishes".
+    preempt_deadlines: bool = False
 
     def validate(self) -> None:
         _check(self.block_size >= 1, "serve.block_size must be >= 1")

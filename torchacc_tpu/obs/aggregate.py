@@ -53,11 +53,11 @@ import statistics
 import threading
 import time
 import urllib.error
-import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from torchacc_tpu.obs.hist import Histogram
+from torchacc_tpu.utils import http as _http
 from torchacc_tpu.utils.logger import logger
 
 _PROM_PREFIX = "torchacc_"
@@ -352,8 +352,14 @@ class FleetAggregator:
 
     @staticmethod
     def _http_fetch(url: str, timeout_s: float) -> str:
-        with urllib.request.urlopen(url, timeout=timeout_s) as r:
-            return r.read().decode()
+        # one attempt on the shared client (utils/http.py); an HTTP
+        # error status re-raises so the caller's mark-host-down path
+        # treats it exactly like a transport failure (a 503 /healthz
+        # keeps the last-good series, same as before the extraction)
+        code, body = _http.request(url, timeout_s=timeout_s)
+        if code >= 400:
+            raise OSError(f"HTTP {code} from {url}")
+        return body
 
     def scrape_once(self) -> None:
         """Poll every worker once (the poller thread body; tests call

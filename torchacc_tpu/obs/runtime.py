@@ -303,6 +303,7 @@ class ServeObs:
         sched = engine.scheduler
         self._gauges: dict = {}
         self._checks: dict = {}
+        self._json: dict = {}
 
         def gauge(name, fn, help=""):
             self._gauges[name] = fn
@@ -311,6 +312,16 @@ class ServeObs:
         def check(name, fn):
             self._checks[name] = fn
             server.register_health(name, fn)
+
+        def json_route(path, fn):
+            self._json[path] = fn
+            server.register_json(path, fn)
+
+        # the router tier's routing signal (and ROADMAP 1(c)'s
+        # autoscaling signal): instantaneous queue/slot/KV headroom +
+        # TTFT p95 + drain state, strict JSON (docs/serving.md
+        # "Router tier")
+        json_route("/admission", engine.admission_snapshot)
 
         # decode-loop liveness (the serve /healthz the supervisor
         # probes): a run() loop with work that has not completed an
@@ -370,3 +381,5 @@ class ServeObs:
             server.unregister_gauge(name, fn)
         for name, fn in self._checks.items():
             server.unregister_health(name, fn)
+        for path, fn in self._json.items():
+            server.unregister_json(path, fn)

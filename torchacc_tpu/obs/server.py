@@ -130,6 +130,32 @@ def unregister_json(path: str, fn: Optional[Callable] = None) -> None:
             _json_routes.pop(path, None)
 
 
+# POST routes: strict JSON in, strict JSON out — the seam the serve
+# worker's /submit and the router's front door register through.  The
+# handler owns transport errors (unparseable body -> 400, provider
+# raise -> 500); the provider returns either a dict payload or a
+# ``(status_code, dict)`` pair when it owns the status (e.g. 429).
+_json_post_routes: Dict[str, Callable[[Dict], object]] = {}
+
+
+def register_json_post(path: str, fn: Callable[[Dict], object]) -> None:
+    """Serve ``fn(payload)`` as strict JSON under POST ``path``.  Same
+    rules as :func:`register_json`: no reserved paths, last owner
+    wins.  ``fn`` may return a dict (HTTP 200) or ``(code, dict)``."""
+    if not path.startswith("/") or path in _RESERVED_PATHS:
+        raise ValueError(
+            f"json post route must start with '/' and not shadow "
+            f"{_RESERVED_PATHS}; got {path!r}")
+    with _reg_lock:
+        _json_post_routes[path] = fn
+
+
+def unregister_json_post(path: str, fn: Optional[Callable] = None) -> None:
+    with _reg_lock:
+        if fn is None or _json_post_routes.get(path) is fn:
+            _json_post_routes.pop(path, None)
+
+
 def clear_registries() -> None:
     """Drop every gauge + health + text + json provider (tests)."""
     with _reg_lock:
@@ -137,6 +163,7 @@ def clear_registries() -> None:
         _health.clear()
         _texts.clear()
         _json_routes.clear()
+        _json_post_routes.clear()
 
 
 def health() -> Dict[str, object]:
@@ -261,6 +288,38 @@ class _Handler(BaseHTTPRequestHandler):
                            "text/plain")
         except (BrokenPipeError, ConnectionResetError):
             pass  # scraper went away mid-response
+
+    def do_POST(self):  # noqa: N802 - BaseHTTP API
+        path = self.path.split("?", 1)[0]
+        try:
+            with _reg_lock:
+                fn = _json_post_routes.get(path)
+            if fn is None:
+                self._send(404, b"no such POST route\n", "text/plain")
+                return
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(n) or b"{}")
+            except (ValueError, OSError) as e:
+                self._send(400, json.dumps(
+                    {"error": f"bad JSON body: {e!r}"}).encode(),
+                    "application/json")
+                return
+            try:
+                out = fn(payload)
+                code, doc = (out if (isinstance(out, tuple)
+                                     and len(out) == 2) else (200, out))
+                from torchacc_tpu.obs.flight import json_safe
+                self._send(int(code),
+                           json.dumps(json_safe(doc),
+                                      allow_nan=False).encode(),
+                           "application/json")
+            except Exception as e:  # noqa: BLE001 - a broken provider
+                # answers with an error, never a hang
+                self._send(500, json.dumps(
+                    {"error": repr(e)}).encode(), "application/json")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # caller went away mid-response
 
 
 class TelemetryServer:

@@ -21,36 +21,80 @@ inference).  Serving here is native:
   ``engine.load_params(trainer.serving_params())`` swap weights in
   place through the compiled layout-transfer engine
   (parallel/transfer.py) — no pool reallocation, no checkpoint I/O.
+- :mod:`torchacc_tpu.serve.router` / :mod:`~.serve.router_client` —
+  the jax-free routing tier fronting N serve workers (prefix-affinity
+  admission, circuit-breaking health, journal-backed failover).
+
+Attribute access is lazy (PEP 562): importing the jax-free members —
+``RequestJournal``/``read_journal``/``replay_state`` and the router —
+must not drag in the jax-backed engine/scheduler, because the router
+and the supervisor-side journal readers run on hosts that never
+initialise a device backend.
 
 See docs/serving.md for architecture + tuning (and the "Live weight
-handoff" section for the fit↔serve loop).
+handoff" section for the fit↔serve loop, "Router tier" for the front
+door).
 """
 
-from torchacc_tpu.serve.engine import Request, RequestResult, ServeEngine
-from torchacc_tpu.serve.journal import (
-    RequestJournal,
-    read_journal,
-    replay_state,
-)
-from torchacc_tpu.serve.kv_cache import (
-    BlockPool,
-    PrefixIndex,
-    blocks_needed,
-    make_pools,
-)
-from torchacc_tpu.serve.scheduler import PagedDecoder, Scheduler
+from typing import TYPE_CHECKING
 
-__all__ = [
-    "BlockPool",
-    "PagedDecoder",
-    "PrefixIndex",
-    "Request",
-    "RequestJournal",
-    "RequestResult",
-    "Scheduler",
-    "ServeEngine",
-    "blocks_needed",
-    "make_pools",
-    "read_journal",
-    "replay_state",
-]
+#: exported name -> defining submodule (resolved on first access)
+_EXPORTS = {
+    "Request": "engine",
+    "RequestResult": "engine",
+    "ServeEngine": "engine",
+    "RequestJournal": "journal",
+    "read_journal": "journal",
+    "replay_state": "journal",
+    "BlockPool": "kv_cache",
+    "PrefixIndex": "kv_cache",
+    "blocks_needed": "kv_cache",
+    "make_pools": "kv_cache",
+    "PagedDecoder": "scheduler",
+    "Scheduler": "scheduler",
+    "Router": "router",
+    "RouterConfig": "router",
+    "RouterClient": "router_client",
+}
+
+__all__ = sorted(_EXPORTS)
+
+if TYPE_CHECKING:  # pragma: no cover - static analysis only
+    from torchacc_tpu.serve.engine import (  # noqa: F401
+        Request,
+        RequestResult,
+        ServeEngine,
+    )
+    from torchacc_tpu.serve.journal import (  # noqa: F401
+        RequestJournal,
+        read_journal,
+        replay_state,
+    )
+    from torchacc_tpu.serve.kv_cache import (  # noqa: F401
+        BlockPool,
+        PrefixIndex,
+        blocks_needed,
+        make_pools,
+    )
+    from torchacc_tpu.serve.router import Router, RouterConfig  # noqa: F401
+    from torchacc_tpu.serve.router_client import RouterClient  # noqa: F401
+    from torchacc_tpu.serve.scheduler import (  # noqa: F401
+        PagedDecoder,
+        Scheduler,
+    )
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    value = getattr(
+        importlib.import_module(f"torchacc_tpu.serve.{mod}"), name)
+    globals()[name] = value        # cache: one resolution per process
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

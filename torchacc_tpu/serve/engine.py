@@ -212,6 +212,7 @@ class ServeEngine:
         self._replay_enqueued: set = set()
         self._replay_shed: set = set()
         self._shed_ids: List[int] = []
+        self._preempted_ids: List[int] = []
         # liveness heartbeat for the /healthz serve check: stamped at
         # the end of every engine iteration; _running marks a live
         # run() loop (a paused caller between phases is not a hang)
@@ -232,7 +233,8 @@ class ServeEngine:
         return {"ttft": [], "waits": [], "gaps": [], "tokens": 0,
                 "requests": 0, "t0": None, "t1": None,
                 "prefix_hits": 0, "cached_tokens": 0, "shared_blocks": 0,
-                "cow": 0, "deadline_total": 0, "deadline_miss": 0}
+                "cow": 0, "deadline_total": 0, "deadline_miss": 0,
+                "shed": 0, "preempted": 0}
 
     def _mesh_ctx(self):
         import contextlib
@@ -553,7 +555,8 @@ class ServeEngine:
         step, so no schedule can meet it — the one case shedding never
         second-guesses a recovery.  In-flight sequences are never shed
         (the whole-reservation guarantee: an admitted request always
-        finishes)."""
+        finishes) — relaxing THAT is the separate
+        ``serve.preempt_deadlines`` opt-in (:meth:`_preempt_expired`)."""
         if not self.config.serve.shed_deadlines or not self._queue:
             return
         now = time.monotonic()
@@ -566,6 +569,29 @@ class ServeEngine:
             self._shed(seq, "deadline-unmeetable"
                             + (" (drain)" if self._draining else ""))
             self._queue.remove(seq)
+
+    def _preempt_expired(self) -> None:
+        """Opt-in ``serve.preempt_deadlines`` (ROADMAP 3(d)): evict an
+        ADMITTED sequence whose absolute deadline has passed — the one
+        deliberate exception to the whole-reservation guarantee.  The
+        slot and its KV blocks free immediately (deferred-release
+        machinery makes mid-ring eviction safe), the request finishes
+        with typed ``finish_reason='preempted'`` carrying the partial
+        tokens, and :meth:`_drain_events` journals it like a shed so a
+        replay never re-serves it.  Never silent: counted
+        (``serve_requests_preempted``) and logged."""
+        if not self.config.serve.preempt_deadlines:
+            return
+        now = time.monotonic()
+        for seq in self.scheduler.slot_seq:
+            if (seq is not None and not seq.finished
+                    and seq.deadline != float("inf")
+                    and now >= seq.deadline):
+                self.scheduler.preempt(seq, now)
+                logger.warning(
+                    f"serve: preempted in-flight request {seq.sid} "
+                    f"(deadline passed; {len(seq.out_tokens)} token(s) "
+                    "resolved so far returned as a typed partial)")
 
     # -- the loop -----------------------------------------------------------
 
@@ -624,6 +650,7 @@ class ServeEngine:
         """One engine iteration (admission + scheduler.step + completion
         accounting).  Returns True while there is work anywhere."""
         self._shed_expired()
+        self._preempt_expired()
         with self._mesh_ctx():
             # admission inside the mesh context too: a fully-cached
             # prompt's admit dispatches the copy-on-write program over
@@ -741,6 +768,7 @@ class ServeEngine:
                 s.sid for s in self.scheduler.slot_seq if s is not None),
             "unserved": self.unserved_ids(),
             "shed": list(self._shed_ids),
+            "preempted": list(self._preempted_ids),
             "journal": (self._journal.path if self._journal is not None
                         else None),
         }
@@ -854,6 +882,24 @@ class ServeEngine:
         fin = self.scheduler.finished
         while fin:
             seq = fin.pop()
+            if seq.finish_reason == "preempted":
+                # deadline preemption terminal: journaled as a shed
+                # (same dedupe semantics — replay must never re-serve
+                # it), counted separately, partial tokens readable via
+                # result() with finish_reason='preempted'
+                if self._journal is not None:
+                    self._journal.shed(rid=seq.sid, reason="preempted")
+                self._preempted_ids.append(seq.sid)
+                counters.inc("serve_requests_preempted")
+                a = self._agg
+                a["preempted"] = a.get("preempted", 0) + 1
+                a["deadline_total"] += 1
+                a["deadline_miss"] += 1
+                if self._obs is not None and seq.out_tokens:
+                    # zero-token preempts have no real TTFT — keep the
+                    # latency histograms clean of clamped zeros
+                    self._obs.on_request_done(seq)
+                continue
             self._completed += 1
             counters.inc("serve_requests_completed")
             counters.inc("serve_tokens_generated", len(seq.out_tokens))
@@ -951,7 +997,8 @@ class ServeEngine:
             # a shed-only window (deadline storm, recovery sweep) is
             # exactly what shedding exists to make visible — never
             # collapse it to "nothing happened"
-            return {"requests": 0, "shed": a.get("shed", 0)}
+            return {"requests": 0, "shed": a.get("shed", 0),
+                    "preempted": a.get("preempted", 0)}
         pool = self.scheduler.pool
         return {
             "requests": a["requests"],
@@ -986,6 +1033,38 @@ class ServeEngine:
             # dropped with a typed result because their deadline had
             # provably passed (this stats window)
             "shed": a.get("shed", 0),
+            # deadline preemption (serve.preempt_deadlines): admitted
+            # sequences evicted mid-decode with a typed partial result
+            "preempted": a.get("preempted", 0),
+        }
+
+    def admission_snapshot(self) -> Dict[str, Any]:
+        """The strict-JSON ``/admission`` payload (ServeObs registers
+        it on the worker's telemetry endpoint): the instantaneous load
+        signal the router tier routes on — queue depth, slot and
+        KV-block headroom, TTFT p95, drain state — and ROADMAP 1(c)'s
+        autoscaling input in the same place."""
+        sched = self.scheduler
+        pool = sched.pool
+        ttft = self._agg["ttft"]
+        return {
+            "queue_depth": len(self._queue),
+            "slots_busy": sum(s is not None for s in sched.slot_seq),
+            "slots_total": len(sched.slot_seq),
+            "free_blocks": int(pool.available - pool.cached),
+            "cached_blocks": int(pool.cached),
+            "blocks_in_use": int(pool.in_use),
+            "block_size": int(self.config.serve.block_size),
+            "ttft_p95_ms": round(_percentile(ttft, 95) * 1e3, 3),
+            "draining": bool(self._draining),
+            "completed": int(self._completed),
+            "shed": len(self._shed_ids),
+            "preempted": len(self._preempted_ids),
+            # warm-cache evidence for the router's affinity gate: a
+            # replica receiving same-template traffic shows hits here
+            "requests": int(self._agg["requests"]),
+            "prefix_hits": int(self._agg["prefix_hits"]),
+            "pid": os.getpid(),
         }
 
     def reset_stats(self) -> None:

@@ -33,12 +33,13 @@ ids: greedy decodes are token-identical on replay by construction
 and a request whose wall-clock deadline passed while the process was
 dead is shed with a typed result instead of silently served late.
 
-Stdlib-only (json/os/hashlib) — but note the serve package __init__
-pulls jax, so the jax-free supervisor does NOT import this module: it
-duplicates the minimal read-and-count (``supervisor/worker.py
-serve_progress``, by design, with the filename/kind literals inlined),
-and the chaos gate carries its own reader.  A journal format change
-must touch all three.
+Stdlib-only (json/os/hashlib), and since the serve package __init__
+went lazy (PEP 562) this module imports WITHOUT jax — the router tier
+builds its durable assignment journal directly on
+:class:`RequestJournal`.  The supervisor still duplicates the minimal
+read-and-count (``supervisor/worker.py serve_progress``, by design,
+with the filename/kind literals inlined), and the chaos gate carries
+its own reader.  A journal format change must touch all three.
 """
 
 from __future__ import annotations

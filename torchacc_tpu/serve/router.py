@@ -1,0 +1,823 @@
+"""Fault-tolerant serve routing tier (ROADMAP 1(b)).
+
+A single stateless-looking front door over N supervised serve workers,
+built from the same parts as the rest of the control plane — the shared
+HTTP client (``utils/http.py``), the telemetry server's strict-JSON
+GET/POST seams (``obs/server.py``), the durable request journal
+(``serve/journal.py``) and the goodput ledger (``obs/goodput.py``).
+Four legs:
+
+- **Prefix-affinity admission** — :func:`chain_keys` reproduces the
+  PrefixIndex block-chain hash (``serve/kv_cache.py``) byte-for-byte,
+  so same-template traffic lands on the replica already holding the
+  warm KV blocks.  With no affinity match the router falls back to
+  power-of-two-choices over each worker's ``/admission`` snapshot
+  (queue depth + busy slots, then free blocks).
+- **Circuit-breaking health** — a per-worker :class:`CircuitBreaker`
+  driven by ``/healthz`` probes: closed → open after N consecutive
+  transport failures, half-open probe after a cooldown, closed again on
+  probe success.  A degraded worker is *deprioritized, never killed* —
+  process lifecycle belongs to the supervisor.
+- **Journal-backed failover** — the router's own assignment journal IS
+  a :class:`~torchacc_tpu.serve.journal.RequestJournal` (``accepted`` =
+  assigned, ``completed`` = result harvested, ``shed`` = typed drop),
+  so a ``kill -9`` of the router replays to the exact routed set.  When
+  a *worker* dies mid-flight the resubmittable remainder is re-derived
+  from that worker's journal (``read_journal``/``replay_state``) and
+  re-routed to survivors under the original router rids; first terminal
+  record wins, so a supervisor-restarted worker replaying the same
+  requests can never double-count a completion.
+- **Deadline/drain-aware admission** — provably-unmeetable deadlines
+  are shed at the front door (typed, journaled), 429 backpressure when
+  every breaker is open or all queues exceed the bound, and a ``/drain``
+  op for rolling restarts.
+
+The module is jax-free in the sense that matters here: it never imports
+``serve.engine``/``serve.scheduler`` (the lazy serve package keeps them
+out), initialises no device backend, and talks to workers only over
+HTTP and their on-disk journals.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torchacc_tpu.obs import hist as _hist
+from torchacc_tpu.obs import server as obs_server
+from torchacc_tpu.obs.goodput import GoodputLedger
+from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.serve.journal import (RequestJournal, read_journal,
+                                        replay_state)
+from torchacc_tpu.utils import http as _http
+from torchacc_tpu.utils.http import HttpClient
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import counters
+
+
+def chain_keys(prompt_ids, block_size: int) -> List[bytes]:
+    """The PrefixIndex chain-key recipe (``serve/kv_cache.py``), without
+    numpy: blake2b-16 over (parent digest, block of int32 token bytes),
+    one key per FULL block.  Must stay byte-identical to
+    ``PrefixIndex.keys`` — the router's affinity map and the worker's
+    prefix cache hash the same chains or affinity routes cold.  Tokens
+    serialise as little-endian int32, matching numpy's ``tobytes()`` on
+    every platform this runs on."""
+    bs = int(block_size)
+    if bs <= 0:
+        return []
+    toks = [int(t) for t in prompt_ids]
+    out: List[bytes] = []
+    parent = b""
+    for i in range(len(toks) // bs):
+        h = hashlib.blake2b(parent, digest_size=16)
+        h.update(b"".join(t.to_bytes(4, "little", signed=True)
+                          for t in toks[i * bs:(i + 1) * bs]))
+        parent = h.digest()
+        out.append(parent)
+    return out
+
+
+class CircuitBreaker:
+    """Per-worker admission breaker: ``closed`` (routable) → ``open``
+    after ``failure_threshold`` consecutive probe failures → ``half_open``
+    once ``cooldown_s`` has elapsed (exactly one probe allowed) → back to
+    ``closed`` on probe success or ``open`` on probe failure.  The clock
+    is injectable so the state machine unit-tests run on a fake clock."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self.state = self.CLOSED
+        self.failures = 0          # consecutive
+        self.opened_at = 0.0
+        self.opens = 0             # transitions into OPEN (flap count)
+
+    @property
+    def routable(self) -> bool:
+        """Only a closed breaker admits traffic — half-open carries the
+        probe, not requests."""
+        return self.state == self.CLOSED
+
+    def should_probe(self) -> bool:
+        """Health-loop gate: closed and half-open workers probe every
+        tick; an open one only after the cooldown (that attempt IS the
+        half-open transition)."""
+        if self.state != self.OPEN:
+            return True
+        if self._clock() - self.opened_at >= self.cooldown_s:
+            self.state = self.HALF_OPEN
+            return True
+        return False
+
+    def record_success(self) -> bool:
+        """Returns True when this success CLOSED a non-closed breaker
+        (the readmission edge, so the caller can count/log it)."""
+        readmitted = self.state != self.CLOSED
+        self.state = self.CLOSED
+        self.failures = 0
+        return readmitted
+
+    def record_failure(self) -> bool:
+        """Returns True when this failure OPENED the breaker (the
+        caller triggers failover exactly once per open edge)."""
+        self.failures += 1
+        if (self.state == self.HALF_OPEN
+                or self.failures >= self.failure_threshold):
+            opened = self.state != self.OPEN
+            if opened:
+                self.opens += 1
+            self.state = self.OPEN
+            self.opened_at = self._clock()
+            return opened
+        return False
+
+
+@dataclass
+class WorkerRef:
+    """Static registry entry for one serve replica: where to reach it
+    and — for journal-backed failover — where its request journal lives
+    on the shared filesystem (None disables the harvest path; failover
+    then resubmits blind and relies on router-side dedupe)."""
+    host: int
+    url: str
+    journal_dir: Optional[str] = None
+
+
+@dataclass
+class RouterConfig:
+    block_size: int = 16             # must match the workers' ServeConfig
+    affinity: bool = True            # prefix-affinity routing on/off
+    queue_bound: int = 64            # per-worker depth before 429
+    breaker_failures: int = 3        # consecutive failures to open
+    breaker_cooldown_s: float = 5.0  # open -> half-open probe delay
+    probe_timeout_s: float = 1.0     # /healthz probe budget
+    http_timeout_s: float = 5.0      # submit/result budget
+    admission_ttl_s: float = 0.5     # /admission snapshot reuse window
+    health_interval_s: float = 0.5   # health loop cadence
+    journal_fsync: bool = True
+
+    def validate(self) -> None:
+        if self.block_size <= 0:
+            raise ValueError("block_size must be positive")
+        if self.queue_bound < 1:
+            raise ValueError("queue_bound must be >= 1")
+
+
+class _Worker:
+    """Router-side view of one replica: HTTP client, breaker, and the
+    last ``/admission`` snapshot (the p2c load signal)."""
+
+    def __init__(self, ref: WorkerRef, cfg: RouterConfig,
+                 clock: Callable[[], float]):
+        self.ref = ref
+        self.client = HttpClient(ref.url, timeout_s=cfg.http_timeout_s,
+                                 retries=0)
+        self.breaker = CircuitBreaker(
+            failure_threshold=cfg.breaker_failures,
+            cooldown_s=cfg.breaker_cooldown_s, clock=clock)
+        self.admission: Optional[Dict[str, Any]] = None
+        self.admission_at = -1e18
+        # two drain sources: the pin set through the router's /drain
+        # seam (cleared by an explicit resume — e.g. the supervisor
+        # announcing the relaunch) and the worker's own self-reported
+        # drain state from /admission
+        self.drain_pin = False
+        self.reported_draining = False
+
+    @property
+    def draining(self) -> bool:
+        return self.drain_pin or self.reported_draining
+
+    @property
+    def host(self) -> int:
+        return self.ref.host
+
+    def load(self) -> Tuple[int, int]:
+        """p2c ordering key from the last admission snapshot: fewer
+        (queued + busy) first, then more free KV blocks.  An unknown
+        snapshot sorts as idle — a fresh worker should attract work,
+        not repel it."""
+        a = self.admission or {}
+        depth = int(a.get("queue_depth", 0)) + int(a.get("slots_busy", 0))
+        return (depth, -int(a.get("free_blocks", 1 << 30)))
+
+
+class Router:
+    """The routing tier.  Pure library core — tests drive
+    :meth:`route`/:meth:`result`/:meth:`health_check_once` directly with
+    injected clocks; :meth:`serve_http` mounts the same methods on the
+    telemetry server's JSON seams for the real front door."""
+
+    def __init__(self, journal_dir: str, workers: List[WorkerRef],
+                 config: Optional[RouterConfig] = None, *,
+                 rng: Optional[random.Random] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
+        self.config = config or RouterConfig()
+        self.config.validate()
+        self.journal_dir = journal_dir
+        self._clock = clock
+        self._wall = wall
+        self._rng = rng or random.Random(0)
+        self._lock = threading.RLock()
+        self._workers: Dict[int, _Worker] = {
+            w.host: _Worker(w, self.config, clock) for w in workers}
+        if len(self._workers) != len(workers):
+            raise ValueError("duplicate worker host ids")
+        # rid -> {"record": <accepted record>, "worker": host|None,
+        #         "wrid": worker-side rid|None}
+        self._assign: Dict[int, Dict[str, Any]] = {}
+        self._done: Dict[int, Dict[str, Any]] = {}
+        self._shed: Dict[int, str] = {}
+        self._affinity: Dict[bytes, int] = {}
+        self._next_rid = 0
+        self._draining = False
+        self._ledger = GoodputLedger(clock=clock)
+        self._ledger.start()
+        self._bucket = "all_healthy"
+        self._registered: List[Tuple[str, str, Any]] = []
+        self._journal = RequestJournal(journal_dir,
+                                       fsync=self.config.journal_fsync)
+        self._recover()
+
+    # -- durability -----------------------------------------------------------
+
+    def _recover(self) -> None:
+        """Replay the assignment journal (crash-restart path).  Terminal
+        records rebuild the done/shed caches; pending rids are
+        reconciled against the workers — adopted where a live worker
+        already carries them (matched through the ``router-<rid>``
+        trace id in ITS journal), harvested where a worker journal
+        already holds the completion, resubmitted otherwise.  Replay is
+        idempotent: nothing is re-journaled for an already-terminal
+        rid."""
+        pending, completed, shed = replay_state(read_journal(
+            self.journal_dir))
+        for rid, rec in completed.items():
+            self._done[rid] = {"tokens": rec.get("tokens", []),
+                               "finish_reason": rec.get("finish_reason",
+                                                        "stop")}
+        for rid, rec in shed.items():
+            self._shed[rid] = rec.get("reason", "unknown")
+        for rid, rec in pending.items():
+            self._assign[rid] = {"record": rec,
+                                 "worker": rec.get("worker"),
+                                 "wrid": None}
+        known = (set(pending) | set(completed) | set(shed))
+        self._next_rid = (max(known) + 1) if known else 0
+        if not pending:
+            return
+        counters.inc("router_requests_replayed", len(pending))
+        logger.info(f"router: replayed {len(pending)} pending "
+                    f"assignment(s) from {self.journal_dir}")
+        # rebind each pending rid to wherever it actually lives now
+        adopted = {}
+        for w in self._workers.values():
+            adopted.update(self._scan_worker_journal(w))
+        for rid in sorted(pending):
+            info = adopted.get(rid)
+            if info is not None and info["terminal"] == "completed":
+                self._complete(rid, info["tokens"], info["finish_reason"])
+            elif info is not None and info["terminal"] == "shed":
+                self._shed_rid(rid, f"worker:{info.get('reason', 'shed')}")
+            elif (info is not None
+                  and self._workers[info["host"]].breaker.routable):
+                self._assign[rid]["worker"] = info["host"]
+                self._assign[rid]["wrid"] = info["wrid"]
+            else:
+                self._assign[rid]["worker"] = None
+                self._reroute(rid, exclude=set())
+
+    def _scan_worker_journal(self, w: _Worker) -> Dict[int, Dict[str, Any]]:
+        """Read one worker's on-disk journal and index every record the
+        ROUTER placed there (trace ``router-<rid>``) by router rid."""
+        if w.ref.journal_dir is None:
+            return {}
+        try:
+            wp, wc, ws = replay_state(read_journal(w.ref.journal_dir))
+        except OSError:
+            return {}
+        out: Dict[int, Dict[str, Any]] = {}
+        trace_of = {}
+        for wrid, rec in list(wp.items()):
+            trace_of[wrid] = rec.get("trace_id", "")
+        # replay_state drops accepted payloads for terminal rids; read
+        # the raw records once more for their traces
+        for rec in read_journal(w.ref.journal_dir):
+            if rec.get("kind") == "accepted":
+                trace_of.setdefault(int(rec.get("rid", -1)),
+                                    rec.get("trace_id", ""))
+        for wrid, trace in trace_of.items():
+            if not isinstance(trace, str) or not trace.startswith("router-"):
+                continue
+            try:
+                rid = int(trace.split("-", 1)[1])
+            except ValueError:
+                continue
+            if wrid in wc:
+                out[rid] = {"host": w.host, "wrid": wrid,
+                            "terminal": "completed",
+                            "tokens": wc[wrid].get("tokens", []),
+                            "finish_reason": wc[wrid].get("finish_reason",
+                                                          "stop")}
+            elif wrid in ws:
+                out[rid] = {"host": w.host, "wrid": wrid,
+                            "terminal": "shed",
+                            "reason": ws[wrid].get("reason", "shed")}
+            else:
+                out[rid] = {"host": w.host, "wrid": wrid, "terminal": None}
+        return out
+
+    def _complete(self, rid: int, tokens, finish_reason: str) -> bool:
+        """Record a terminal completion exactly once.  The duplicate
+        path is LOAD-BEARING: after failover the supervisor may restart
+        the dead worker, which replays its journal and re-serves the
+        same requests the router already moved to a survivor — the
+        second completion must count as a dedupe, not a result."""
+        if rid in self._done or rid in self._shed:
+            counters.inc("router_duplicate_results")
+            return False
+        self._journal.completed(rid=rid, tokens=tokens,
+                                finish_reason=finish_reason)
+        self._done[rid] = {"tokens": [int(t) for t in tokens],
+                           "finish_reason": finish_reason}
+        self._assign.pop(rid, None)
+        counters.inc("router_requests_completed")
+        return True
+
+    def _shed_rid(self, rid: int, reason: str) -> bool:
+        if rid in self._done or rid in self._shed:
+            counters.inc("router_duplicate_results")
+            return False
+        self._journal.shed(rid=rid, reason=reason)
+        self._shed[rid] = reason
+        self._assign.pop(rid, None)
+        counters.inc("router_requests_shed")
+        return True
+
+    # -- routing --------------------------------------------------------------
+
+    def _candidates(self, exclude=()) -> List[_Worker]:
+        return [w for w in self._workers.values()
+                if w.breaker.routable and not w.draining
+                and w.host not in exclude]
+
+    def _fresh_admission(self, w: _Worker) -> None:
+        if self._clock() - w.admission_at < self.config.admission_ttl_s:
+            return
+        try:
+            code, doc = w.client.get_json("/admission")
+            if code == 200 and isinstance(doc, dict):
+                w.admission = doc
+                w.admission_at = self._clock()
+                w.reported_draining = bool(doc.get("draining", False))
+        except (OSError, ValueError):
+            pass  # the health loop owns failure accounting
+
+    def _pick(self, prompt_ids, exclude=()) -> Tuple[Optional[_Worker], str]:
+        """Choose a replica: deepest warm prefix chain first, then
+        power-of-two-choices on the admission snapshots."""
+        cands = self._candidates(exclude)
+        if not cands:
+            return None, "none"
+        if self.config.affinity:
+            keys = chain_keys(prompt_ids, self.config.block_size)
+            by_host = {w.host: w for w in cands}
+            for key in reversed(keys):        # deepest chain first
+                host = self._affinity.get(key)
+                if host in by_host:
+                    return by_host[host], "affinity"
+        if len(cands) == 1:
+            self._fresh_admission(cands[0])
+            return cands[0], "p2c"
+        a, b = self._rng.sample(cands, 2)
+        self._fresh_admission(a)
+        self._fresh_admission(b)
+        return (a if a.load() <= b.load() else b), "p2c"
+
+    def _note_affinity(self, prompt_ids, host: int) -> None:
+        if not self.config.affinity:
+            return
+        for key in chain_keys(prompt_ids, self.config.block_size):
+            self._affinity[key] = host
+
+    def _accept_record(self, rid: int, payload: Dict[str, Any],
+                       worker: Optional[int]) -> Dict[str, Any]:
+        deadline_s = payload.get("deadline_s")
+        return {
+            "kind": "accepted", "rid": rid,
+            "trace_id": str(payload.get("trace_id", "") or f"req-{rid}"),
+            "prompt_ids": [int(t) for t in payload["prompt_ids"]],
+            "max_new_tokens": int(payload.get("max_new_tokens", 16)),
+            "temperature": float(payload.get("temperature", 0.0)),
+            "top_k": int(payload.get("top_k", 0)),
+            "top_p": float(payload.get("top_p", 1.0)),
+            "eos_id": (None if payload.get("eos_id") is None
+                       else int(payload["eos_id"])),
+            "seed": int(payload.get("seed", 0)),
+            "priority": int(payload.get("priority", 0)),
+            "deadline_unix": (None if deadline_s is None
+                              else self._wall() + float(deadline_s)),
+            "t_accept": self._wall(),
+            "worker": worker,          # informational; recovery re-derives
+        }
+
+    def route(self, payload: Dict[str, Any]):
+        """The front door.  Returns a dict (200) or ``(status, dict)``
+        — the shape ``obs/server.register_json_post`` providers use."""
+        failpoint("router.route", rid=self._next_rid)
+        t0 = self._clock()
+        prompt = payload.get("prompt_ids")
+        if not isinstance(prompt, list) or not prompt:
+            return 400, {"error": "prompt_ids must be a non-empty list"}
+        with self._lock:
+            if self._draining:
+                counters.inc("router_429")
+                return 429, {"error": "router draining"}
+            deadline_s = payload.get("deadline_s")
+            if deadline_s is not None and float(deadline_s) <= 0.0:
+                # provably unmeetable: journaled like any shed so the
+                # request is ACCOUNTED, never silently dropped
+                rid = self._next_rid
+                self._next_rid += 1
+                self._journal.append(self._accept_record(rid, payload, None))
+                self._shed_rid(rid, "deadline-unmeetable")
+                return {"rid": rid, "status": "shed",
+                        "reason": "deadline-unmeetable"}
+            worker, how = self._pick(prompt)
+            if worker is None:
+                counters.inc("router_429")
+                return 429, {"error": "no routable workers"}
+            bounded = [w for w in self._candidates()
+                       if int((w.admission or {}).get("queue_depth", 0))
+                       < self.config.queue_bound]
+            if not bounded:
+                counters.inc("router_429")
+                return 429, {"error": "all queues over bound"}
+            if worker not in bounded:
+                worker = bounded[0]
+                how = "p2c"
+            rid = self._next_rid
+            self._next_rid += 1
+            record = self._accept_record(rid, payload, worker.host)
+            self._journal.append(record)        # journal-first
+            self._assign[rid] = {"record": record, "worker": None,
+                                 "wrid": None}
+            self._note_affinity(prompt, worker.host)
+            ok = self._submit_to(worker, rid, record)
+            counters.inc("router_requests_routed")
+            if how == "affinity":
+                counters.inc("router_affinity_hits")
+            _hist.observe("router_route_decision_ms",
+                          (self._clock() - t0) * 1e3)
+            return {"rid": rid,
+                    "worker": worker.host if ok else None,
+                    "routed_by": how,
+                    "status": "routed" if ok else "queued"}
+
+    def _submit_to(self, w: _Worker, rid: int,
+                   record: Dict[str, Any]) -> bool:
+        """Push one journaled assignment to a worker.  Failure leaves
+        the rid as an ORPHAN (assigned to no one) — the health loop's
+        reconcile pass re-places it, so a flaky submit can delay a
+        request but never lose it."""
+        body = {k: record[k] for k in
+                ("prompt_ids", "max_new_tokens", "temperature", "top_k",
+                 "top_p", "eos_id", "seed", "priority")}
+        body["trace_id"] = f"router-{rid}"
+        if record.get("deadline_unix") is not None:
+            remaining = record["deadline_unix"] - self._wall()
+            if remaining <= 0.0:
+                self._shed_rid(rid, "deadline-expired-in-router")
+                return False
+            body["deadline_s"] = remaining
+        try:
+            code, doc = w.client.post_json("/submit", body)
+        except (OSError, ValueError):
+            code, doc = 0, None
+        if code != 200 or not isinstance(doc, dict) or "rid" not in doc:
+            self._assign[rid]["worker"] = None
+            return False
+        self._assign[rid]["worker"] = w.host
+        self._assign[rid]["wrid"] = int(doc["rid"])
+        return True
+
+    # -- results --------------------------------------------------------------
+
+    def result(self, rid: int) -> Dict[str, Any]:
+        with self._lock:
+            if rid in self._done:
+                d = self._done[rid]
+                return {"rid": rid, "status": "completed",
+                        "tokens": d["tokens"],
+                        "finish_reason": d["finish_reason"]}
+            if rid in self._shed:
+                return {"rid": rid, "status": "shed",
+                        "reason": self._shed[rid]}
+            a = self._assign.get(rid)
+            if a is None:
+                return {"rid": rid, "status": "unknown"}
+            if a["worker"] is None or a["wrid"] is None:
+                return {"rid": rid, "status": "pending", "worker": None}
+            w = self._workers[a["worker"]]
+            try:
+                code, doc = w.client.post_json("/result",
+                                               {"rid": a["wrid"]})
+            except (OSError, ValueError):
+                return {"rid": rid, "status": "pending",
+                        "worker": w.host}
+            if code == 200 and isinstance(doc, dict):
+                if doc.get("status") == "completed":
+                    self._complete(rid, doc.get("tokens", []),
+                                   doc.get("finish_reason", "stop"))
+                    return self.result(rid)
+                if doc.get("status") == "shed":
+                    self._shed_rid(rid,
+                                   f"worker:{doc.get('reason', 'shed')}")
+                    return self.result(rid)
+            return {"rid": rid, "status": "pending", "worker": w.host}
+
+    # -- health / failover ----------------------------------------------------
+
+    def health_check_once(self) -> Dict[str, str]:
+        """One breaker tick: probe every worker that should be probed,
+        fail over the assignments of any breaker that OPENS on this
+        tick, reconcile orphans, and lap the goodput ledger into
+        all_healthy/degraded so breaker flaps show up as attributed
+        wall time rather than vanishing."""
+        with self._lock:
+            for w in self._workers.values():
+                if not w.breaker.should_probe():
+                    continue
+                try:
+                    code, _ = _http.request(
+                        w.ref.url + "/healthz",
+                        timeout_s=self.config.probe_timeout_s)
+                    ok = code < 500
+                except OSError:
+                    ok = False
+                if ok:
+                    if w.breaker.record_success():
+                        counters.inc("router_breaker_closes")
+                        logger.info(f"router: worker {w.host} readmitted "
+                                    "(breaker closed)")
+                    self._fresh_admission(w)
+                else:
+                    if w.breaker.record_failure():
+                        counters.inc("router_breaker_opens")
+                        logger.warning(
+                            f"router: worker {w.host} breaker OPEN after "
+                            f"{w.breaker.failures} consecutive failures — "
+                            "failing its in-flight assignments over")
+                        self._failover(w.host)
+            # orphan reconcile: rids journaled but placed nowhere
+            for rid in sorted(self._assign):
+                if self._assign[rid]["worker"] is None:
+                    self._reroute(rid, exclude=set())
+            # ledger: attribute the elapsed tick to the bucket that was
+            # in effect, then flip on the breaker edge
+            self._ledger.lap(self._bucket)
+            self._bucket = ("all_healthy" if all(
+                w.breaker.routable for w in self._workers.values())
+                else "degraded")
+            self._ledger.publish(prefix="router_goodput_")
+            return {str(w.host): w.breaker.state
+                    for w in self._workers.values()}
+
+    def _failover(self, host: int) -> None:
+        """Move every non-terminal assignment off a dead worker.  The
+        worker's journal is the source of truth: completions already on
+        its disk are harvested (not re-decoded), everything else is
+        resubmitted to survivors under the ORIGINAL router rids."""
+        dead = self._workers[host]
+        harvested = self._scan_worker_journal(dead)
+        moved = 0
+        for rid in sorted(self._assign):
+            if self._assign[rid]["worker"] != host:
+                continue
+            info = harvested.get(rid)
+            if info is not None and info["terminal"] == "completed":
+                self._complete(rid, info["tokens"], info["finish_reason"])
+                continue
+            if info is not None and info["terminal"] == "shed":
+                self._shed_rid(rid, f"worker:{info.get('reason', 'shed')}")
+                continue
+            self._assign[rid]["worker"] = None
+            self._assign[rid]["wrid"] = None
+            if self._reroute(rid, exclude={host}):
+                moved += 1
+        if moved:
+            counters.inc("router_requests_failover", moved)
+            logger.warning(f"router: failed {moved} request(s) over "
+                           f"from worker {host}")
+
+    def _reroute(self, rid: int, exclude) -> bool:
+        record = self._assign[rid]["record"]
+        worker, _ = self._pick(record["prompt_ids"], exclude=exclude)
+        if worker is None:
+            return False        # orphan; next health tick retries
+        self._note_affinity(record["prompt_ids"], worker.host)
+        return self._submit_to(worker, rid, record)
+
+    # -- drain ----------------------------------------------------------------
+
+    def drain(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Rolling-restart orchestration: ``{"hosts": [..]}`` stops the
+        router sending NEW work to those replicas and best-effort
+        forwards ``begin_drain`` so they finish what they hold;
+        ``{"all": true}`` drains the router's own front door (429 for
+        new requests, in-flight unaffected).  ``{"op": "resume", ...}``
+        reverses either."""
+        resume = payload.get("op") == "resume"
+        with self._lock:
+            if payload.get("all"):
+                self._draining = not resume
+            touched = []
+            for host in payload.get("hosts", []):
+                w = self._workers.get(int(host))
+                if w is None:
+                    continue
+                w.drain_pin = not resume
+                touched.append(w.host)
+                if not resume:
+                    try:
+                        w.client.post_json("/admin", {"op": "begin_drain",
+                                                      "reason": "router"})
+                    except (OSError, ValueError):
+                        pass
+            return {"draining": touched, "router_draining": self._draining,
+                    "resumed": resume}
+
+    # -- views ----------------------------------------------------------------
+
+    def accounting(self) -> Dict[str, Any]:
+        """The durability contract, as a dict the gate asserts on:
+        every routed rid is pending, completed, or typed-shed."""
+        with self._lock:
+            return {"routed": self._next_rid,
+                    "pending": sorted(self._assign),
+                    "completed": len(self._done),
+                    "shed": len(self._shed)}
+
+    def state_json(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "workers": [{
+                    "host": w.host, "url": w.ref.url,
+                    "breaker": w.breaker.state,
+                    "failures": w.breaker.failures,
+                    "opens": w.breaker.opens,
+                    "draining": w.draining,
+                    "admission": w.admission,
+                } for w in self._workers.values()],
+                "accounting": self.accounting(),
+                "affinity_keys": len(self._affinity),
+                "bucket": self._bucket,
+                "goodput": self._ledger.summary(),
+            }
+
+    def prometheus_text(self) -> str:
+        """Labeled per-worker series for the /metrics page (the scalar
+        registries can't carry labels).  Breaker state encodes as
+        0=closed 1=half_open 2=open."""
+        rank = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
+                CircuitBreaker.OPEN: 2}
+        lines = ["# TYPE router_breaker_state gauge",
+                 "# TYPE router_worker_queue_depth gauge",
+                 "# TYPE router_worker_free_blocks gauge"]
+        with self._lock:
+            for w in self._workers.values():
+                a = w.admission or {}
+                lab = f'{{host="{w.host}"}}'
+                lines.append(f"router_breaker_state{lab} "
+                             f"{rank[w.breaker.state]}")
+                lines.append(f"router_worker_queue_depth{lab} "
+                             f"{int(a.get('queue_depth', 0))}")
+                lines.append(f"router_worker_free_blocks{lab} "
+                             f"{int(a.get('free_blocks', 0))}")
+        return "\n".join(lines) + "\n"
+
+    # -- HTTP front door ------------------------------------------------------
+
+    def serve_http(self, port: int = 0,
+                   host: str = "127.0.0.1") -> obs_server.TelemetryServer:
+        """Mount the router on the telemetry server: POST /route,
+        /result, /drain; GET /router (state) plus the standard /metrics
+        and /healthz the fleet scraper consumes."""
+        _hist.configure(True)
+        srv = obs_server.start(port, host)
+        regs = [("json_post", "/route", lambda p: self.route(p)),
+                ("json_post", "/result",
+                 lambda p: self.result(int(p.get("rid", -1)))),
+                ("json_post", "/drain", lambda p: self.drain(p)),
+                ("json", "/router", self.state_json),
+                ("text", "router", self.prometheus_text),
+                ("health", "router_liveness", lambda: ("ok", None))]
+        for kind, name, fn in regs:
+            getattr(obs_server, f"register_{kind}")(name, fn)
+        self._registered = regs
+        return srv
+
+    def close(self) -> None:
+        for kind, name, fn in self._registered:
+            try:
+                getattr(obs_server, f"unregister_{kind}")(name, fn)
+            except Exception:
+                pass
+        self._registered = []
+        self._ledger.freeze()
+        self._journal.close()
+
+
+def _parse_worker(spec: str) -> WorkerRef:
+    """``HOST=URL[;JOURNAL_DIR]`` (';' because URLs carry ':')."""
+    host, rest = spec.split("=", 1)
+    url, _, jdir = rest.partition(";")
+    return WorkerRef(host=int(host), url=url.rstrip("/"),
+                     journal_dir=jdir or None)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import json as _json
+    import signal as _signal
+
+    p = argparse.ArgumentParser(description="torchacc serve router")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--journal-dir", required=True)
+    p.add_argument("--worker", action="append", default=[],
+                   metavar="HOST=URL[;JOURNAL_DIR]")
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--no-affinity", action="store_true")
+    p.add_argument("--queue-bound", type=int, default=64)
+    p.add_argument("--breaker-failures", type=int, default=3)
+    p.add_argument("--breaker-cooldown-s", type=float, default=2.0)
+    p.add_argument("--health-interval-s", type=float, default=0.25)
+    p.add_argument("--no-fsync", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--chaos", default=None,
+                   help="JSON chaos spec, e.g. "
+                        '\'{"kill": {"after": 5}}\' -> SIGKILL self at '
+                        "the Nth router.route failpoint")
+    args = p.parse_args(argv)
+
+    cfg = RouterConfig(block_size=args.block_size,
+                       affinity=not args.no_affinity,
+                       queue_bound=args.queue_bound,
+                       breaker_failures=args.breaker_failures,
+                       breaker_cooldown_s=args.breaker_cooldown_s,
+                       health_interval_s=args.health_interval_s,
+                       journal_fsync=not args.no_fsync)
+    workers = [_parse_worker(s) for s in args.worker]
+    if not workers:
+        p.error("at least one --worker is required")
+
+    plan = None
+    if args.chaos:
+        from torchacc_tpu.resilience.chaos import ChaosPlan
+        spec = _json.loads(args.chaos)
+        plan = ChaosPlan(seed=args.seed)
+        if "kill" in spec:
+            plan.kill("router.route",
+                      after=int(spec["kill"].get("after", 0)))
+
+    router = Router(args.journal_dir, workers, cfg,
+                    rng=random.Random(args.seed))
+    srv = router.serve_http(args.port)
+    stop = threading.Event()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(sig, lambda *a: stop.set())
+    print(f"ROUTER_READY port={srv.port} journal={args.journal_dir}",
+          flush=True)
+
+    def _loop():
+        while not stop.wait(cfg.health_interval_s):
+            router.health_check_once()
+
+    try:
+        if plan is not None:
+            with plan:
+                _loop()
+        else:
+            _loop()
+    finally:
+        router.close()
+        obs_server.stop()
+    print("ROUTER_DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,80 @@
+"""Thin client for the serve router's strict-JSON front door.
+
+Wraps the shared :class:`~torchacc_tpu.utils.http.HttpClient` (same
+retry/backoff contract as the supervisor's probes) around the router's
+POST ``/route`` / ``/result`` / ``/drain`` and GET ``/router`` surface.
+jax-free like the router itself — smoke gates and external callers can
+import it without pulling in the serve engine.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from torchacc_tpu.utils.http import HttpClient
+
+
+class RouterClient(HttpClient):
+    """``submit`` returns the router's response dict (``rid`` plus
+    ``status`` in routed|queued|shed); ``await_result`` polls until the
+    rid reaches a terminal state or the timeout expires."""
+
+    def submit(self, prompt_ids: List[int], *,
+               max_new_tokens: int = 16, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0,
+               eos_id: Optional[int] = None, seed: int = 0,
+               priority: int = 0, deadline_s: Optional[float] = None,
+               trace_id: str = "") -> Dict[str, Any]:
+        code, doc = self.post_json("/route", {
+            "prompt_ids": [int(t) for t in prompt_ids],
+            "max_new_tokens": max_new_tokens,
+            "temperature": temperature, "top_k": top_k, "top_p": top_p,
+            "eos_id": eos_id, "seed": seed, "priority": priority,
+            "deadline_s": deadline_s, "trace_id": trace_id,
+        })
+        if not isinstance(doc, dict):
+            doc = {"error": doc}
+        doc["http_status"] = code
+        return doc
+
+    def result(self, rid: int) -> Dict[str, Any]:
+        code, doc = self.post_json("/result", {"rid": int(rid)})
+        if not isinstance(doc, dict):
+            doc = {"error": doc}
+        doc["http_status"] = code
+        return doc
+
+    def await_result(self, rid: int, *, timeout_s: float = 30.0,
+                     poll_s: float = 0.1) -> Dict[str, Any]:
+        """Poll ``/result`` until terminal (completed/shed/unknown).
+        Transport errors during the wait are swallowed and retried —
+        the router may be mid-restart (its journal makes that safe)."""
+        deadline = time.monotonic() + timeout_s
+        last: Dict[str, Any] = {"rid": rid, "status": "pending"}
+        while time.monotonic() < deadline:
+            try:
+                last = self.result(rid)
+            except (OSError, ValueError):
+                last = {"rid": rid, "status": "pending"}
+            if last.get("status") in ("completed", "shed", "unknown"):
+                return last
+            self._sleep(poll_s)
+        return last
+
+    def state(self) -> Dict[str, Any]:
+        code, doc = self.get_json("/router")
+        if isinstance(doc, dict):
+            doc["http_status"] = code
+        return doc
+
+    def drain(self, hosts: Optional[List[int]] = None, *,
+              all_traffic: bool = False,
+              resume: bool = False) -> Dict[str, Any]:
+        payload: Dict[str, Any] = {"hosts": list(hosts or [])}
+        if all_traffic:
+            payload["all"] = True
+        if resume:
+            payload["op"] = "resume"
+        _, doc = self.post_json("/drain", payload)
+        return doc if isinstance(doc, dict) else {"error": doc}

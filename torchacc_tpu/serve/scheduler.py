@@ -946,6 +946,19 @@ class Scheduler:
         self.finished.append(seq)
         self._evict(seq)
 
+    def preempt(self, seq: Sequence, now: float) -> None:
+        """Evict an ADMITTED sequence before its natural finish (the
+        engine's opt-in ``serve.preempt_deadlines`` sweep): typed
+        ``finish_reason='preempted'`` with whatever tokens resolved so
+        far, blocks released through the same deferred-free path as any
+        eviction.  Safe mid-flight by the existing machinery: lagged
+        ring entries for the evicted slot drop in :meth:`_record`'s
+        post-finish guard, and the deferred free holds the blocks until
+        every already-dispatched iteration resolves."""
+        if seq.finished:
+            return
+        self._finish(seq, "preempted", now)
+
     def _evict(self, seq: Sequence) -> None:
         slot = seq.slot
         if slot < 0:

@@ -120,6 +120,12 @@ class WorkerSpec:
     #: the {obs_port} placeholder and is polled at probe_interval_s.
     #: Off (False): sensing is exit-disposition + deadline only.
     probe: bool = False
+    #: serve-fleet worker registry: with a base set, host i's telemetry
+    #: port is ``obs_port_base + i`` on EVERY incarnation instead of a
+    #: fresh ephemeral port — a fronting router's static worker list
+    #: stays valid across restarts (the prober's expect_pid still
+    #: catches a stale process squatting the reused port)
+    obs_port_base: Optional[int] = None
     probe_interval_s: float = 2.0
     probe_timeout_s: float = 2.0
     #: consecutive unreachable/unhealthy observations before the worker
@@ -148,6 +154,8 @@ class WorkerSpec:
             raise ValueError(
                 f"WorkerSpec.role must be 'train' or 'serve', got "
                 f"{self.role!r}")
+        if self.obs_port_base is not None and self.obs_port_base <= 0:
+            raise ValueError("obs_port_base must be a positive port")
         if self.log_dir is None:
             self.log_dir = os.path.join(self.run_dir, "supervisor_logs")
 
@@ -205,8 +213,16 @@ class Supervisor:
                  sleep: Callable[[float], None] = time.sleep,
                  provisioner: Optional[Provisioner] = None,
                  prober_factory: Optional[
-                     Callable[[int, int], WorkerProber]] = None):
+                     Callable[[int, int], WorkerProber]] = None,
+                 router_url: Optional[str] = None):
         self.spec = spec
+        #: a fronting serve router (serve/router.py): its /metrics
+        #: joins the fleet scrape under reserved host -1 and planned
+        #: stops/relaunches are announced on its /drain seam, so the
+        #: router stops routing to a replica the DAEMON is about to
+        #: kill instead of discovering it through breaker failures
+        self.router_url = (router_url.rstrip("/") if router_url
+                           else None)
         self.policy = policy if policy is not None else RestartPolicy()
         self.engine = PolicyEngine(self.policy, spec.world_size, rng=rng)
         self.poll_interval_s = float(poll_interval_s)
@@ -444,9 +460,12 @@ class Supervisor:
         worker_urls: Dict[int, str] = {}
         # workers get telemetry ports when probing OR when the fleet
         # aggregator needs endpoints to scrape
-        want_obs = s.probe or self.fleet is not None
+        want_obs = (s.probe or self.fleet is not None
+                    or s.obs_port_base is not None)
         for host in range(world):
-            obs_port = free_port() if want_obs else 0
+            obs_port = (s.obs_port_base + host
+                        if s.obs_port_base is not None
+                        else (free_port() if want_obs else 0))
             mapping = {"host": host, "world": world,
                        "incarnation": self.incarnation,
                        "run_dir": s.run_dir, "coord_port": coord_port,
@@ -475,11 +494,38 @@ class Supervisor:
             # fresh incarnation: the dying one's last-seen totals fold
             # into the per-host base inside (counters/histograms stay
             # monotonic across restarts)
+            if self.router_url is not None:
+                # the router scrapes under reserved host -1: its
+                # breaker/failover counters and goodput buckets ride
+                # the aggregated /metrics + /fleet like any replica's
+                worker_urls[-1] = self.router_url
             self.fleet.set_workers(worker_urls,
                                    incarnation=self.incarnation)
+        # the slots are live again — lift any drain pin the stop set
+        self._notify_router("resume", list(range(world)))
         return handles, probers
 
+    def _notify_router(self, op: str, hosts: List[int]) -> None:
+        """Best-effort drain orchestration toward a fronting router:
+        tell it which replicas are about to stop (or are back) so new
+        work routes around a PLANNED kill instead of piling onto a
+        doomed queue.  Never load-bearing — the router's breakers and
+        journal-backed failover cover the case where this call is lost
+        with the daemon mid-crash."""
+        if self.router_url is None or not hosts:
+            return
+        try:
+            from torchacc_tpu.utils.http import HttpClient
+            payload: Dict[str, Any] = {"hosts": hosts}
+            if op == "resume":
+                payload["op"] = "resume"
+            HttpClient(self.router_url, timeout_s=1.0,
+                       retries=0).post_json("/drain", payload)
+        except (OSError, ValueError):
+            pass
+
     def _stop_all(self, handles: List[WorkerHandle]) -> None:
+        self._notify_router("drain", [h.host for h in handles])
         for h in handles:
             if h.running():
                 h.terminate(self.spec.term_grace_s)
@@ -1016,11 +1062,13 @@ def main_from_args(args) -> int:
         argv=list(args.worker_argv),
         env=env,
         probe=args.probe,
+        obs_port_base=getattr(args, "obs_port_base", None),
         incarnation_timeout_s=args.incarnation_timeout_s,
         exit_grace_s=args.exit_grace_s,
     )
     sup = Supervisor(spec, policy, obs_port=args.obs_port,
-                     provisioner=provisioner)
+                     provisioner=provisioner,
+                     router_url=getattr(args, "router_url", None))
     report = sup.run()
     print(json.dumps(report, indent=2))
     return 0 if report["status"] == "completed" else 3

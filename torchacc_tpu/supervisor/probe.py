@@ -15,19 +15,21 @@ death.  Three layers of hardening:
   bad observations (:class:`WorkerProber`) — one slow scrape is noise,
   five in a row is a corpse.
 
-Stdlib-only (urllib), no jax anywhere: the supervisor daemon must run
-on a host that has never initialised a device."""
+The first two layers are the shared :class:`utils.http.HttpClient`
+contract (one retry/backoff implementation for the prober, the fleet
+scraper, and the serve router); this module adds the health-semantics
+layer on top.  Stdlib-only, no jax anywhere: the supervisor daemon
+must run on a host that has never initialised a device."""
 
 from __future__ import annotations
 
 import json
 import random
 import time
-import urllib.error
-import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
+from torchacc_tpu.utils.http import HttpClient
 from torchacc_tpu.utils.logger import logger
 
 
@@ -46,10 +48,12 @@ class ProbeResult:
         return self.status != "unreachable"
 
 
-class ProbeClient:
+class ProbeClient(HttpClient):
     """Timeout-bounded ``/healthz`` / ``/metrics`` reader with
-    in-call jittered retry.  ``sleep``/``rng`` are injectable so the
-    backoff schedule is testable without wall time."""
+    in-call jittered retry (the :class:`HttpClient` semantics: an HTTP
+    error status IS an answer, transport failures retry).  ``sleep``/
+    ``rng`` are injectable so the backoff schedule is testable without
+    wall time."""
 
     def __init__(self, base_url: str, *, timeout_s: float = 2.0,
                  retries: int = 2, backoff_s: float = 0.2,
@@ -57,42 +61,20 @@ class ProbeClient:
                  max_backoff_s: float = 2.0, jitter: float = 0.5,
                  rng: Optional[random.Random] = None,
                  sleep: Callable[[float], None] = time.sleep):
-        self.base_url = base_url.rstrip("/")
-        self.timeout_s = float(timeout_s)
-        self.retries = int(retries)
-        self.backoff_s = float(backoff_s)
-        self.backoff_multiplier = float(backoff_multiplier)
-        self.max_backoff_s = float(max_backoff_s)
-        self.jitter = float(jitter)
-        self._rng = rng if rng is not None else random.Random()
-        self._sleep = sleep
+        super().__init__(base_url, timeout_s=timeout_s, retries=retries,
+                         backoff_s=backoff_s,
+                         backoff_multiplier=backoff_multiplier,
+                         max_backoff_s=max_backoff_s, jitter=jitter,
+                         rng=rng, sleep=sleep)
 
     # -- raw fetch with retry ------------------------------------------------
 
-    def _delay(self, attempt: int) -> float:
-        base = min(self.backoff_s * (self.backoff_multiplier ** attempt),
-                   self.max_backoff_s)
-        return max(base * (1.0 + self.jitter
-                           * (2.0 * self._rng.random() - 1.0)), 0.0)
+    _delay = HttpClient.delay
 
     def _fetch(self, path: str):
         """(status_code, body) with bounded retries; raises the last
         error when every attempt failed."""
-        last: Optional[BaseException] = None
-        for attempt in range(self.retries + 1):
-            try:
-                with urllib.request.urlopen(self.base_url + path,
-                                            timeout=self.timeout_s) as r:
-                    return r.status, r.read().decode()
-            except urllib.error.HTTPError as e:
-                # an HTTP status IS an answer (503 = unhealthy), never
-                # a retry case
-                return e.code, e.read().decode()
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
-                last = e
-                if attempt < self.retries:
-                    self._sleep(self._delay(attempt))
-        raise last if last is not None else OSError("unreachable")
+        return self.request(path)
 
     # -- typed probes --------------------------------------------------------
 

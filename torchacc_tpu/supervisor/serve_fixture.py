@@ -22,6 +22,14 @@ original id, and only workload items with ids past the journal's
 newest accepted id are submitted fresh — a relaunched incarnation
 never double-submits.
 
+``--serve-http`` swaps the baked-in workload for the router tier's
+wire protocol: POST /submit, /result and /admin (begin_drain) mount on
+the telemetry server's JSON seams and a mailbox hands each call to the
+engine loop between steps, so the engine stays single-threaded.  The
+journal replay above still runs first — a supervisor-restarted worker
+re-admits its in-flight requests under the original ids, which is what
+lets the router adopt (rather than resubmit) them after a crash.
+
 Faults are ChaosPlan-driven from ``--chaos`` (strict JSON), applied
 only when ``--incarnation`` matches ``--chaos-incarnation`` (-1 =
 every incarnation) AND the rule's optional ``host`` matches ``--host``:
@@ -72,6 +80,16 @@ def _parse(argv):
     p.add_argument("--no-shed", action="store_true",
                    help="serve late instead of shedding expired "
                         "deadlines (the clean-reference configuration)")
+    p.add_argument("--serve-http", action="store_true",
+                   help="router-worker mode: no baked-in workload — "
+                        "requests arrive on POST /submit (telemetry "
+                        "server JSON seam) until --serve-for-s elapses "
+                        "or SIGTERM drains; requires --obs-port")
+    p.add_argument("--serve-for-s", type=float, default=120.0,
+                   help="--serve-http serving window")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="enable the prefix cache (the router "
+                        "affinity scenario's worker configuration)")
     p.add_argument("--chaos", default="",
                    help="strict-JSON fault spec (see module docstring)")
     p.add_argument("--chaos-incarnation", type=int, default=0,
@@ -113,6 +131,104 @@ def _rule(chaos, name, host):
     return picked
 
 
+def _serve_http(engine, args) -> None:
+    """Router-worker serving loop: requests arrive over the telemetry
+    server's POST /submit seam instead of a baked-in workload.  Handler
+    threads never touch the engine — a mailbox hands each op to the
+    single engine loop between steps (the engine is single-threaded by
+    design), so journal appends keep their one-appender discipline."""
+    import queue as _qmod
+    import threading
+    import time
+
+    from torchacc_tpu.obs import server as obs_server
+    from torchacc_tpu.resilience.preemption import (
+        install_preemption_handler, preemption_requested)
+    from torchacc_tpu.serve import Request
+
+    mailbox = _qmod.Queue()
+
+    def bridge(op):
+        def handler(payload):
+            ev = threading.Event()
+            box = {}
+            mailbox.put((op, payload, box, ev))
+            if not ev.wait(15.0):
+                return 503, {"error": "engine loop stalled"}
+            return box["code"], box["doc"]
+        return handler
+
+    def handle(op, payload):
+        if op == "submit":
+            if engine.draining:
+                return 503, {"error": "draining"}
+            try:
+                rid = engine.submit(Request(
+                    prompt_ids=[int(t) for t in payload["prompt_ids"]],
+                    max_new_tokens=payload.get("max_new_tokens"),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    top_p=float(payload.get("top_p", 1.0)),
+                    eos_id=payload.get("eos_id"),
+                    seed=int(payload.get("seed", 0)),
+                    priority=int(payload.get("priority", 0)),
+                    deadline_s=payload.get("deadline_s"),
+                    trace_id=payload.get("trace_id") or None))
+            except (KeyError, TypeError, ValueError) as e:
+                return 400, {"error": repr(e)}
+            except RuntimeError as e:  # queue full / never servable
+                return 429, {"error": str(e)}
+            return 200, {"rid": rid}
+        if op == "result":
+            rid = int(payload.get("rid", -1))
+            try:
+                r = engine.result(rid)
+            except KeyError:
+                return 200, {"rid": rid, "status": "unknown"}
+            except RuntimeError:
+                return 200, {"rid": rid, "status": "pending"}
+            status = "shed" if r.finish_reason == "shed" else "completed"
+            return 200, {"rid": rid, "status": status,
+                         "tokens": r.tokens,
+                         "finish_reason": r.finish_reason,
+                         "reason": r.finish_reason}
+        if op == "admin" and payload.get("op") == "begin_drain":
+            engine.begin_drain(str(payload.get("reason", "http")))
+            return 200, {"draining": True}
+        return 400, {"error": f"unknown op {op!r}"}
+
+    routes = {"/submit": bridge("submit"), "/result": bridge("result"),
+              "/admin": bridge("admin")}
+    for path, fn in routes.items():
+        obs_server.register_json_post(path, fn)
+    install_preemption_handler()
+    print(f"SERVE_HTTP_READY host={args.host} port={args.obs_port}",
+          flush=True)
+    t0 = time.monotonic()
+    try:
+        while time.monotonic() - t0 < args.serve_for_s:
+            while True:
+                try:
+                    op, payload, box, ev = mailbox.get_nowait()
+                except _qmod.Empty:
+                    break
+                try:
+                    box["code"], box["doc"] = handle(op, payload)
+                except Exception as e:  # noqa: BLE001 - HTTP boundary
+                    box["code"], box["doc"] = 500, {"error": repr(e)}
+                ev.set()
+            if preemption_requested() and not engine.draining:
+                engine.begin_drain("preempted")
+            busy = engine.step()
+            if engine.draining and not busy:
+                break
+            if not busy:
+                time.sleep(0.01)
+    finally:
+        for path, fn in routes.items():
+            obs_server.unregister_json_post(path, fn)
+
+
 def main(argv=None) -> int:
     args = _parse(sys.argv[1:] if argv is None else list(argv))
     try:
@@ -146,7 +262,7 @@ def main(argv=None) -> int:
         serve=ta.ServeConfig(
             block_size=8, num_blocks=96, max_slots=4, prefill_chunk=8,
             decode_depth=2, max_new_tokens=args.max_new,
-            journal_dir=journal_dir,
+            journal_dir=journal_dir, prefix_cache=args.prefix_cache,
             shed_deadlines=not args.no_shed),
         obs=ta.ObsConfig(enabled=True,
                          http_port=(args.obs_port or None),
@@ -176,7 +292,11 @@ def main(argv=None) -> int:
     known = (recovered["replayed"] + recovered["completed"]
              + recovered["shed"] + recovered["shed_on_recovery"])
     start = max(known) + 1 if known else 0
-    prompts = workload(args.seed, args.requests, args.max_new)
+    # HTTP mode takes its requests from the wire (the replay above
+    # still re-admits journaled work under the original ids — the
+    # router's failover adoption depends on exactly that)
+    prompts = ([] if args.serve_http
+               else workload(args.seed, args.requests, args.max_new))
     for i in range(start, len(prompts)):
         deadline = (args.deadline_s
                     if (args.deadline_s > 0 and i == len(prompts) - 1)
@@ -209,7 +329,10 @@ def main(argv=None) -> int:
     ctx = plan if armed else contextlib.nullcontext()
     try:
         with ctx:
-            engine.run()
+            if args.serve_http:
+                _serve_http(engine, args)
+            else:
+                engine.run()
     except Exception as e:  # noqa: BLE001 - exit code is the channel
         print(f"SERVE_ABORT type={type(e).__name__}: {e}", flush=True)
         _linger()

@@ -1,0 +1,114 @@
+"""One shared stdlib HTTP client for the jax-free control plane.
+
+Three callers used to carry their own copy of the same semantics —
+``supervisor/probe.py`` (ProbeClient's retrying ``_fetch``),
+``obs/aggregate.py`` (the fleet scraper's one-shot ``_http_fetch``),
+and now ``serve/router.py`` — so the retry/backoff contract lives here
+once:
+
+- every request is **timeout-bounded** (a wedged endpoint costs
+  ``timeout_s``, never a caller hang);
+- an HTTP error status **is an answer** (503 = unhealthy), returned as
+  ``(code, body)`` and never retried;
+- transport failures (connection refused, reset, timeout) retry with
+  **jittered exponential backoff** inside the call, then raise the
+  last error when every attempt failed;
+- ``sleep``/``rng`` are injectable so backoff schedules are testable
+  without wall time.
+
+Stdlib-only (urllib), no jax anywhere: every consumer runs on hosts
+that never initialise a device backend.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+
+def request(url: str, *, method: str = "GET",
+            data: Optional[bytes] = None,
+            headers: Optional[Dict[str, str]] = None,
+            timeout_s: float = 2.0) -> Tuple[int, str]:
+    """One attempt, no retry: ``(status_code, body)``.
+
+    An HTTP error status is returned, not raised; transport errors
+    (``URLError``/``OSError``/``TimeoutError``) propagate to the
+    caller — the retrying wrapper is :meth:`HttpClient.request`."""
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class HttpClient:
+    """Timeout-bounded, jitter-retrying client rooted at ``base_url``.
+
+    The retry loop covers transport failures only; any HTTP status is
+    a final answer.  ``delay(attempt)`` exposes the backoff schedule
+    (exponential from ``backoff_s`` capped at ``max_backoff_s``,
+    ±``jitter`` fraction) for callers that pace their own loops."""
+
+    def __init__(self, base_url: str, *, timeout_s: float = 2.0,
+                 retries: int = 2, backoff_s: float = 0.2,
+                 backoff_multiplier: float = 2.0,
+                 max_backoff_s: float = 2.0, jitter: float = 0.5,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.backoff_s * (self.backoff_multiplier ** attempt),
+                   self.max_backoff_s)
+        return max(base * (1.0 + self.jitter
+                           * (2.0 * self._rng.random() - 1.0)), 0.0)
+
+    def request(self, path: str, *, method: str = "GET",
+                data: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None
+                ) -> Tuple[int, str]:
+        """``(status_code, body)`` with bounded retries; raises the
+        last transport error when every attempt failed."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return request(self.base_url + path, method=method,
+                               data=data, headers=headers,
+                               timeout_s=self.timeout_s)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                last = e
+                if attempt < self.retries:
+                    self._sleep(self.delay(attempt))
+        raise last if last is not None else OSError("unreachable")
+
+    # -- JSON conveniences ----------------------------------------------------
+
+    def get_json(self, path: str) -> Tuple[int, object]:
+        """GET ``path`` and parse the body as JSON.  An unparseable
+        body raises ``ValueError`` (strict-JSON endpoints never answer
+        with prose on success paths)."""
+        code, body = self.request(path)
+        return code, json.loads(body)
+
+    def post_json(self, path: str, payload: object) -> Tuple[int, object]:
+        """POST ``payload`` as JSON, parse the JSON answer."""
+        code, body = self.request(
+            path, method="POST",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return code, json.loads(body)
