@@ -167,6 +167,21 @@ chaos:
 	$(MAKE) serve-chaos
 	$(MAKE) router-chaos
 	$(MAKE) chaos-replace
+	$(MAKE) data-chaos
+
+# streaming-data-plane gate (docs/data.md): the full store/stream
+# suite under 3 ChaosStore fault schedules — transient errors, 429
+# throttles, torn reads, checksum corruption, dead sources.  Proves
+# kill -9 mid-stream + restart yields bitwise-identical remaining
+# batches under injected store faults, quarantine-at-encounter equals
+# a pre-excluded run, a dead source sheds to survivors, and injected
+# stalls land in the data_wait goodput bucket — never as HangError.
+data-chaos:
+	for s in 0 1 2; do \
+		echo "== data chaos seed $$s =="; \
+		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) \
+			tests/test_datastream.py -m "not slow" -q || exit 1; \
+	done
 
 # multi-host robustness proof: 2-process jax.distributed fixtures
 # (cross-host resume consensus with divergent quarantine, preemption

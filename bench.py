@@ -332,6 +332,19 @@ def main() -> int:
                          "tokens saved, cow/eviction counts and warm-vs-"
                          "cold TTFT p50/p95 (`make serve-smoke` runs "
                          "this on CPU as the PR gate)")
+    ap.add_argument("--data", action="store_true",
+                    help="benchmark the streaming data plane (torchacc_"
+                         "tpu/data/store.py + stream.py, docs/data.md): "
+                         "host-side ingestion tokens/s over a 2-source "
+                         "ChaosStore mixture (transient errors, 429 "
+                         "throttles, torn reads, latency spikes), then "
+                         "a short fit over the same stream reporting "
+                         "data_wait ms/step from the goodput ledger "
+                         "plus the retry/quarantine counters.  FAILS "
+                         "unless the chaos-run batch stream is bitwise "
+                         "identical to a fault-free run and every "
+                         "injected stall lands in data_wait (`make "
+                         "data-chaos` runs the pytest gate)")
     args = ap.parse_args()
 
     wd = Watchdog()
@@ -353,6 +366,10 @@ def _bench(args, wd: Watchdog) -> int:
     dev, n_chips = devs[0], len(devs)
     print(f"[bench] devices: {n_chips}x {getattr(dev, 'device_kind', dev)}",
           file=sys.stderr)
+
+    if args.data:
+        # host-side + one tiny fit; no persistent-cache concerns
+        return _bench_data(args, wd, devs)
 
     if args.handoff:
         # same fresh-compile policy as the serve path (the serving
@@ -1254,6 +1271,173 @@ def _bench_obs(args, wd: Watchdog, devs) -> int:
         hist.reset()
         flight.recorder.clear()
         counters.reset()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+def _bench_data(args, wd: Watchdog, devs) -> int:
+    """Streaming-data-plane benchmark + gate (docs/data.md).
+
+    Leg 1 (host-side): stream one epoch of a 2-source weighted mixture
+    through ChaosStore-wrapped local stores (transient errors, 429
+    throttles, torn reads, latency spikes) and report ingestion
+    tokens/s plus the retry/quarantine counters; FAILS unless the
+    delivered batch stream is bitwise identical to a fault-free run.
+
+    Leg 2 (fit): a short ``accelerate`` fit over the same stream via
+    AsyncLoader with the goodput ledger on, reporting ``data_wait``
+    ms/step — the data-plane SLO — with the injected store latency
+    visibly accounted there (FAILS if data_wait misses the injected
+    stall time).
+    """
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.data import AsyncLoader
+    from torchacc_tpu.data.store import (ChaosStore, LocalShardStore,
+                                         write_store)
+    from torchacc_tpu.data.stream import StreamingDataset, StreamingSource
+    from torchacc_tpu.models import TransformerLM, get_preset
+    from torchacc_tpu.train import Trainer
+    from torchacc_tpu.utils.metrics import counters
+
+    n_chips = len(devs)
+    metric = "data_plane_ingest_tokens_per_s"
+
+    def fail(error: str, stage: str) -> int:
+        _emit({"metric": metric, "value": 0.0, "unit": "tokens_per_sec",
+               "vs_baseline": 0.0, "error": error, "stage": stage,
+               "elapsed_s": round(time.monotonic() - _T0, 1)})
+        return 1
+
+    wd.stage("data_build_stores", 120)
+    seq, rows, vocab = (128, 8, 256) if args.fast else (512, 8, 1024)
+    n_docs = 600 if args.fast else 4000
+    rng = np.random.default_rng(7)
+    base = tempfile.mkdtemp(prefix="bench_data_")
+
+    def mk_store(tag, n):
+        root = os.path.join(base, tag)
+        docs = [rng.integers(1, vocab, size=int(rng.integers(
+            seq // 4, seq))).astype(np.int32) for _ in range(n)]
+        write_store(root, docs, source=tag, shard_docs=48)
+        return root
+
+    ra = mk_store("web", n_docs)
+    rb = mk_store("code", n_docs // 2)
+    latency_s = 0.05
+
+    def mk_ds(chaos: bool):
+        def store(root, seed):
+            if not chaos:
+                return LocalShardStore(root)
+            return ChaosStore(
+                LocalShardStore(root), seed=seed, transient_rate=0.15,
+                throttle_rate=0.1, torn_rate=0.1, latency_s=latency_s,
+                latency_rate=0.15)
+        stores = [store(ra, 1), store(rb, 2)]
+        ds = StreamingDataset(
+            [StreamingSource("web", stores[0], weight=2.0),
+             StreamingSource("code", stores[1], weight=1.0)],
+            seq, rows, buffer_docs=96, shuffle_seed=11)
+        return ds, stores
+
+    try:
+        # -- leg 1: host-side ingestion under chaos, bitwise gate ----------
+        wd.stage("data_ingest", 300)
+        counters.reset()
+        ref_ds, _ = mk_ds(chaos=False)
+        ref = [b["input_ids"].copy() for b in ref_ds]
+        ds, stores = mk_ds(chaos=True)
+        t0 = time.perf_counter()
+        got = [b["input_ids"].copy() for b in ds]
+        ingest_wall = time.perf_counter() - t0
+        if len(got) != len(ref) or not all(
+                np.array_equal(a, b) for a, b in zip(got, ref)):
+            return fail("chaos-run batch stream is not bitwise identical "
+                        "to the fault-free run", "ingest")
+        tokens = len(got) * rows * seq
+        tokens_per_s = tokens / ingest_wall
+        injected_s = sum(getattr(s, "slept_s", 0.0) for s in stores)
+        injected = {}
+        for s in stores:
+            for k, v in getattr(s, "injected", {}).items():
+                injected[k] = injected.get(k, 0) + v
+        ingest_counters = {
+            k: counters.get(k) for k in
+            ("store_gets", "shard_fetch_retries", "shards_quarantined",
+             "data_sources_shed")}
+        if ingest_counters["shard_fetch_retries"] <= 0:
+            return fail("chaos injected faults but shard_fetch_retries "
+                        "stayed 0 — the retry path was bypassed",
+                        "ingest")
+
+        # -- leg 2: fit over the stream; data_wait is the SLO --------------
+        wd.stage("data_fit", args.compile_budget)
+        counters.reset()
+        steps = 8 if args.fast else 16
+        mc = get_preset(
+            "llama-tiny", dtype=jnp.float32, vocab_size=vocab,
+            hidden_size=64, num_layers=1, num_heads=2, num_kv_heads=2,
+            intermediate_size=128, max_seq_len=seq)
+        cfg = ta.Config(
+            obs=ta.ObsConfig(enabled=True, goodput=True),
+            resilience=ta.ResilienceConfig(retry_base_delay_s=0.01,
+                                           retry_max_delay_s=0.05))
+        cfg.dist.dp.size = n_chips
+        tr = Trainer(TransformerLM(mc), cfg, optimizer=optax.adamw(1e-3))
+        fit_ds, fit_stores = mk_ds(chaos=True)
+        loader = AsyncLoader(fit_ds, cfg)
+        t0 = time.perf_counter()
+        tr.fit(loader, max_steps=steps,
+               metrics_dir=os.path.join(base, "metrics"))
+        fit_wall = time.perf_counter() - t0
+        data_wait_ms = counters.get("goodput_data_wait_ms")
+        fit_injected_s = sum(getattr(s, "slept_s", 0.0)
+                             for s in fit_stores)
+        wd.stage("report", 60)
+        result = {
+            "metric": metric,
+            "value": round(tokens_per_s, 1),
+            "unit": "tokens_per_sec",
+            "vs_baseline": 1.0,
+            "detail": {
+                "ingest": {
+                    "tokens": tokens,
+                    "batches": len(got),
+                    "wall_s": round(ingest_wall, 3),
+                    "injected_faults": injected,
+                    "injected_latency_s": round(injected_s, 3),
+                    "counters": ingest_counters,
+                    "bitwise_vs_fault_free": True,
+                },
+                "fit": {
+                    "steps": steps,
+                    "wall_s": round(fit_wall, 3),
+                    "data_wait_ms_per_step": round(
+                        data_wait_ms / max(steps, 1), 2),
+                    "data_wait_ms_total": data_wait_ms,
+                    "injected_latency_s": round(fit_injected_s, 3),
+                    "loader_retries": counters.get("loader_retries"),
+                    "shard_fetch_retries": counters.get(
+                        "shard_fetch_retries"),
+                    "stalls_deferred": counters.get(
+                        "loader_stalls_deferred"),
+                },
+                "seq_len": seq,
+                "batch_rows": rows,
+                "n_chips": n_chips,
+                "fast": bool(args.fast),
+                "wall_s": round(time.monotonic() - _T0, 1),
+            },
+        }
+        _emit(result)
+        return 0
+    finally:
         shutil.rmtree(base, ignore_errors=True)
 
 

@@ -2,6 +2,11 @@ from torchacc_tpu.data.async_loader import AsyncLoader
 from torchacc_tpu.data.bucketing import closest_bucket, pad_batch
 from torchacc_tpu.data.dataset import PackedDataset
 from torchacc_tpu.data.packing import pack_sequences
+from torchacc_tpu.data.store import (ChaosStore, LocalShardStore, ShardStore,
+                                     StoreClient, write_store)
+from torchacc_tpu.data.stream import StreamingDataset, StreamingSource
 
 __all__ = ["AsyncLoader", "closest_bucket", "pad_batch", "PackedDataset",
-           "pack_sequences"]
+           "pack_sequences", "ShardStore", "LocalShardStore", "ChaosStore",
+           "StoreClient", "write_store", "StreamingDataset",
+           "StreamingSource"]

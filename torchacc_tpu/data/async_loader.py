@@ -44,7 +44,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Dict, Iterable, Iterator, Optional
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
 import jax
 import numpy as np
@@ -142,6 +142,43 @@ class AsyncLoader:
         self._consumed = 0
         self._src_pos = 0
         self._resume_state: Optional[Dict[str, Any]] = None
+        # "slow, not stuck": count of producer-side retry backoffs in
+        # flight (fetch/transfer), read by the consumer's stall deadline
+        # so a retrying source defers the hang verdict instead of
+        # tripping it — retry wait is data_wait (the SLO), not a hang
+        self._retrying = 0
+        self._stall_heartbeat: Optional[Callable[[], None]] = None
+
+    # -- stall/retry plumbing -------------------------------------------------
+    @property
+    def in_retry(self) -> bool:
+        """True while a producer-side fetch/transfer is inside a retry
+        backoff — here or in the wrapped source (e.g. a StreamingDataset
+        retrying a store GET)."""
+        return (self._retrying > 0
+                or bool(getattr(self._loader, "in_retry", False)))
+
+    def set_stall_heartbeat(self, fn: Optional[Callable[[], None]]) -> None:
+        """Wire the trainer watchdog's ``beat`` in: it fires before
+        every retry backoff sleep (and is forwarded to the wrapped
+        source), so a long backoff never reads as a dead section."""
+        self._stall_heartbeat = fn
+        fwd = getattr(self._loader, "set_stall_heartbeat", None)
+        if callable(fwd):
+            fwd(fn)
+
+    def _retry_sleep(self, seconds: float) -> None:
+        self._retrying += 1
+        try:
+            hb = self._stall_heartbeat
+            if hb is not None:
+                try:
+                    hb()
+                except Exception:
+                    pass
+            time.sleep(seconds)
+        finally:
+            self._retrying -= 1
 
     # -- durable state -------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -198,7 +235,8 @@ class AsyncLoader:
                 raise
             return item
         return retry_call(once, policy=self._retry, counter="loader_retries",
-                          description="loader batch fetch")
+                          description="loader batch fetch",
+                          sleep=self._retry_sleep)
 
     def _leaf_sharding(self, leaf) -> NamedSharding:
         """Batch sharding truncated to the leaf's rank (scalars — e.g.
@@ -226,7 +264,8 @@ class AsyncLoader:
             return {k: jax.device_put(v, self._leaf_sharding(v))
                     for k, v in host.items()}
         return retry_call(once, policy=self._retry, counter="loader_retries",
-                          description="loader device transfer")
+                          description="loader device transfer",
+                          sleep=self._retry_sleep)
 
     def skip_batches(self, n: int) -> Iterator[Dict[str, jax.Array]]:
         """Iterate after fast-forwarding ``n`` source batches WITHOUT
@@ -487,16 +526,33 @@ class AsyncLoader:
         deadline = self._stall_deadline
         if not deadline:
             return q.get()
-        import time
         start = time.monotonic()
         quantum = min(max(deadline / 4.0, 0.01), 0.5)
         tripped = False
+        deferred = False
         while True:
             try:
                 return q.get(timeout=quantum)
             except queue.Empty:
                 waited = time.monotonic() - start
                 if waited >= deadline and not tripped:
+                    if self.in_retry:
+                        # the producer is SLOW, not STUCK: a fetch is
+                        # inside a retry backoff (store 429s, transient
+                        # errors).  That wait belongs to the data_wait
+                        # SLO, not the hang verdict — defer the deadline
+                        # until the retrying clears
+                        if not deferred:
+                            deferred = True
+                            from torchacc_tpu.utils.metrics import counters
+                            counters.inc("loader_stalls_deferred")
+                            logger.warning(
+                                f"loader stall deadline ({deadline:.1f}s)"
+                                " reached while the source is retrying —"
+                                " deferring the hang verdict (this wait "
+                                "is data_wait, not a hang)")
+                        start = time.monotonic()
+                        continue
                     from torchacc_tpu.resilience.watchdog import trip_stall
                     trip_stall("loader.fetch", waited, deadline,
                                dump_dir=self._stall_dump_dir,

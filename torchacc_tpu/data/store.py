@@ -1,0 +1,408 @@
+"""Object-store shard abstraction for the streaming data plane.
+
+A trillion-token run does not read local Sequences — it streams shards
+from an object store that throttles (HTTP 429), tears reads mid-object,
+serves the occasional bit-rotted payload, and sometimes goes away
+entirely.  This module is the storage half of that pipeline
+(``data/stream.py`` is the ordering/packing half):
+
+- **Manifest + shard codec** — a store is a flat namespace of shard
+  blobs plus one ``manifest.json`` naming every shard with its byte
+  size, sha256, and document count.  The checksum in the manifest is
+  what makes torn reads and corruption *detectable*; the doc counts are
+  what make resume seekable without fetching (``stream.py`` walks the
+  global document order from counts alone).  The codec is a fixed
+  little-endian layout (magic + lengths + payload) so shard bytes — and
+  therefore checksums — are identical across hosts and runs.
+- :class:`ShardStore` / :class:`LocalShardStore` — the GET surface and
+  its local-directory backend (the gs:// backend is the same two
+  methods over tensorstore/GCS when a real bucket exists).
+- :class:`ChaosStore` — a fault-injecting wrapper with a gs://-shaped
+  failure model: transient 5xx-ish errors, 429 throttling with a
+  retry-after, latency spikes, torn (short) reads, checksum-corrupted
+  payloads, and hard-dead stores.  Faults are a pure function of
+  ``(seed, shard name, attempt)`` so the same seed yields the same
+  fault schedule regardless of fetch order — the property the bitwise
+  chaos gates stand on.
+- :class:`StoreClient` — ALL store GETs go through this one path: the
+  shared retry/backoff core (``utils/retry.py``, the same policy object
+  the HTTP client and checkpoint I/O use), checksum verification
+  against the manifest, decode, per-source :class:`CircuitBreaker`
+  bookkeeping, and the ``store_gets`` / ``shard_fetch_retries``
+  counters.  A GET that stays bad across the retry budget raises typed
+  :class:`~torchacc_tpu.errors.ShardCorruptionError` /
+  ``DataLoaderError`` — the caller (``stream.py``) quarantines the
+  shard and moves on.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import zlib
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from torchacc_tpu.errors import DataLoaderError, ShardCorruptionError
+from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.retry import CircuitBreaker, RetryPolicy, retry_call
+
+_MAGIC = b"TASH1\n"
+MANIFEST_NAME = "manifest.json"
+
+
+class ThrottleError(OSError):
+    """An HTTP-429-shaped rejection: the backend is alive but pacing
+    us.  ``retry_after_s`` is honoured by the shared retry core (the
+    backoff sleep is at least that long)."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.05):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+# -- shard codec ---------------------------------------------------------------
+
+def encode_shard(docs: Sequence[Any], *, kind: str = "tokens") -> bytes:
+    """Serialise documents into one shard blob.
+
+    ``kind='tokens'``: each doc is an int32 token array.  ``'text'``:
+    each doc is a str (tokenized online at read time).  Fixed layout —
+    magic, kind byte, uint32 ndocs, uint32 lengths, payload — so the
+    bytes (and the manifest checksum) are platform-independent."""
+    if kind not in ("tokens", "text"):
+        raise ValueError(f"unknown shard kind {kind!r}")
+    if kind == "tokens":
+        payloads = [np.asarray(d, np.int32).reshape(-1).tobytes()
+                    for d in docs]
+        lengths = [len(p) // 4 for p in payloads]
+    else:
+        payloads = [str(d).encode("utf-8") for d in docs]
+        lengths = [len(p) for p in payloads]
+    head = (_MAGIC + (b"T" if kind == "tokens" else b"X")
+            + np.uint32(len(docs)).tobytes()
+            + np.asarray(lengths, "<u4").tobytes())
+    return head + b"".join(payloads)
+
+
+def decode_shard(data: bytes) -> tuple:
+    """``(kind, docs)`` from shard bytes; raises
+    :class:`ShardCorruptionError` on any structural damage (bad magic,
+    truncation, trailing garbage)."""
+    def bad(reason: str) -> ShardCorruptionError:
+        return ShardCorruptionError(
+            f"shard payload undecodable: {reason}", reason=reason)
+    if len(data) < len(_MAGIC) + 5 or not data.startswith(_MAGIC):
+        raise bad("bad magic")
+    kind_b = data[len(_MAGIC):len(_MAGIC) + 1]
+    if kind_b not in (b"T", b"X"):
+        raise bad(f"unknown kind byte {kind_b!r}")
+    kind = "tokens" if kind_b == b"T" else "text"
+    off = len(_MAGIC) + 1
+    ndocs = int(np.frombuffer(data[off:off + 4], "<u4")[0])
+    off += 4
+    if len(data) < off + 4 * ndocs:
+        raise bad("truncated length table")
+    lengths = np.frombuffer(data[off:off + 4 * ndocs], "<u4").astype(np.int64)
+    off += 4 * ndocs
+    unit = 4 if kind == "tokens" else 1
+    need = off + int(lengths.sum()) * unit
+    if len(data) != need:
+        raise bad(f"payload is {len(data) - off} bytes, header says "
+                  f"{need - off}")
+    docs: List[Any] = []
+    for ln in lengths:
+        n = int(ln) * unit
+        chunk = data[off:off + n]
+        off += n
+        if kind == "tokens":
+            docs.append(np.frombuffer(chunk, "<i4").astype(np.int32))
+        else:
+            try:
+                docs.append(chunk.decode("utf-8"))
+            except UnicodeDecodeError as e:
+                raise bad(f"undecodable text doc: {e}") from e
+    return kind, docs
+
+
+# -- stores --------------------------------------------------------------------
+
+class ShardStore:
+    """The GET surface every backend implements: one manifest, byte
+    blobs by name.  Implementations raise ``OSError`` (or subclasses
+    like :class:`ThrottleError`) for transport failures — the
+    :class:`StoreClient` owns retries; stores stay retry-free."""
+
+    def manifest(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get(self, name: str) -> bytes:
+        raise NotImplementedError
+
+
+class LocalShardStore(ShardStore):
+    """Directory-backed store: shards are files under ``root``,
+    ``manifest.json`` beside them (what :func:`write_store` lays out)."""
+
+    def __init__(self, root: str):
+        self.root = str(root)
+
+    def manifest(self) -> Dict[str, Any]:
+        with open(os.path.join(self.root, MANIFEST_NAME)) as f:
+            return json.load(f)
+
+    def get(self, name: str) -> bytes:
+        if os.sep in name or name.startswith("."):
+            raise DataLoaderError(f"illegal shard name {name!r}")
+        with open(os.path.join(self.root, name), "rb") as f:
+            return f.read()
+
+
+def write_store(root: str, docs: Sequence[Any], *, source: str,
+                shard_docs: int = 64, kind: str = "tokens"
+                ) -> Dict[str, Any]:
+    """Shard ``docs`` into ``root`` and write the manifest; returns the
+    manifest dict.  The builder the tests/bench use — a production
+    ingest job writes the same layout into a bucket."""
+    os.makedirs(root, exist_ok=True)
+    shards: List[Dict[str, Any]] = []
+    for i in range(0, max(len(docs), 1), shard_docs):
+        chunk = docs[i:i + shard_docs]
+        if not len(chunk):
+            break
+        name = f"{source}-{i // shard_docs:05d}.tash"
+        blob = encode_shard(chunk, kind=kind)
+        with open(os.path.join(root, name), "wb") as f:
+            f.write(blob)
+        shards.append({
+            "name": name, "docs": len(chunk), "bytes": len(blob),
+            "sha256": hashlib.sha256(blob).hexdigest(), "kind": kind,
+        })
+    manifest = {"version": 1, "source": source, "shards": shards}
+    tmp = os.path.join(root, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+    os.replace(tmp, os.path.join(root, MANIFEST_NAME))
+    return manifest
+
+
+# -- fault injection -----------------------------------------------------------
+
+class ChaosStore(ShardStore):
+    """gs://-shaped fault model around any :class:`ShardStore`.
+
+    Per-shard fault plans are derived once from ``(seed, shard name)``
+    and consumed per GET *attempt*, so the schedule is deterministic
+    under any fetch order and any retry policy:
+
+    - ``transient_rate``: the shard's first 1–2 GETs raise ``OSError``
+      (a 5xx / connection reset), then succeed;
+    - ``throttle_rate``: the first GET raises :class:`ThrottleError`
+      (429 + retry-after), then succeeds;
+    - ``torn_rate``: the first GET returns a SHORT read (truncated
+      bytes — checksum catches it), then succeeds;
+    - ``latency_s`` / ``latency_rate``: the GET sleeps first (the
+      ``data_wait`` SLO regression hook);
+    - ``corrupt_rate`` / ``corrupt_shards``: the payload is bit-flipped
+      on EVERY read — permanent damage, the quarantine path;
+    - ``dead``: every GET raises — a source that fell off the network
+      (the breaker-shed path).
+
+    A shard draws at most one of transient/throttle/torn (priority in
+    that order) so fault budgets stay predictable per shard.
+    """
+
+    def __init__(self, inner: ShardStore, *, seed: int = 0,
+                 transient_rate: float = 0.0, throttle_rate: float = 0.0,
+                 torn_rate: float = 0.0, corrupt_rate: float = 0.0,
+                 corrupt_shards: Iterable[str] = (),
+                 latency_s: float = 0.0, latency_rate: float = 0.0,
+                 dead: bool = False,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self.seed = int(seed)
+        self.transient_rate = float(transient_rate)
+        self.throttle_rate = float(throttle_rate)
+        self.torn_rate = float(torn_rate)
+        self.corrupt_rate = float(corrupt_rate)
+        self.corrupt_shards = set(corrupt_shards)
+        self.latency_s = float(latency_s)
+        self.latency_rate = float(latency_rate)
+        self.dead = bool(dead)
+        self._sleep = sleep
+        self._attempts: Dict[str, int] = {}
+        self.injected: Dict[str, int] = {}   # fault kind -> count
+        self.slept_s = 0.0                   # total injected latency
+
+    def manifest(self) -> Dict[str, Any]:
+        if self.dead:
+            raise OSError("chaos: store is dead (manifest)")
+        return self.inner.manifest()
+
+    def _plan(self, name: str) -> Dict[str, Any]:
+        import random as _random
+        rng = _random.Random(
+            zlib.crc32(f"{self.seed}|{name}".encode()))
+        r = rng.random()
+        fault, n = None, 0
+        if r < self.transient_rate:
+            fault, n = "transient", 1 + int(rng.random() * 2)
+        elif r < self.transient_rate + self.throttle_rate:
+            fault, n = "throttle", 1
+        elif r < self.transient_rate + self.throttle_rate + self.torn_rate:
+            fault, n = "torn", 1
+        return {
+            "fault": fault, "n": n,
+            "corrupt": (name in self.corrupt_shards
+                        or rng.random() < self.corrupt_rate),
+            "latency": rng.random() < self.latency_rate,
+        }
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def get(self, name: str) -> bytes:
+        if self.dead:
+            self._count("dead")
+            raise OSError(f"chaos: store is dead (GET {name})")
+        plan = self._plan(name)
+        attempt = self._attempts.get(name, 0)
+        self._attempts[name] = attempt + 1
+        if plan["latency"] and attempt == 0:
+            self._count("latency")
+            logger.warning(f"chaos: {self.latency_s:.2f}s latency spike "
+                           f"on GET {name}")
+            self._sleep(self.latency_s)
+            self.slept_s += self.latency_s
+        if plan["fault"] is not None and attempt < plan["n"]:
+            self._count(plan["fault"])
+            if plan["fault"] == "transient":
+                raise OSError(f"chaos: transient store error on GET "
+                              f"{name} (attempt {attempt})")
+            if plan["fault"] == "throttle":
+                raise ThrottleError(
+                    f"chaos: 429 on GET {name} (attempt {attempt})",
+                    retry_after_s=0.01)
+            data = self.inner.get(name)
+            return data[:max(len(data) // 2, 1)]     # torn read
+        data = self.inner.get(name)
+        if plan["corrupt"]:
+            self._count("corrupt")
+            buf = bytearray(data)
+            buf[len(buf) // 2] ^= 0x40               # one flipped bit
+            return bytes(buf)
+        return data
+
+
+# -- the one GET path ----------------------------------------------------------
+
+class StoreClient:
+    """Retrying, checksum-verifying, breaker-tracking shard reader for
+    ONE source.  Every GET: ``store.get`` → sha256 vs manifest → decode
+    (→ tokenize for text shards), all inside the shared retry core; a
+    checksum/decode failure is retried (torn reads are transient), and
+    the LAST failure propagates typed for ``stream.py`` to quarantine.
+
+    ``on_wait(seconds)`` fires before every backoff sleep — the
+    in-retry heartbeat seam (``AsyncLoader`` reads :attr:`in_retry` so
+    a slow-but-retrying source never trips ``HangError``)."""
+
+    def __init__(self, store: ShardStore, *, source: str,
+                 policy: Optional[RetryPolicy] = None,
+                 failure_budget: int = 3,
+                 breaker_cooldown_s: float = 30.0,
+                 tokenize: Optional[Callable[[str], Any]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_wait: Optional[Callable[[float], None]] = None):
+        self.store = store
+        self.source = str(source)
+        self.policy = policy if policy is not None else RetryPolicy(
+            max_retries=3, base_delay_s=0.05, max_delay_s=1.0,
+            retry_on=(OSError, ShardCorruptionError))
+        self.breaker = CircuitBreaker(failure_threshold=max(
+            int(failure_budget), 1), cooldown_s=breaker_cooldown_s)
+        self.tokenize = tokenize
+        self._sleep = sleep
+        self._on_wait = on_wait
+        self._retrying = 0           # threads currently inside a backoff
+        self._entries: Optional[Dict[str, Dict[str, Any]]] = None
+
+    @property
+    def in_retry(self) -> bool:
+        return self._retrying > 0
+
+    def manifest_entries(self) -> Dict[str, Dict[str, Any]]:
+        """name -> manifest entry, fetched once through the retry
+        core (a dead store fails HERE, typed)."""
+        if self._entries is None:
+            try:
+                man = retry_call(self.store.manifest, policy=self.policy,
+                                 description=f"{self.source}: manifest",
+                                 counter="shard_fetch_retries",
+                                 sleep=self._retry_sleep)
+            except Exception as e:
+                raise DataLoaderError(
+                    f"source {self.source!r}: manifest unreadable "
+                    f"({e!r})") from e
+            self._entries = {s["name"]: s for s in man.get("shards", [])}
+        return self._entries
+
+    def _retry_sleep(self, seconds: float) -> None:
+        self._retrying += 1
+        try:
+            if self._on_wait is not None:
+                self._on_wait(seconds)
+            self._sleep(seconds)
+        finally:
+            self._retrying -= 1
+
+    def get_docs(self, name: str) -> List[Any]:
+        """Fetch + verify + decode one shard into its document list.
+        Raises :class:`ShardCorruptionError` (persistent corruption) or
+        ``OSError`` (transport, retries exhausted); the caller owns the
+        quarantine verdict and the breaker's failure edge."""
+        from torchacc_tpu.utils.metrics import counters
+        entry = self.manifest_entries().get(name)
+        if entry is None:
+            raise DataLoaderError(
+                f"source {self.source!r}: shard {name!r} is not in the "
+                "manifest")
+        want_sha = entry.get("sha256")
+
+        def once() -> List[Any]:
+            failpoint("store.get", source=self.source, shard=name)
+            counters.inc("store_gets")
+            data = self.store.get(name)
+            if want_sha is not None:
+                got = hashlib.sha256(data).hexdigest()
+                if got != want_sha:
+                    raise ShardCorruptionError(
+                        f"{self.source}/{name}: sha256 {got[:12]} != "
+                        f"manifest {want_sha[:12]} (torn read or "
+                        "corruption)", source=self.source, shard=name,
+                        reason="checksum mismatch")
+            kind, docs = decode_shard(data)
+            if kind == "text":
+                if self.tokenize is None:
+                    raise DataLoaderError(
+                        f"{self.source}/{name} holds text docs but the "
+                        "source has no tokenizer")
+                docs = [self.tokenize(d) for d in docs]
+            return [np.asarray(d, np.int32).reshape(-1) for d in docs]
+
+        return retry_call(
+            once, policy=self.policy,
+            description=f"{self.source}/{name}: shard fetch",
+            counter="shard_fetch_retries", sleep=self._retry_sleep)
+
+    def record_outcome(self, ok: bool) -> bool:
+        """Feed the per-source breaker; returns True on the OPEN edge
+        (the stream sheds the source exactly once)."""
+        if ok:
+            self.breaker.record_success()
+            return False
+        return self.breaker.record_failure()

@@ -1,0 +1,537 @@
+"""Streaming dataset over object-store shards: the ordering half of the
+fault-tolerant data plane (``data/store.py`` is the storage half).
+
+:class:`StreamingDataset` lists shards from each source's manifest and
+delivers a packed batch stream with the same durable-state contract as
+:class:`~torchacc_tpu.data.dataset.PackedDataset` — which it extends, so
+the group packing, global-batch sharding, and O(1) bisect resume are the
+SAME code path local Sequence training uses.  What this layer adds:
+
+- **Deterministic, world-size-independent order.**  The global document
+  stream is a pure function of ``(shuffle_seed, epoch, manifests,
+  weights + reweight history, quarantined set, shed history)``: shard
+  order per source is a permutation keyed ``(seed, epoch, source)``,
+  document order within a shard keyed ``(seed, epoch, source, shard)``
+  (the shuffle window IS the shard — bounded memory at any corpus
+  size), and sources interleave by smooth weighted round-robin — a
+  deterministic deficit scheduler, no RNG in the mixture at all.  Every
+  host computes the identical global stream and slices its rows, so a
+  checkpoint saved at N hosts resumes at M bitwise (elastic resume).
+- **Mixture weights with live re-weighting.**  ``set_weights`` takes
+  effect at the next document and is recorded as ``(epoch, doc_index,
+  weights)`` in ``state_dict()`` — resume replays the recipe change at
+  the same point, so a mid-run recipe shift is as resumable as the
+  original recipe.
+- **Quarantine instead of crash.**  A shard whose payload stays corrupt
+  (checksum/decode) or unfetchable across the retry budget is
+  quarantined — written to the quarantine manifest, counted
+  (``shards_quarantined``), and skipped.  Shards are resolved eagerly
+  when the cursor CROSSES into them (not lazily when a document is
+  drawn), which makes quarantine-at-encounter bitwise-equivalent to a
+  run constructed with those shards pre-excluded: the interleave never
+  observes the bad shard at all.
+- **Source shedding.**  Each source feeds a circuit breaker; on the
+  open edge the source is shed from the mixture (remaining weights
+  renormalize implicitly, ``data_sources_shed``), a typed
+  :class:`~torchacc_tpu.errors.DataSourceError` is recorded — and
+  raised only when no source remains.  Sheds are recorded with their
+  ``(epoch, doc_index)`` so a post-shed checkpoint resumes bitwise.
+- **Resume without refetching.**  ``load_state_dict`` seeks by
+  replaying the interleave ARITHMETICALLY — manifest document counts
+  only, no shard GETs — up to the saved position, then fetches just
+  each live source's current shard.  Resume cost is O(delivered docs)
+  integer work + one GET per source, independent of corpus size.
+
+Under :class:`~torchacc_tpu.data.async_loader.AsyncLoader` the producer
+thread owns all fetching; the loader's prefetch queue is the starvation
+buffer and stalls surface in the ``data_wait`` goodput bucket (the data
+SLO), not as consumer hangs: retry backoffs raise :attr:`in_retry`,
+which the loader's deadline watchdog treats as "slow, not stuck".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
+
+from torchacc_tpu.data.dataset import PackedDataset
+from torchacc_tpu.data.store import ShardStore, StoreClient
+from torchacc_tpu.errors import (DataLoaderError, DataSourceError,
+                                 ShardCorruptionError)
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import counters
+from torchacc_tpu.utils.retry import RetryPolicy
+
+QUARANTINE_FILE = "data_quarantine.json"
+
+
+class StreamingSource:
+    """One named corpus: a :class:`ShardStore` plus its mixture weight.
+
+    ``tokenize`` is required when the store holds ``text`` shards
+    (online tokenization happens in the fetch worker, never on the
+    consumer thread)."""
+
+    def __init__(self, name: str, store: ShardStore, *,
+                 weight: float = 1.0,
+                 tokenize: Optional[Callable[[str], Any]] = None):
+        if not name or "/" in name:
+            raise ValueError(f"illegal source name {name!r}")
+        if not weight > 0:
+            raise ValueError(f"source {name!r}: weight must be > 0")
+        self.name = str(name)
+        self.store = store
+        self.weight = float(weight)
+        self.tokenize = tokenize
+
+
+class _Run:
+    """Per-source walk state for one epoch: the shard cursor, the SWRR
+    deficit counter, and (fetch mode only) the resolved current shard."""
+
+    __slots__ = ("name", "entries", "order", "k", "j", "cw", "ew",
+                 "cur_docs")
+
+    def __init__(self, name: str, entries: List[Dict[str, Any]],
+                 order: np.ndarray, ew: float):
+        self.name = name
+        self.entries = entries          # manifest order
+        self.order = order              # epoch shard permutation
+        self.k = 0                      # position in ``order``
+        self.j = 0                      # docs delivered from current shard
+        self.cw = 0.0                   # SWRR current (deficit) weight
+        self.ew = ew                    # SWRR effective weight
+        self.cur_docs: Optional[List[np.ndarray]] = None
+
+    def entry(self) -> Dict[str, Any]:
+        return self.entries[int(self.order[self.k])]
+
+
+class StreamingDataset(PackedDataset):
+    """Packed batch stream over weighted object-store sources.
+
+    Yields the same ``{"input_ids", "segment_ids", "positions"}``
+    batches as :class:`PackedDataset` (shape ``[batch_rows/num_shards,
+    seq_len]``) and speaks the same ``state_dict`` protocol — plus the
+    mixture/quarantine/shed state described in the module docstring.
+
+    ``quarantined`` pre-excludes ``"source/shard"`` keys (the format the
+    quarantine manifest records); ``quarantine_dir`` persists the
+    manifest across restarts.  One live iterator per instance, exactly
+    as the parent.
+    """
+
+    def __init__(
+        self,
+        sources: Sequence[StreamingSource],
+        seq_len: int,
+        batch_rows: int,
+        *,
+        buffer_docs: int = 512,
+        pad_id: int = 0,
+        pad_final: bool = False,
+        shuffle_seed: int = 0,
+        num_shards: int = 1,
+        shard_index: int = 0,
+        quarantined: Iterable[str] = (),
+        quarantine_dir: Optional[str] = None,
+        failure_budget: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        retry_policy: Optional[RetryPolicy] = None,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if not sources:
+            raise ValueError("need at least one StreamingSource")
+        names = [s.name for s in sources]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate source names: {names}")
+        super().__init__(
+            (), seq_len, batch_rows, buffer_docs=buffer_docs,
+            pad_id=pad_id, pad_final=pad_final, shuffle_seed=shuffle_seed,
+            num_shards=num_shards, shard_index=shard_index)
+        self.sources = {s.name: s for s in sources}
+        self._weights0 = {s.name: s.weight for s in sources}
+        self._reweights: List[Tuple[int, int, Dict[str, float]]] = []
+        self._sheds: List[Tuple[int, int, str]] = []
+        self.quarantined = set(quarantined)
+        self.quarantine_dir = quarantine_dir
+        self.source_errors: List[DataSourceError] = []
+        self._heartbeat: Optional[Callable[[], None]] = None
+        self._clients = {
+            s.name: StoreClient(
+                s.store, source=s.name, policy=retry_policy,
+                failure_budget=failure_budget,
+                breaker_cooldown_s=breaker_cooldown_s,
+                tokenize=s.tokenize, sleep=sleep, on_wait=self._on_wait)
+            for s in sources}
+        # live walk position (producer side) — what set_weights stamps
+        self._walk_epoch = 0
+        self._walk_idx = 0
+        if self.quarantine_dir:
+            self._load_quarantine_file()
+
+    # -- plumbing the loader reads --------------------------------------------
+
+    @property
+    def in_retry(self) -> bool:
+        """True while any source's fetch is inside a retry backoff —
+        the loader's stall watchdog defers ``HangError`` while this
+        holds (slow-but-retrying is ``data_wait``, not a hang)."""
+        return any(c.in_retry for c in self._clients.values())
+
+    def set_stall_heartbeat(self, fn: Optional[Callable[[], None]]) -> None:
+        """Called before every retry backoff sleep — wire the trainer's
+        watchdog ``beat`` here so long backoffs never look like hangs."""
+        self._heartbeat = fn
+
+    def _on_wait(self, seconds: float) -> None:
+        hb = self._heartbeat
+        if hb is not None:
+            try:
+                hb()
+            except Exception:
+                pass
+
+    # -- mixture recipe -------------------------------------------------------
+
+    def set_weights(self, weights: Dict[str, float]) -> None:
+        """Re-weight the mixture, effective at the NEXT document.
+
+        Partial dicts re-weight just the named sources.  The change is
+        recorded as ``(epoch, doc_index, weights)`` in ``state_dict()``
+        so resume replays it at the identical point."""
+        unknown = set(weights) - set(self.sources)
+        if unknown:
+            raise ValueError(f"unknown sources in set_weights: "
+                             f"{sorted(unknown)}")
+        for name, w in weights.items():
+            if not float(w) >= 0:
+                raise ValueError(f"weight for {name!r} must be >= 0")
+        self._reweights.append(
+            (self._walk_epoch, self._walk_idx,
+             {k: float(v) for k, v in weights.items()}))
+        logger.info(f"data mixture re-weighted at epoch "
+                    f"{self._walk_epoch} doc {self._walk_idx}: {weights}")
+
+    # -- durable state --------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        d = super().state_dict()
+        d.update({
+            "kind": "streaming_dataset",
+            "sources": sorted(self.sources),
+            "weights": dict(self._weights0),
+            "reweights": [[e, i, dict(w)] for e, i, w in self._reweights],
+            "sheds": [[e, i, n] for e, i, n in self._sheds],
+            "quarantined": sorted(self.quarantined),
+        })
+        return d
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        if state.get("kind") not in ("streaming_dataset", None):
+            raise DataLoaderError(
+                f"loader-state mismatch: saved kind={state.get('kind')!r} "
+                "is not a streaming_dataset state")
+        saved = state.get("sources")
+        if saved is not None and list(saved) != sorted(self.sources):
+            raise DataLoaderError(
+                f"loader-state mismatch: saved sources {saved} != "
+                f"{sorted(self.sources)} — the saved position indexes a "
+                "different mixture")
+        w0 = state.get("weights")
+        if w0 is not None and {k: float(v) for k, v in w0.items()} != \
+                self._weights0:
+            raise DataLoaderError(
+                f"loader-state mismatch: saved base weights {w0} != "
+                f"{self._weights0} — change recipes via set_weights(), "
+                "which is recorded and resumable")
+        self._reweights = [
+            (int(e), int(i), {k: float(v) for k, v in w.items()})
+            for e, i, w in state.get("reweights") or []]
+        self._sheds = [(int(e), int(i), str(n))
+                       for e, i, n in state.get("sheds") or []]
+        self.quarantined |= set(state.get("quarantined") or [])
+        super().load_state_dict(state)
+
+    # -- quarantine -----------------------------------------------------------
+
+    @staticmethod
+    def _qkey(source: str, shard: str) -> str:
+        return f"{source}/{shard}"
+
+    def _load_quarantine_file(self) -> None:
+        path = os.path.join(self.quarantine_dir, QUARANTINE_FILE)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            self.quarantined |= {
+                self._qkey(r["source"], r["shard"])
+                for r in doc.get("shards", [])}
+        except FileNotFoundError:
+            pass
+        except Exception as e:
+            logger.warning(f"quarantine manifest {path} unreadable "
+                           f"({e!r}); starting from constructor set")
+
+    def _record_quarantine(self, source: str, shard: str,
+                           reason: str) -> None:
+        key = self._qkey(source, shard)
+        if key in self.quarantined:
+            return
+        self.quarantined.add(key)
+        counters.inc("shards_quarantined")
+        logger.warning(f"quarantined shard {key}: {reason}")
+        if not self.quarantine_dir:
+            return
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+        path = os.path.join(self.quarantine_dir, QUARANTINE_FILE)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except Exception:
+            doc = {"version": 1, "shards": []}
+        doc["shards"].append({"source": source, "shard": shard,
+                              "reason": reason,
+                              "epoch": self._walk_epoch})
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+
+    # -- the deterministic walk -----------------------------------------------
+
+    def _seekable(self) -> bool:
+        # manifests give doc counts, so resume seeks arithmetically —
+        # always, regardless of the (unused) parent ``documents`` arg
+        return True
+
+    def _rng(self, epoch: int, source: str,
+             shard: Optional[int] = None) -> np.random.Generator:
+        key = [int(self.shuffle_seed or 0), int(epoch),
+               zlib.crc32(source.encode())]
+        if shard is not None:
+            key.append(int(shard))
+        return np.random.default_rng(key)
+
+    def _epoch_runs(self, epoch: int) -> Dict[str, _Run]:
+        """Fresh per-source walk state at the top of ``epoch``: shard
+        permutation over the FULL manifest (quarantined shards are
+        skipped at the cursor, keeping the permutation domain stable as
+        the quarantine set grows) and the mixture weights with every
+        prior-epoch reweight already applied."""
+        ew = dict(self._weights0)
+        for e, _i, w in self._reweights:
+            if e < epoch:
+                ew.update(w)
+        shed_names = {n for _e, _i, n in self._sheds}
+        runs: Dict[str, _Run] = {}
+        for name in sorted(self.sources):
+            if name in shed_names:
+                continue            # a shed is permanent: don't re-probe
+            try:
+                entries = list(
+                    self._clients[name].manifest_entries().values())
+            except DataLoaderError:
+                # the source is down before its first draw (manifest
+                # unreachable through the retry budget) — shed it here
+                self._record_shed(name)
+                continue
+            if self.shuffle_seed is None:
+                order = np.arange(len(entries))
+            else:
+                order = self._rng(epoch, name).permutation(len(entries))
+            runs[name] = _Run(name, entries, order, float(ew[name]))
+        return runs
+
+    def _skip_quarantined(self, run: _Run) -> None:
+        while run.k < len(run.order):
+            e = run.entry()
+            if (self._qkey(run.name, e["name"]) not in self.quarantined
+                    and int(e["docs"]) > 0):
+                return
+            run.k += 1
+            run.j = 0
+
+    def _available(self, run: _Run) -> bool:
+        self._skip_quarantined(run)
+        return run.k < len(run.order)
+
+    def _resolve(self, run: _Run) -> bool:
+        """Fetch-mode invariant: make ``run``'s current shard resident
+        (documents decoded, in permuted order).  Quarantines past bad
+        shards; returns False when the source is exhausted.  A breaker
+        open-edge (the source itself is down) raises ``_Shed``."""
+        client = self._clients[run.name]
+        while True:
+            if not self._available(run):
+                return False
+            if run.cur_docs is not None:
+                return True
+            e = run.entry()
+            name = e["name"]
+            try:
+                docs = client.get_docs(name)
+            except (ShardCorruptionError, OSError, DataLoaderError) as err:
+                reason = (getattr(err, "reason", None)
+                          or f"fetch failed: {err}")
+                self._record_quarantine(run.name, name, str(reason))
+                if client.record_outcome(False):
+                    raise _Shed(run.name,
+                                client.breaker.failures) from err
+                run.k += 1
+                run.j = 0
+                continue
+            client.record_outcome(True)
+            if len(docs) != int(e["docs"]):
+                self._record_quarantine(
+                    run.name, name,
+                    f"manifest says {e['docs']} docs, shard decodes to "
+                    f"{len(docs)}")
+                run.k += 1
+                run.j = 0
+                continue
+            perm = (np.arange(len(docs)) if self.shuffle_seed is None
+                    else self._rng(self._walk_epoch, run.name,
+                                   int(run.order[run.k]))
+                    .permutation(len(docs)))
+            run.cur_docs = [docs[int(p)] for p in perm]
+            return True
+
+    def _record_shed(self, name: str) -> None:
+        """Permanently drop ``name`` from the mixture: recorded with its
+        ``(epoch, doc_index)`` so resume replays the removal at the same
+        draw, counted, and kept as a typed error for the operator."""
+        self._sheds.append((self._walk_epoch, self._walk_idx, name))
+        counters.inc("data_sources_shed")
+        err = DataSourceError(
+            f"source {name!r} shed at epoch {self._walk_epoch} doc "
+            f"{self._walk_idx}: failure budget exhausted (breaker "
+            "open); continuing on re-normalized surviving sources",
+            source=name,
+            consecutive=self._clients[name].breaker.failures)
+        self.source_errors.append(err)
+        logger.error(str(err))
+
+    def _shed_source(self, live: Dict[str, _Run], name: str) -> None:
+        live.pop(name, None)
+        self._record_shed(name)
+        if not live:
+            raise DataSourceError(
+                f"source {name!r} failed and no live source remains — "
+                "the data plane is down", source=name)
+
+    def _doc_stream(self, epoch: int, start_group: int) -> Iterator[Any]:
+        """The global document stream from document index
+        ``start_group * buffer_docs`` on.  The skip prefix is walked
+        arithmetically (manifest counts only, zero GETs); delivery then
+        proceeds with real fetches under the eager-resolve invariant."""
+        skip = start_group * self.buffer_docs
+        self._walk_epoch, self._walk_idx = epoch, 0
+        runs = self._epoch_runs(epoch)
+        live = {n: r for n, r in runs.items() if self._available(r)}
+        for e, _i, n in self._sheds:
+            if e < epoch:               # a shed is permanent: excluded
+                live.pop(n, None)       # from every later epoch's start
+        if not live:
+            if self._sheds:
+                raise DataSourceError(
+                    "every data source shed — the data plane is down",
+                    source=self._sheds[-1][2])
+            logger.warning("streaming dataset has no deliverable "
+                           "documents (all shards empty or quarantined)")
+            return
+        # pointers over the LIVE lists (set_weights / a breaker shed
+        # append mid-iteration; prior-epoch entries were applied at
+        # epoch start, future-epoch entries cannot exist yet)
+        rw_p = sum(1 for x in self._reweights if x[0] < epoch)
+        sh_p = sum(1 for x in self._sheds if x[0] < epoch)
+
+        def draw() -> _Run:
+            nonlocal rw_p, sh_p
+            # recorded events fire before the draw at their doc index
+            while (sh_p < len(self._sheds)
+                   and self._sheds[sh_p][1] <= self._walk_idx):
+                live.pop(self._sheds[sh_p][2], None)
+                sh_p += 1
+                if not live:
+                    raise DataSourceError(
+                        "every data source shed — the data plane is down")
+            while (rw_p < len(self._reweights)
+                   and self._reweights[rw_p][1] <= self._walk_idx):
+                for n, w in self._reweights[rw_p][2].items():
+                    if n in runs:       # a shed source may still be named
+                        runs[n].ew = float(w)
+                rw_p += 1
+            total = sum(r.ew for r in live.values())
+            if not total > 0:
+                raise DataLoaderError(
+                    "all live source weights are 0 — nothing to draw")
+            pick: Optional[_Run] = None
+            for n in sorted(live):
+                r = live[n]
+                r.cw += r.ew
+                if pick is None or r.cw > pick.cw:
+                    pick = r
+            pick.cw -= total
+            return pick
+
+        # -- arithmetic fast-forward (resume seek): no fetches --------------
+        # one draw = one document, advanced by manifest counts alone;
+        # O(delivered docs) integer work, zero shard GETs
+        while skip > 0:
+            r = draw()
+            r.j += 1
+            self._walk_idx += 1
+            skip -= 1
+            if r.j >= int(r.entry()["docs"]):
+                r.k += 1
+                r.j = 0
+            if not self._available(r):
+                live.pop(r.name, None)
+                if not live:
+                    return
+
+        # -- delivery: restore the eager-resolve invariant ------------------
+        for name in sorted(live):
+            r = live[name]
+            try:
+                if not self._resolve(r):
+                    live.pop(name, None)
+            except _Shed as s:
+                self._shed_source(live, s.source)
+        if not live:
+            return
+
+        while live:
+            r = draw()
+            doc = r.cur_docs[r.j]
+            r.j += 1
+            self._walk_idx += 1
+            if r.j >= len(r.cur_docs):
+                r.k += 1
+                r.j = 0
+                r.cur_docs = None
+            # eager resolve: quarantine/shed verdicts land HERE, at the
+            # cursor crossing, so the interleave below never observes a
+            # bad shard (bitwise-equal to pre-excluded)
+            try:
+                if r.cur_docs is None and not self._resolve(r):
+                    live.pop(r.name, None)
+            except _Shed as s:
+                self._shed_source(live, s.source)
+            yield doc
+
+
+class _Shed(Exception):
+    """Internal: a source's breaker opened during shard resolution."""
+
+    def __init__(self, source: str, consecutive: int = 0):
+        super().__init__(source)
+        self.source = source
+        self.consecutive = consecutive

@@ -95,6 +95,39 @@ class BadBatchError(DataLoaderError):
         self.consecutive = consecutive
 
 
+class ShardCorruptionError(DataLoaderError):
+    """A shard fetched from the object store failed integrity
+    validation (checksum mismatch against the manifest — a torn/short
+    read or bit-rot — or an undecodable payload).  Transient forms are
+    retried; a shard that stays corrupt across the retry budget is
+    quarantined and skipped.  Carries the source/shard names and the
+    reason so the quarantine manifest names the evidence."""
+
+    def __init__(self, message: str, *, source: Optional[str] = None,
+                 shard: Optional[str] = None,
+                 reason: Optional[str] = None):
+        super().__init__(message)
+        self.source = source
+        self.shard = shard
+        self.reason = reason
+
+
+class DataSourceError(DataLoaderError):
+    """A streaming data source exhausted its failure budget (its
+    per-source circuit breaker opened): every recent shard fetch
+    failed or came back corrupt — the *source* is down, not one shard.
+    When other sources survive, the stream sheds this one (re-normalized
+    mixture weights) and this error is recorded, not raised; it
+    propagates only when no source remains.  Carries the source name
+    and the consecutive-failure count."""
+
+    def __init__(self, message: str, *, source: Optional[str] = None,
+                 consecutive: int = 0):
+        super().__init__(message)
+        self.source = source
+        self.consecutive = consecutive
+
+
 class CoordinationError(TorchAccTPUError):
     """A cross-host coordination primitive failed or timed out.
 

@@ -62,6 +62,23 @@ from torchacc_tpu.utils.logger import logger
 
 _PROM_PREFIX = "torchacc_"
 
+#: data-plane health counters, surfaced per-host under the
+#: ``torchacc_data_`` prefix (and as the ``data_plane`` block of
+#: ``/fleet``) so an operator sees WHICH host's input pipeline is
+#: quarantining shards or grinding through store retries — the fleet-
+#: summed ``torchacc_fleet_*_total`` series alone can't localise that
+DATA_PLANE_COUNTERS = (
+    "bad_batches_skipped",
+    "shards_quarantined",
+    "shard_fetch_retries",
+    "store_gets",
+    "data_sources_shed",
+    "loader_retries",
+    "loader_fallbacks",
+    "loader_stalls_deferred",
+    "resume_replayed_batches",
+)
+
 #: the histogram the drift detector baselines on
 _STEP_HIST = "step_time_ms"
 
@@ -487,6 +504,17 @@ class FleetAggregator:
         with self._lock:
             return self._aggregate_locked()[0]
 
+    def _host_counters_locked(self, host: int) -> Dict[str, float]:
+        """One host's counter totals: folded base from previous
+        incarnations + the current scrape (monotonic across restarts,
+        same discipline as the fleet sums)."""
+        out = dict(self._base_counters.get(host, {}))
+        st = self._cur.get(host)
+        if st is not None:
+            for k, v in st.counters.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
     def prometheus_text(self) -> str:
         """The aggregated block for the daemon's ``/metrics`` (register
         via ``obs.server.register_text``).  Everything lands under the
@@ -518,6 +546,22 @@ class FleetAggregator:
             m = f"torchacc_fleet_{name}_total"
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m} {counters[name]!r}")
+        # per-host data-plane health (base + current, so restarts never
+        # reset the series): the fleet sum says the pod quarantined 9
+        # shards; these say host 3 quarantined all of them
+        with self._lock:
+            known = sorted(set(self._cur) | set(self._base_counters))
+            per_host = {
+                h: self._host_counters_locked(h) for h in known}
+        for name in DATA_PLANE_COUNTERS:
+            if not any(name in c for c in per_host.values()):
+                continue
+            m = f"torchacc_data_{name}"
+            lines.append(f"# TYPE {m} counter")
+            for h in sorted(per_host):
+                if name in per_host[h]:
+                    lines.append(
+                        f'{m}{{host="{h}"}} {per_host[h][name]!r}')
         # merged histograms
         for name in sorted(hists):
             lines.extend(hists[name].prometheus_lines(
@@ -536,6 +580,8 @@ class FleetAggregator:
             hosts = dict(self._cur)
             known = sorted(set(self._cur) | set(self._base_counters)
                            | set(self._base_hists))
+            per_host_counters = {
+                h: self._host_counters_locked(h) for h in known}
             now = time.monotonic()
             out_hosts: Dict[str, Any] = {}
             for h in known:
@@ -580,6 +626,18 @@ class FleetAggregator:
             "counters": counters,
             "histograms": {n: h.snapshot() for n, h in hists.items()},
             "goodput_workers": summary_from_counters(counters),
+            # data-plane health rollup: fleet totals + the per-host
+            # split for the counters that localise input-pipeline decay
+            "data_plane": {
+                "totals": {n: counters[n] for n in DATA_PLANE_COUNTERS
+                           if n in counters},
+                "per_host": {
+                    str(h): {n: v for n, v in per_host_counters[h].items()
+                             if n in DATA_PLANE_COUNTERS}
+                    for h in per_host_counters
+                    if any(n in DATA_PLANE_COUNTERS
+                           for n in per_host_counters[h])},
+            },
         }
         if self.drift is not None:
             status, reason = self.drift.health()

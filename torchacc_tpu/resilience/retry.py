@@ -1,102 +1,20 @@
-"""Jittered-exponential-backoff retry with a wall-clock deadline.
+"""Back-compat shim: the retry core moved to ``utils/retry.py``.
 
-Flaky storage (GCS 429/503s, NFS hiccups) and transient loader failures
-must not kill a multi-host run; MaxText/Orbax production loops wrap every
-checkpoint I/O in exactly this shape of retry.  The policy is a frozen
-dataclass so call sites can share one instance, and the sleep/rng seams
-are injectable so tests run in microseconds and deterministically.
-
-Retries are observable: every retried attempt increments a monotonic
-counter (utils/metrics.py) and logs at WARNING, so degradation shows up
-in the step log line and metrics.jsonl, not only in a post-mortem.
+The jittered-exponential-backoff loop used to live here while the HTTP
+client (``utils/http.py``) and the serve router's circuit breaker
+carried their own copies of the same semantics.  The one shared home is
+now :mod:`torchacc_tpu.utils.retry` — policy, loop, and breaker together
+(one home, one test).  Every existing ``resilience.retry`` import keeps
+working through this re-export; new code should import from
+``torchacc_tpu.utils.retry``.
 """
 
 from __future__ import annotations
 
-import random
-import time
-from dataclasses import dataclass
-from typing import Any, Callable, Optional, Tuple, Type
+from torchacc_tpu.utils.retry import (  # noqa: F401
+    CircuitBreaker,
+    RetryPolicy,
+    retry_call,
+)
 
-from torchacc_tpu.utils.logger import logger
-
-
-@dataclass(frozen=True)
-class RetryPolicy:
-    """How to retry a transient failure.
-
-    ``max_retries`` counts *re*-tries: the call is attempted at most
-    ``max_retries + 1`` times.  Delay before retry ``k`` (0-based) is
-    ``min(base_delay_s * 2**k, max_delay_s)`` scaled by a uniform jitter
-    in ``[1 - jitter, 1 + jitter]``.  ``deadline_s`` bounds the *total*
-    wall-clock spent (attempts + sleeps): once exceeded, no further
-    attempt is made and the last error is re-raised.
-    """
-
-    max_retries: int = 3
-    base_delay_s: float = 0.5
-    max_delay_s: float = 8.0
-    deadline_s: Optional[float] = None
-    jitter: float = 0.5
-    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
-    # exceptions that are final even when retry_on matches them (e.g. a
-    # typed error raised by the retried callable to mean "do not retry")
-    no_retry: Tuple[Type[BaseException], ...] = ()
-
-    def validate(self) -> None:
-        if self.max_retries < 0:
-            raise ValueError("retry: max_retries must be >= 0")
-        if self.base_delay_s < 0 or self.max_delay_s < self.base_delay_s:
-            raise ValueError("retry: need 0 <= base_delay_s <= max_delay_s")
-        if not 0.0 <= self.jitter <= 1.0:
-            raise ValueError("retry: jitter must be in [0, 1]")
-        if self.deadline_s is not None and self.deadline_s <= 0:
-            raise ValueError("retry: deadline_s must be positive")
-
-    def delay(self, attempt: int, rng: random.Random) -> float:
-        base = min(self.base_delay_s * (2.0 ** attempt), self.max_delay_s)
-        return base * (1.0 - self.jitter + 2.0 * self.jitter * rng.random())
-
-
-def retry_call(
-    fn: Callable[..., Any],
-    *args: Any,
-    policy: RetryPolicy = RetryPolicy(),
-    description: str = "",
-    counter: Optional[str] = None,
-    rng: Optional[random.Random] = None,
-    sleep: Callable[[float], None] = time.sleep,
-    clock: Callable[[], float] = time.monotonic,
-    **kwargs: Any,
-) -> Any:
-    """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
-
-    ``counter`` names a utils/metrics monotonic counter incremented once
-    per *retried* attempt.  The last exception is re-raised unchanged
-    (with prior attempts visible via ``__context__``) so callers keep
-    their own typed wrapping.
-    """
-    rng = rng if rng is not None else random.Random()
-    what = description or getattr(fn, "__name__", "call")
-    start = clock()
-    for attempt in range(policy.max_retries + 1):
-        try:
-            return fn(*args, **kwargs)
-        except policy.retry_on as e:
-            if isinstance(e, policy.no_retry) or attempt >= policy.max_retries:
-                raise
-            delay = policy.delay(attempt, rng)
-            if (policy.deadline_s is not None
-                    and clock() - start + delay > policy.deadline_s):
-                logger.warning(
-                    f"{what}: attempt {attempt + 1} failed ({e!r}) and the "
-                    f"{policy.deadline_s:.1f}s retry deadline is exhausted")
-                raise
-            if counter is not None:
-                from torchacc_tpu.utils.metrics import counters
-                counters.inc(counter)
-            logger.warning(
-                f"{what}: attempt {attempt + 1}/{policy.max_retries + 1} "
-                f"failed ({e!r}); retrying in {delay:.2f}s")
-            sleep(delay)
-    raise AssertionError("unreachable")  # pragma: no cover
+__all__ = ["RetryPolicy", "retry_call", "CircuitBreaker"]

@@ -82,66 +82,9 @@ def chain_keys(prompt_ids, block_size: int) -> List[bytes]:
     return out
 
 
-class CircuitBreaker:
-    """Per-worker admission breaker: ``closed`` (routable) → ``open``
-    after ``failure_threshold`` consecutive probe failures → ``half_open``
-    once ``cooldown_s`` has elapsed (exactly one probe allowed) → back to
-    ``closed`` on probe success or ``open`` on probe failure.  The clock
-    is injectable so the state machine unit-tests run on a fake clock."""
-
-    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
-
-    def __init__(self, *, failure_threshold: int = 3,
-                 cooldown_s: float = 5.0,
-                 clock: Callable[[], float] = time.monotonic):
-        if failure_threshold < 1:
-            raise ValueError("failure_threshold must be >= 1")
-        self.failure_threshold = int(failure_threshold)
-        self.cooldown_s = float(cooldown_s)
-        self._clock = clock
-        self.state = self.CLOSED
-        self.failures = 0          # consecutive
-        self.opened_at = 0.0
-        self.opens = 0             # transitions into OPEN (flap count)
-
-    @property
-    def routable(self) -> bool:
-        """Only a closed breaker admits traffic — half-open carries the
-        probe, not requests."""
-        return self.state == self.CLOSED
-
-    def should_probe(self) -> bool:
-        """Health-loop gate: closed and half-open workers probe every
-        tick; an open one only after the cooldown (that attempt IS the
-        half-open transition)."""
-        if self.state != self.OPEN:
-            return True
-        if self._clock() - self.opened_at >= self.cooldown_s:
-            self.state = self.HALF_OPEN
-            return True
-        return False
-
-    def record_success(self) -> bool:
-        """Returns True when this success CLOSED a non-closed breaker
-        (the readmission edge, so the caller can count/log it)."""
-        readmitted = self.state != self.CLOSED
-        self.state = self.CLOSED
-        self.failures = 0
-        return readmitted
-
-    def record_failure(self) -> bool:
-        """Returns True when this failure OPENED the breaker (the
-        caller triggers failover exactly once per open edge)."""
-        self.failures += 1
-        if (self.state == self.HALF_OPEN
-                or self.failures >= self.failure_threshold):
-            opened = self.state != self.OPEN
-            if opened:
-                self.opens += 1
-            self.state = self.OPEN
-            self.opened_at = self._clock()
-            return opened
-        return False
+# The per-worker admission breaker moved to the shared retry core
+# (one home, one test); re-exported so router users keep their import.
+from torchacc_tpu.utils.retry import CircuitBreaker  # noqa: F401,E402
 
 
 @dataclass

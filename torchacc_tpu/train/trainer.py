@@ -1569,6 +1569,14 @@ class Trainer:
         # published for the telemetry session's heartbeat gauge/health
         # provider (obs/runtime.py); cleared in the finally below
         self._watchdog = wd
+        # a loader whose source retries store fetches (AsyncLoader over
+        # a StreamingDataset) beats the watchdog before every backoff
+        # sleep, so a slow-but-retrying source reads as data_wait — the
+        # SLO bucket — never as a dead "data_fetch" section
+        if wd is not None:
+            set_hb = getattr(loader, "set_stall_heartbeat", None)
+            if callable(set_hb):
+                set_hb(wd.beat)
         history = []
         t0 = _time.perf_counter()
         t_prev, s_prev = t0, start_step

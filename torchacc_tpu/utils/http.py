@@ -16,6 +16,12 @@ once:
 - ``sleep``/``rng`` are injectable so backoff schedules are testable
   without wall time.
 
+The backoff schedule and the retry loop are NOT implemented here — they
+are the shared core in ``utils/retry.py`` (:class:`RetryPolicy` /
+:func:`retry_call`), the same one the checkpoint I/O, the loader, and
+the object-store shard fetch path use.  This module only binds it to
+urllib transport semantics.
+
 Stdlib-only (urllib), no jax anywhere: every consumer runs on hosts
 that never initialise a device backend.
 """
@@ -28,6 +34,8 @@ import time
 import urllib.error
 import urllib.request
 from typing import Callable, Dict, Optional, Tuple
+
+from torchacc_tpu.utils.retry import RetryPolicy, retry_call
 
 
 def request(url: str, *, method: str = "GET",
@@ -65,18 +73,35 @@ class HttpClient:
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
         self.retries = int(retries)
-        self.backoff_s = float(backoff_s)
-        self.backoff_multiplier = float(backoff_multiplier)
-        self.max_backoff_s = float(max_backoff_s)
-        self.jitter = float(jitter)
+        # the shared backoff core (utils/retry.py) owns the schedule —
+        # transport errors only; any HTTP status is a final answer and
+        # never reaches the retry loop
+        self._policy = RetryPolicy(
+            max_retries=int(retries), base_delay_s=float(backoff_s),
+            max_delay_s=float(max_backoff_s), jitter=float(jitter),
+            multiplier=float(backoff_multiplier),
+            retry_on=(urllib.error.URLError, OSError, TimeoutError))
         self._rng = rng if rng is not None else random.Random()
         self._sleep = sleep
 
+    # legacy knob views (callers pace their own loops off these)
+    @property
+    def backoff_s(self) -> float:
+        return self._policy.base_delay_s
+
+    @property
+    def max_backoff_s(self) -> float:
+        return self._policy.max_delay_s
+
+    @property
+    def jitter(self) -> float:
+        return self._policy.jitter
+
     def delay(self, attempt: int) -> float:
-        base = min(self.backoff_s * (self.backoff_multiplier ** attempt),
-                   self.max_backoff_s)
-        return max(base * (1.0 + self.jitter
-                           * (2.0 * self._rng.random() - 1.0)), 0.0)
+        """The backoff schedule (exponential from ``backoff_s`` capped
+        at ``max_backoff_s``, ±``jitter`` fraction) for callers that
+        pace their own loops."""
+        return max(self._policy.delay(attempt, self._rng), 0.0)
 
     def request(self, path: str, *, method: str = "GET",
                 data: Optional[bytes] = None,
@@ -84,17 +109,11 @@ class HttpClient:
                 ) -> Tuple[int, str]:
         """``(status_code, body)`` with bounded retries; raises the
         last transport error when every attempt failed."""
-        last: Optional[BaseException] = None
-        for attempt in range(self.retries + 1):
-            try:
-                return request(self.base_url + path, method=method,
-                               data=data, headers=headers,
-                               timeout_s=self.timeout_s)
-            except (urllib.error.URLError, OSError, TimeoutError) as e:
-                last = e
-                if attempt < self.retries:
-                    self._sleep(self.delay(attempt))
-        raise last if last is not None else OSError("unreachable")
+        return retry_call(
+            request, self.base_url + path, method=method, data=data,
+            headers=headers, timeout_s=self.timeout_s,
+            policy=self._policy, description=f"http {method} {path}",
+            rng=self._rng, sleep=self._sleep)
 
     # -- JSON conveniences ----------------------------------------------------
 

@@ -1,0 +1,204 @@
+"""The ONE retry/backoff/jitter + circuit-breaker core.
+
+Four near-duplicate backoff loops used to live across the tree — the
+checkpoint I/O retries (``resilience/retry.py``), the stdlib HTTP
+client's transport retries (``utils/http.py``), the serve router's
+per-worker admission breaker (``serve/router.py``), and the object-store
+shard fetch path (``data/store.py``).  They all share the same contract,
+so the contract lives here once:
+
+- :class:`RetryPolicy` — jittered exponential backoff with a wall-clock
+  deadline, a frozen dataclass so call sites share one instance;
+- :func:`retry_call` — the retry loop itself, with injectable
+  ``sleep``/``rng``/``clock`` seams (tests run in microseconds), a
+  metrics counter per retried attempt, and an ``on_retry`` hook so
+  callers can surface "slow but alive" (the loader's in-retry flag that
+  keeps a retrying fetch from tripping the hang watchdog);
+- :class:`CircuitBreaker` — closed → open after N consecutive failures
+  → half-open probe after a cooldown, the state machine the router uses
+  per worker and the streaming data plane uses per source.
+
+``resilience/retry.py`` and ``serve/router.py`` re-export their old
+names, so existing imports keep working; new code should import from
+here.  Stdlib-only, no jax anywhere — consumers include hosts that
+never initialise a device backend.
+
+Flaky storage (GCS 429/503s, NFS hiccups) and transient loader failures
+must not kill a multi-host run; MaxText/Orbax production loops wrap every
+checkpoint I/O in exactly this shape of retry.  Retries are observable:
+every retried attempt increments a monotonic counter (utils/metrics.py)
+and logs at WARNING, so degradation shows up in the step log line and
+metrics.jsonl, not only in a post-mortem.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Tuple, Type
+
+from torchacc_tpu.utils.logger import logger
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """How to retry a transient failure.
+
+    ``max_retries`` counts *re*-tries: the call is attempted at most
+    ``max_retries + 1`` times.  Delay before retry ``k`` (0-based) is
+    ``min(base_delay_s * multiplier**k, max_delay_s)`` scaled by a
+    uniform jitter in ``[1 - jitter, 1 + jitter]``.  ``deadline_s``
+    bounds the *total* wall-clock spent (attempts + sleeps): once
+    exceeded, no further attempt is made and the last error is
+    re-raised.
+    """
+
+    max_retries: int = 3
+    base_delay_s: float = 0.5
+    max_delay_s: float = 8.0
+    deadline_s: Optional[float] = None
+    jitter: float = 0.5
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+    # exceptions that are final even when retry_on matches them (e.g. a
+    # typed error raised by the retried callable to mean "do not retry")
+    no_retry: Tuple[Type[BaseException], ...] = ()
+    multiplier: float = 2.0
+
+    def validate(self) -> None:
+        if self.max_retries < 0:
+            raise ValueError("retry: max_retries must be >= 0")
+        if self.base_delay_s < 0 or self.max_delay_s < self.base_delay_s:
+            raise ValueError("retry: need 0 <= base_delay_s <= max_delay_s")
+        if not 0.0 <= self.jitter <= 1.0:
+            raise ValueError("retry: jitter must be in [0, 1]")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("retry: deadline_s must be positive")
+        if self.multiplier < 1.0:
+            raise ValueError("retry: multiplier must be >= 1")
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.base_delay_s * (self.multiplier ** attempt),
+                   self.max_delay_s)
+        return base * (1.0 - self.jitter + 2.0 * self.jitter * rng.random())
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    policy: RetryPolicy = RetryPolicy(),
+    description: str = "",
+    counter: Optional[str] = None,
+    rng: Optional[random.Random] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+    ``counter`` names a utils/metrics monotonic counter incremented once
+    per *retried* attempt.  ``on_retry(attempt, exc, delay_s)`` fires
+    just before each backoff sleep — the seam callers use to surface
+    "retrying, not hung" to watchdogs/heartbeats.  The last exception is
+    re-raised unchanged (with prior attempts visible via
+    ``__context__``) so callers keep their own typed wrapping.
+    """
+    rng = rng if rng is not None else random.Random()
+    what = description or getattr(fn, "__name__", "call")
+    start = clock()
+    for attempt in range(policy.max_retries + 1):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            if isinstance(e, policy.no_retry) or attempt >= policy.max_retries:
+                raise
+            delay = policy.delay(attempt, rng)
+            # a throttling backend (HTTP 429) may name its own pace;
+            # honour it when it is longer than the schedule's
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                delay = max(delay, float(retry_after))
+            if (policy.deadline_s is not None
+                    and clock() - start + delay > policy.deadline_s):
+                logger.warning(
+                    f"{what}: attempt {attempt + 1} failed ({e!r}) and the "
+                    f"{policy.deadline_s:.1f}s retry deadline is exhausted")
+                raise
+            if counter is not None:
+                from torchacc_tpu.utils.metrics import counters
+                counters.inc(counter)
+            logger.warning(
+                f"{what}: attempt {attempt + 1}/{policy.max_retries + 1} "
+                f"failed ({e!r}); retrying in {delay:.2f}s")
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+class CircuitBreaker:
+    """Per-dependency admission breaker: ``closed`` (routable) → ``open``
+    after ``failure_threshold`` consecutive failures → ``half_open``
+    once ``cooldown_s`` has elapsed (exactly one probe allowed) → back to
+    ``closed`` on probe success or ``open`` on probe failure.  The clock
+    is injectable so the state machine unit-tests run on a fake clock.
+
+    Two instantiations: the serve router holds one per worker (probe
+    failures open it, failover fires on the open edge), and the
+    streaming data plane holds one per source (quarantined shards open
+    it, the source sheds to survivors on the open edge)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 cooldown_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self.state = self.CLOSED
+        self.failures = 0          # consecutive
+        self.opened_at = 0.0
+        self.opens = 0             # transitions into OPEN (flap count)
+
+    @property
+    def routable(self) -> bool:
+        """Only a closed breaker admits traffic — half-open carries the
+        probe, not requests."""
+        return self.state == self.CLOSED
+
+    def should_probe(self) -> bool:
+        """Health-loop gate: closed and half-open dependencies probe
+        every tick; an open one only after the cooldown (that attempt IS
+        the half-open transition)."""
+        if self.state != self.OPEN:
+            return True
+        if self._clock() - self.opened_at >= self.cooldown_s:
+            self.state = self.HALF_OPEN
+            return True
+        return False
+
+    def record_success(self) -> bool:
+        """Returns True when this success CLOSED a non-closed breaker
+        (the readmission edge, so the caller can count/log it)."""
+        readmitted = self.state != self.CLOSED
+        self.state = self.CLOSED
+        self.failures = 0
+        return readmitted
+
+    def record_failure(self) -> bool:
+        """Returns True when this failure OPENED the breaker (the
+        caller triggers failover/shed exactly once per open edge)."""
+        self.failures += 1
+        if (self.state == self.HALF_OPEN
+                or self.failures >= self.failure_threshold):
+            opened = self.state != self.OPEN
+            if opened:
+                self.opens += 1
+            self.state = self.OPEN
+            self.opened_at = self._clock()
+            return opened
+        return False
