@@ -318,6 +318,75 @@ def test_sole_dead_source_raises_typed(tmp_path):
         _take(ds)
 
 
+def test_mid_epoch_shed_resume_bitwise(tmp_path):
+    """A source that sheds AFTER delivering documents (doc_index > 0)
+    must stay in the replayed walk until its recorded index: a
+    checkpoint taken after the shed resumes bitwise, including when the
+    source's store is completely unreachable on resume (the manifest
+    doc counts ride state_dict, so the replay is pure arithmetic)."""
+    import zlib
+    from bisect import bisect_right
+    roots = _mk_roots(tmp_path)
+    # code = 80 docs / 5 shards; keep only the first shard of the
+    # epoch-0 permutation healthy so the breaker opens mid-epoch, after
+    # that shard's documents were interleaved into the stream
+    order = np.random.default_rng(
+        [CHAOS_SEED, 0, zlib.crc32(b"code")]).permutation(5)
+    bad = [f"code-{i:05d}.tash" for i in range(5) if i != int(order[0])]
+    chaos = {"code": {"corrupt_shards": bad}, "web": {}}
+
+    ds = _ds(roots, chaos=chaos)
+    got = _take(ds)
+    assert len(ds._sheds) == 1
+    shed_epoch, shed_idx, shed_name = ds._sheds[0]
+    assert (shed_epoch, shed_name) == (0, "code")
+    assert shed_idx > 0, "test needs a MID-epoch shed"
+
+    ds1 = _ds(roots, chaos=chaos)
+    it1 = iter(ds1)
+    head = _take(it1, n=6)
+    assert ds1._sheds, "shed must fall inside the taken prefix"
+    state = json.loads(json.dumps(ds1.state_dict()))
+    tail = _take(it1)
+    _assert_batches_equal(head + tail, got)
+    assert set(state["manifest_docs"]) == {"code", "web"}
+    # the scenario under test: the checkpoint position is past the shed
+    r0 = state["batches_consumed"] * ROWS
+    start_group = bisect_right(state["group_cum_rows"], r0)
+    assert start_group * state["buffer_docs"] >= shed_idx
+
+    ds2 = _ds(roots, chaos=chaos)
+    ds2.load_state_dict(state)
+    _assert_batches_equal(_take(ds2), tail)
+    assert ds2._sheds == [(shed_epoch, shed_idx, shed_name)]  # replayed,
+    # not re-recorded — and the pre-shed interleave was reproduced
+
+    # same resume with the shed source now fully dead: zero GETs are
+    # needed for it (saved doc counts), the tail is still bitwise
+    counters.reset()
+    ds3 = _ds(roots, chaos={"code": {"dead": True}, "web": {}})
+    ds3.load_state_dict(state)
+    _assert_batches_equal(_take(ds3), tail)
+    assert ds3._sheds == [(shed_epoch, shed_idx, shed_name)]
+    assert counters.get("data_sources_shed") == 0
+
+
+def test_config_error_propagates_not_quarantined(tmp_path):
+    """A text-shard source without a tokenizer is a configuration bug:
+    it must raise, not be laundered into shard quarantine + shed."""
+    root = str(tmp_path / "txt")
+    write_store(root, ["hello world"] * 24, source="txt", shard_docs=8,
+                kind="text")
+    ds = StreamingDataset(
+        [StreamingSource("txt", LocalShardStore(root))], SEQ, ROWS,
+        buffer_docs=8, shuffle_seed=CHAOS_SEED, retry_policy=_FAST_RETRY)
+    with pytest.raises(DataLoaderError):
+        _take(ds)
+    assert counters.get("shards_quarantined") == 0
+    assert counters.get("data_sources_shed") == 0
+    assert not ds.source_errors
+
+
 # -- the starvation SLO: slow-but-retrying is data_wait, not a hang ----------
 
 def test_stall_deadline_defers_while_source_retrying(tmp_path, devices):
@@ -343,6 +412,31 @@ def test_stall_deadline_defers_while_source_retrying(tmp_path, devices):
         np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
     assert counters.get("loader_stalls_deferred") >= 1
     assert counters.get("watchdog_stalls") == 0
+
+
+def test_stall_deferral_is_bounded(devices):
+    """in_retry defers the hang verdict but cannot postpone it forever:
+    a source claiming to retry while never producing a batch trips the
+    watchdog once the total wait passes the deferral cap."""
+    import queue as _queue
+
+    from torchacc_tpu.errors import HangError
+
+    class _Stuck:
+        in_retry = True
+
+        def __iter__(self):
+            return iter(())
+
+    cfg = ta.Config(
+        dist=ta.DistConfig(dp=ta.DPConfig(size=8)),
+        resilience=ta.ResilienceConfig(loader_deadline_s=0.02,
+                                       abort_on_hang=True))
+    al = AsyncLoader(_Stuck(), cfg)
+    with pytest.raises(HangError):
+        al._get_with_stall_deadline(_queue.Queue())
+    assert counters.get("loader_stalls_deferred") >= 2
+    assert counters.get("watchdog_stalls") == 1
 
 
 # -- kill -9 mid-stream + restart (the acceptance scenario) -------------------

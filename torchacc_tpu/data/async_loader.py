@@ -61,6 +61,12 @@ from torchacc_tpu.utils.logger import logger
 _SENTINEL = object()
 _EXHAUSTED = object()
 
+#: a retrying source defers the consumer's hang verdict, but not
+#: forever: once the TOTAL wait for one batch exceeds this many
+#: deadlines, the watchdog trips even mid-backoff — a source flapping
+#: through endless short retries is starvation, not progress
+_STALL_DEFER_CAP = 64
+
 
 class _Degrade:
     """Producer -> consumer handoff: async loading gave up; the consumer
@@ -522,39 +528,52 @@ class AsyncLoader:
         wait (stack dump + ``watchdog_stalls``; ``HangError`` when
         ``resilience.abort_on_hang``) — otherwise it logs and keeps
         waiting, so an eventually-recovering source only costs the
-        diagnostics."""
+        diagnostics.  A source inside a retry backoff (``in_retry``)
+        defers the verdict — that wait is the ``data_wait`` SLO, not a
+        hang — but only up to ``_STALL_DEFER_CAP`` deadlines of total
+        wait: past that, retrying-forever counts as stuck."""
         deadline = self._stall_deadline
         if not deadline:
             return q.get()
-        start = time.monotonic()
+        begin = start = time.monotonic()
         quantum = min(max(deadline / 4.0, 0.01), 0.5)
         tripped = False
-        deferred = False
+        deferrals = 0
+        # in_retry is sampled every quantum, not just at expiry: between
+        # two backoff sleeps the flag is briefly false, and a single
+        # unlucky sample must not convert a retrying source into a hang
+        last_retry = float("-inf")
         while True:
             try:
                 return q.get(timeout=quantum)
             except queue.Empty:
-                waited = time.monotonic() - start
+                now = time.monotonic()
+                if self.in_retry:
+                    last_retry = now
+                waited = now - start
                 if waited >= deadline and not tripped:
-                    if self.in_retry:
+                    total = now - begin
+                    if (now - last_retry < deadline
+                            and total < deadline * _STALL_DEFER_CAP):
                         # the producer is SLOW, not STUCK: a fetch is
                         # inside a retry backoff (store 429s, transient
                         # errors).  That wait belongs to the data_wait
                         # SLO, not the hang verdict — defer the deadline
-                        # until the retrying clears
-                        if not deferred:
-                            deferred = True
-                            from torchacc_tpu.utils.metrics import counters
-                            counters.inc("loader_stalls_deferred")
-                            logger.warning(
-                                f"loader stall deadline ({deadline:.1f}s)"
-                                " reached while the source is retrying —"
-                                " deferring the hang verdict (this wait "
-                                "is data_wait, not a hang)")
+                        # until the retrying clears (bounded above)
+                        deferrals += 1
+                        from torchacc_tpu.utils.metrics import counters
+                        counters.inc("loader_stalls_deferred")
+                        logger.warning(
+                            f"loader stall deadline ({deadline:.1f}s) "
+                            "reached while the source is retrying — "
+                            f"deferring the hang verdict (deferral "
+                            f"{deferrals}, {total:.1f}s waited; trips "
+                            f"anyway at {deadline * _STALL_DEFER_CAP:.1f}"
+                            "s)")
                         start = time.monotonic()
                         continue
                     from torchacc_tpu.resilience.watchdog import trip_stall
-                    trip_stall("loader.fetch", waited, deadline,
+                    trip_stall("loader.fetch", total, deadline,
                                dump_dir=self._stall_dump_dir,
                                abort=self._abort_on_hang)
                     tripped = True

@@ -35,7 +35,11 @@ SAME code path local Sequence training uses.  What this layer adds:
   renormalize implicitly, ``data_sources_shed``), a typed
   :class:`~torchacc_tpu.errors.DataSourceError` is recorded — and
   raised only when no source remains.  Sheds are recorded with their
-  ``(epoch, doc_index)`` so a post-shed checkpoint resumes bitwise.
+  ``(epoch, doc_index)`` so a post-shed checkpoint resumes bitwise: a
+  source shed mid-epoch stays in the replayed walk until its recorded
+  index (excluding it outright would change the interleave of every
+  earlier document), and its manifest doc counts ride ``state_dict()``
+  so the replay needs no GET against the — possibly still dead — store.
 - **Resume without refetching.**  ``load_state_dict`` seeks by
   replaying the interleave ARITHMETICALLY — manifest document counts
   only, no shard GETs — up to the saved position, then fetches just
@@ -55,6 +59,11 @@ import json
 import os
 import time
 import zlib
+
+try:
+    import fcntl
+except ImportError:  # pragma: no cover - non-POSIX
+    fcntl = None  # type: ignore[assignment]
 from typing import (Any, Callable, Dict, Iterable, Iterator, List, Optional,
                     Sequence, Tuple)
 
@@ -159,6 +168,11 @@ class StreamingDataset(PackedDataset):
         self._weights0 = {s.name: s.weight for s in sources}
         self._reweights: List[Tuple[int, int, Dict[str, float]]] = []
         self._sheds: List[Tuple[int, int, str]] = []
+        # per-source manifest doc counts in manifest order, refreshed
+        # each epoch and persisted in state_dict(): resume replays a
+        # source shed mid-epoch from these counts alone, even when its
+        # manifest is no longer reachable
+        self._manifest_docs: Dict[str, List[Tuple[str, int]]] = {}
         self.quarantined = set(quarantined)
         self.quarantine_dir = quarantine_dir
         self.source_errors: List[DataSourceError] = []
@@ -230,6 +244,8 @@ class StreamingDataset(PackedDataset):
             "reweights": [[e, i, dict(w)] for e, i, w in self._reweights],
             "sheds": [[e, i, n] for e, i, n in self._sheds],
             "quarantined": sorted(self.quarantined),
+            "manifest_docs": {n: [[s, d] for s, d in v]
+                              for n, v in self._manifest_docs.items()},
         })
         return d
 
@@ -257,6 +273,9 @@ class StreamingDataset(PackedDataset):
         self._sheds = [(int(e), int(i), str(n))
                        for e, i, n in state.get("sheds") or []]
         self.quarantined |= set(state.get("quarantined") or [])
+        self._manifest_docs = {
+            str(n): [(str(s), int(d)) for s, d in v]
+            for n, v in (state.get("manifest_docs") or {}).items()}
         super().load_state_dict(state)
 
     # -- quarantine -----------------------------------------------------------
@@ -291,18 +310,25 @@ class StreamingDataset(PackedDataset):
             return
         os.makedirs(self.quarantine_dir, exist_ok=True)
         path = os.path.join(self.quarantine_dir, QUARANTINE_FILE)
-        try:
-            with open(path) as f:
-                doc = json.load(f)
-        except Exception:
-            doc = {"version": 1, "shards": []}
-        doc["shards"].append({"source": source, "shard": shard,
-                              "reason": reason,
-                              "epoch": self._walk_epoch})
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f, indent=1)
-        os.replace(tmp, path)
+        # quarantine_dir may be shared (several hosts on one filesystem,
+        # or a loader thread beside a supervisor): the read-modify-write
+        # runs under an exclusive flock so concurrent writers never lose
+        # each other's records
+        with open(path + ".lock", "w") as lockf:
+            if fcntl is not None:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+            except Exception:
+                doc = {"version": 1, "shards": []}
+            doc["shards"].append({"source": source, "shard": shard,
+                                  "reason": reason,
+                                  "epoch": self._walk_epoch})
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)
 
     # -- the deterministic walk -----------------------------------------------
 
@@ -324,24 +350,49 @@ class StreamingDataset(PackedDataset):
         permutation over the FULL manifest (quarantined shards are
         skipped at the cursor, keeping the permutation domain stable as
         the quarantine set grows) and the mixture weights with every
-        prior-epoch reweight already applied."""
+        prior-epoch reweight already applied.
+
+        A source shed BEFORE this epoch's first draw (an earlier epoch,
+        or doc 0 of this one) is permanent and excluded outright.  One
+        shed LATER stays in the walk so the replay pointer
+        (``_doc_stream``) removes it at its recorded doc index —
+        excluding it here would change the interleave of every earlier
+        document and break bitwise resume."""
         ew = dict(self._weights0)
         for e, _i, w in self._reweights:
             if e < epoch:
                 ew.update(w)
-        shed_names = {n for _e, _i, n in self._sheds}
+        shed_before = {n for e, i, n in self._sheds
+                       if e < epoch or (e == epoch and i == 0)}
+        shed_later = {n: (e, i) for e, i, n in self._sheds
+                      if n not in shed_before}
         runs: Dict[str, _Run] = {}
         for name in sorted(self.sources):
-            if name in shed_names:
+            if name in shed_before:
                 continue            # a shed is permanent: don't re-probe
             try:
                 entries = list(
                     self._clients[name].manifest_entries().values())
-            except DataLoaderError:
-                # the source is down before its first draw (manifest
-                # unreachable through the retry budget) — shed it here
-                self._record_shed(name)
-                continue
+            except DataLoaderError as err:
+                if name not in shed_later:
+                    # the source is down before its first draw (manifest
+                    # unreachable through the retry budget) — shed here
+                    self._record_shed(name)
+                    continue
+                # scheduled to shed mid-epoch: the walk only needs its
+                # doc counts up to the recorded index, and those persist
+                # in state_dict exactly so a now-dead source can still
+                # be replayed arithmetically
+                saved = self._manifest_docs.get(name)
+                if saved is None:
+                    raise DataLoaderError(
+                        f"source {name!r} was shed mid-epoch at "
+                        f"{shed_later[name]} but its manifest is "
+                        "unreachable and no saved doc counts exist — "
+                        "cannot replay the pre-shed interleave") from err
+                entries = [{"name": s, "docs": int(d)} for s, d in saved]
+            self._manifest_docs[name] = [
+                (str(e["name"]), int(e["docs"])) for e in entries]
             if self.shuffle_seed is None:
                 order = np.arange(len(entries))
             else:
@@ -377,7 +428,11 @@ class StreamingDataset(PackedDataset):
             name = e["name"]
             try:
                 docs = client.get_docs(name)
-            except (ShardCorruptionError, OSError, DataLoaderError) as err:
+            except (ShardCorruptionError, OSError) as err:
+                # data damage / transport failure only — a config error
+                # (DataLoaderError: missing tokenizer, shard absent from
+                # the manifest) propagates instead of masquerading as
+                # shard loss in the quarantine manifest
                 reason = (getattr(err, "reason", None)
                           or f"fetch failed: {err}")
                 self._record_quarantine(run.name, name, str(reason))
@@ -420,7 +475,21 @@ class StreamingDataset(PackedDataset):
 
     def _shed_source(self, live: Dict[str, _Run], name: str) -> None:
         live.pop(name, None)
-        self._record_shed(name)
+        if any(n == name for _e, _i, n in self._sheds):
+            # a recorded shed for this source is still pending (we are
+            # replaying its pre-shed window) and the store failed EARLIER
+            # than in the original run: the documents it delivered before
+            # the recorded shed cannot be refetched.  Don't record a
+            # second shed — the pending record still fires at its index —
+            # but say loudly that this replay is no longer bitwise.
+            counters.inc("data_replay_shed_early")
+            logger.error(
+                f"source {name!r} failed during resume replay before its "
+                "recorded shed point — pre-shed documents could not be "
+                "refetched; the resumed stream may diverge from the "
+                "original run")
+        else:
+            self._record_shed(name)
         if not live:
             raise DataSourceError(
                 f"source {name!r} failed and no live source remains — "
@@ -435,9 +504,6 @@ class StreamingDataset(PackedDataset):
         self._walk_epoch, self._walk_idx = epoch, 0
         runs = self._epoch_runs(epoch)
         live = {n: r for n, r in runs.items() if self._available(r)}
-        for e, _i, n in self._sheds:
-            if e < epoch:               # a shed is permanent: excluded
-                live.pop(n, None)       # from every later epoch's start
         if not live:
             if self._sheds:
                 raise DataSourceError(
@@ -448,26 +514,39 @@ class StreamingDataset(PackedDataset):
             return
         # pointers over the LIVE lists (set_weights / a breaker shed
         # append mid-iteration; prior-epoch entries were applied at
-        # epoch start, future-epoch entries cannot exist yet)
+        # epoch start — sheds excluded from runs, reweights folded into
+        # ew — and entries for later epochs must not fire here)
         rw_p = sum(1 for x in self._reweights if x[0] < epoch)
         sh_p = sum(1 for x in self._sheds if x[0] < epoch)
 
-        def draw() -> _Run:
+        def apply_recorded() -> None:
+            # recorded events fire before the draw at their doc index —
+            # called after every walk-index advance so a replayed shed
+            # removes its source before the cursor can resolve past it
             nonlocal rw_p, sh_p
-            # recorded events fire before the draw at their doc index
             while (sh_p < len(self._sheds)
+                   and self._sheds[sh_p][0] == epoch
                    and self._sheds[sh_p][1] <= self._walk_idx):
-                live.pop(self._sheds[sh_p][2], None)
+                name = self._sheds[sh_p][2]
+                popped = live.pop(name, None)
                 sh_p += 1
-                if not live:
+                if popped is not None and not live:
                     raise DataSourceError(
-                        "every data source shed — the data plane is down")
+                        "every data source shed — the data plane is "
+                        "down", source=name)
             while (rw_p < len(self._reweights)
+                   and self._reweights[rw_p][0] == epoch
                    and self._reweights[rw_p][1] <= self._walk_idx):
                 for n, w in self._reweights[rw_p][2].items():
                     if n in runs:       # a shed source may still be named
                         runs[n].ew = float(w)
                 rw_p += 1
+
+        def draw() -> _Run:
+            # re-check right before picking: a consumer-side
+            # set_weights (or a live shed) may have appended a record
+            # since the post-increment apply
+            apply_recorded()
             total = sum(r.ew for r in live.values())
             if not total > 0:
                 raise DataLoaderError(
@@ -484,6 +563,7 @@ class StreamingDataset(PackedDataset):
         # -- arithmetic fast-forward (resume seek): no fetches --------------
         # one draw = one document, advanced by manifest counts alone;
         # O(delivered docs) integer work, zero shard GETs
+        apply_recorded()
         while skip > 0:
             r = draw()
             r.j += 1
@@ -492,6 +572,7 @@ class StreamingDataset(PackedDataset):
             if r.j >= int(r.entry()["docs"]):
                 r.k += 1
                 r.j = 0
+            apply_recorded()
             if not self._available(r):
                 live.pop(r.name, None)
                 if not live:
@@ -517,11 +598,15 @@ class StreamingDataset(PackedDataset):
                 r.k += 1
                 r.j = 0
                 r.cur_docs = None
+            apply_recorded()
             # eager resolve: quarantine/shed verdicts land HERE, at the
             # cursor crossing, so the interleave below never observes a
-            # bad shard (bitwise-equal to pre-excluded)
+            # bad shard (bitwise-equal to pre-excluded).  A source a
+            # recorded shed just removed is NOT resolved — the original
+            # run never fetched past its shed point either
             try:
-                if r.cur_docs is None and not self._resolve(r):
+                if (r.name in live and r.cur_docs is None
+                        and not self._resolve(r)):
                     live.pop(r.name, None)
             except _Shed as s:
                 self._shed_source(live, s.source)
