@@ -5,7 +5,7 @@
 PYTHON ?= python
 PYTEST ?= $(PYTHON) -m pytest
 
-.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc chaos-replace serve-smoke serve-chaos router-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke lint dryrun tpu-watch
+.PHONY: test test-all test-inproc bench chaos chaos-multihost chaos-elastic chaos-sdc chaos-replace serve-smoke serve-chaos router-chaos handoff-smoke ckpt-smoke obs-smoke supervisor-smoke fleet-smoke store-chaos lint dryrun tpu-watch
 
 # Per-file subprocess isolation: XLA:CPU's in-process multi-device runtime
 # can SIGABRT nondeterministically mid-suite (scripts/run_tests.py docstring);
@@ -168,6 +168,7 @@ chaos:
 	$(MAKE) router-chaos
 	$(MAKE) chaos-replace
 	$(MAKE) data-chaos
+	$(MAKE) store-chaos
 
 # streaming-data-plane gate (docs/data.md): the full store/stream
 # suite under 3 ChaosStore fault schedules — transient errors, 429
@@ -181,6 +182,23 @@ data-chaos:
 		echo "== data chaos seed $$s =="; \
 		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) \
 			tests/test_datastream.py -m "not slow" -q || exit 1; \
+	done
+
+# unified object-store-plane gate (docs/resilience.md "Object-store
+# tier-2"): the shared PUT/GET client + two-phase commit under 3
+# write-side ChaosObjectStore fault schedules — transient 5xx, partial
+# (torn-object) uploads, acknowledged-but-lost writes, lost commit
+# markers, stale listings, dead destinations.  Proves kill -9
+# mid-trickle under write faults restarts to a bitwise newest-tier
+# restore, torn uploads stay invisible to restore_latest_valid, a
+# breaker-open mirror degrades to tier-1-only, and a journal archive
+# upload killed after rotation loses no record (union replay 100%).
+# Runs the slow subprocess kill fixtures too — they ARE the gate.
+store-chaos:
+	for s in 0 1 2; do \
+		echo "== store chaos seed $$s =="; \
+		CHAOS_SEED=$$s JAX_PLATFORMS=cpu $(PYTEST) \
+			tests/test_store.py -q || exit 1; \
 	done
 
 # multi-host robustness proof: 2-process jax.distributed fixtures

@@ -1515,12 +1515,24 @@ def _bench_checkpoint(args, wd: Watchdog, devs) -> int:
         n_saves = sum(1 for s in range(1, steps + 1) if s % every == 0)
         stall = sum(r.get("save_blocked_ms", 0.0) for r in hist)
         trainers[tag] = tr
-        return {"save_stall_ms_per_save": round(stall / max(n_saves, 1), 3),
-                "save_stall_ms_total": round(stall, 2),
-                "n_saves": n_saves,
-                "steps_per_sec": round(steps / wall, 3),
-                "tiered_saves": counters.get("tiered_saves"),
-                "wall_s": round(wall, 2)}
+        out = {"save_stall_ms_per_save": round(stall / max(n_saves, 1), 3),
+               "save_stall_ms_total": round(stall, 2),
+               "n_saves": n_saves,
+               "steps_per_sec": round(steps / wall, 3),
+               "tiered_saves": counters.get("tiered_saves"),
+               "wall_s": round(wall, 2)}
+        # tier-2 object-store leg: upload time/volume through the ONE
+        # shared PUT path (store/client.py), off the step critical path
+        cli = (tr._tiered_cache[1]._mirror_cli
+               if tr._tiered_cache is not None else None)
+        if cli is not None:
+            out.update({
+                "tier2_upload_ms": round(cli.put_ms, 2),
+                "tier2_upload_bytes": int(cli.put_bytes),
+                "tier2_uploads": int(cli.puts),
+                "tier2_put_retries": counters.get("store_put_retries"),
+            })
+        return out
 
     try:
         rows = {}
@@ -1593,6 +1605,10 @@ def _bench_checkpoint(args, wd: Watchdog, devs) -> int:
                 "main_cadence": main,
                 "blocking_stall_ms_per_save": blocking,
                 "tiered_stall_ms_per_save": tiered,
+                "tier2_upload_ms": rows[f"tiered_c{main}"].get(
+                    "tier2_upload_ms"),
+                "tier2_upload_bytes": rows[f"tiered_c{main}"].get(
+                    "tier2_upload_bytes"),
                 "ram_restores": counters.get("ram_restores"),
                 "bitwise": {k: True for k in checks},
                 "params_m": round(mc.num_params() / 1e6, 1),

@@ -1,0 +1,677 @@
+"""Unified object-store plane tests (torchacc_tpu/store/,
+docs/resilience.md "Object-store tier-2") — `make store-chaos` runs
+them under 3 seeds.
+
+The contracts under test:
+
+- write-side ChaosObjectStore fault plans are pure functions of
+  ``(seed, key)``, consumed per attempt — deterministic under ANY
+  put/retry interleaving, and independent of the read-plan stream;
+- the ONE PUT path (verify-after-put inside the retried callable)
+  survives transient 5xx, partial (torn-object-left-behind), and
+  acknowledged-but-lost uploads;
+- two-phase commit invariant: a reader NEVER sees payload objects
+  without their ``_COMMIT`` marker (torn uploads are invisible by
+  protocol), and a marker whose payloads fail checksum verification is
+  quarantined typed, never read;
+- kill -9 mid-trickle under write faults → restart → the newest tier
+  restores bitwise and the torn mirror upload is never offered;
+- a dead mirror store degrades to tier-1-only behind the destination
+  breaker (``store_breaker_open``) instead of stalling the trickle;
+- a journal archive upload killed between rotation and PUT loses
+  nothing: the local segment/archive union replays 100%.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchacc_tpu.errors import StoreCommitError, StoreError
+from torchacc_tpu.store import (
+    COMMIT_MARKER,
+    ChaosObjectStore,
+    GCSObjectStore,
+    LocalObjectStore,
+    ObjectStoreClient,
+    commit_marker_key,
+    list_commits,
+    open_store,
+    put_commit,
+    read_commit,
+    read_commit_marker,
+    sha256_hex,
+    verify_commit,
+)
+from torchacc_tpu.utils.metrics import counters
+from torchacc_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.store
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+_FAST = RetryPolicy(max_retries=4, base_delay_s=0.001, max_delay_s=0.002,
+                    retry_on=(OSError,))
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    counters.reset()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clear_mirror_factory():
+    import torchacc_tpu.checkpoint.tiered as tiered
+    yield
+    tiered.MIRROR_STORE_FACTORY = None
+
+
+def _client(store, **kw):
+    kw.setdefault("policy", _FAST)
+    kw.setdefault("sleep", lambda s: None)
+    return ObjectStoreClient(store, **kw)
+
+
+def _payload(key):
+    return (f"payload:{key}:{CHAOS_SEED}" * 7).encode()
+
+
+# -- backends -----------------------------------------------------------------
+
+def test_local_store_rejects_escaping_and_hidden_keys(tmp_path):
+    s = LocalObjectStore(str(tmp_path))
+    for bad in ("", "/abs", "a//b", "a/../b", ".hidden", "a/.tmp", "a/"):
+        with pytest.raises(StoreError):
+            s.put(bad, b"x")
+    s.put("a/b/c", b"ok")
+    assert s.get("a/b/c") == b"ok"
+    # in-flight temp files are never listed as objects
+    (tmp_path / "a" / ".c.tmp999").write_bytes(b"junk")
+    assert s.list() == ["a/b/c"]
+    s.delete("a/b/c")
+    s.delete("a/b/c")        # idempotent
+    assert not s.exists("a/b/c")
+
+
+def test_open_store_dispatch_and_gcs_stub_typed(tmp_path):
+    assert isinstance(open_store(str(tmp_path)), LocalObjectStore)
+    g = open_store("gs://bucket/pre/fix")
+    assert isinstance(g, GCSObjectStore)
+    assert (g.bucket, g.prefix) == ("bucket", "pre/fix")
+    with pytest.raises(NotImplementedError) as ei:
+        g.put("k", b"x")
+    assert "ObjectStore surface" in str(ei.value)
+    with pytest.raises(StoreError):
+        GCSObjectStore("s3://nope")
+
+
+# -- write-side chaos plan determinism ----------------------------------------
+
+def _drive(store, schedule):
+    """One PUT attempt per schedule entry; per-key outcome strings
+    ('raise' / 'ok' / 'swallowed') — the observable fault schedule."""
+    out = {}
+    for key in schedule:
+        try:
+            store.put(key, _payload(key))
+        except OSError:
+            out.setdefault(key, []).append("raise")
+            continue
+        stored = (store.inner.exists(key)
+                  and store.inner.get(key) == _payload(key))
+        out.setdefault(key, []).append("ok" if stored else "swallowed")
+    return out
+
+
+def test_write_plans_deterministic_under_any_put_order(tmp_path):
+    keys = [f"step/{i}/obj" for i in range(12)]
+    faults = dict(put_transient_rate=0.4, put_partial_rate=0.25,
+                  put_lost_rate=0.2)
+    # order A: each key retried to 4 attempts back to back; order B:
+    # round-robin interleaved and reversed — same per-key schedules
+    a = _drive(ChaosObjectStore(LocalObjectStore(str(tmp_path / "a")),
+                                seed=CHAOS_SEED, **faults),
+               [k for k in keys for _ in range(4)])
+    b = _drive(ChaosObjectStore(LocalObjectStore(str(tmp_path / "b")),
+                                seed=CHAOS_SEED, **faults),
+               [k for _ in range(4) for k in reversed(keys)])
+    assert a == b
+    # the seed moves the schedule: at least one key draws a fault at
+    # these rates (12 keys, ~85% fault probability each)
+    assert any(o[0] != "ok" for o in a.values())
+
+
+def test_write_faults_never_perturb_read_plans(tmp_path):
+    """Read plans draw from ``crc32(seed|key)``, write plans from
+    ``crc32(seed|put|key)`` — enabling write faults must not shift a
+    read schedule a seed was chosen for."""
+    quiet = ChaosObjectStore(LocalObjectStore(str(tmp_path)),
+                             seed=CHAOS_SEED, transient_rate=0.4,
+                             torn_rate=0.3)
+    noisy = ChaosObjectStore(LocalObjectStore(str(tmp_path)),
+                             seed=CHAOS_SEED, transient_rate=0.4,
+                             torn_rate=0.3, put_transient_rate=0.9,
+                             put_partial_rate=0.05)
+    for i in range(20):
+        assert quiet._plan(f"k{i}") == noisy._plan(f"k{i}")
+
+
+def test_put_verify_retries_partial_lost_and_transient(tmp_path):
+    """The one PUT path re-uploads everything the backend tore, lost,
+    or 5xx'd — verify-after-put inside the retried callable."""
+    for kind, faults in (
+            ("transient", dict(put_transient_rate=1.0)),
+            ("partial", dict(put_partial_rate=1.0)),
+            ("lost", dict(put_lost_rate=1.0))):
+        counters.reset()
+        root = str(tmp_path / kind)
+        store = ChaosObjectStore(LocalObjectStore(root), seed=CHAOS_SEED,
+                                 **faults)
+        cli = _client(store)
+        data = _payload(kind)
+        assert cli.put(f"{kind}/obj", data) == sha256_hex(data)
+        assert store.inner.get(f"{kind}/obj") == data
+        assert counters.get("store_put_retries") >= 1, kind
+        assert counters.get("store_puts") == 1
+        assert counters.get("store_put_bytes") == len(data)
+
+
+# -- two-phase commit invariants ----------------------------------------------
+
+def test_payload_without_marker_is_invisible(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    cli = _client(store)
+    cli.put("7/weights.bin", b"torn upload payload")
+    cli.put("7/extra.bin", b"more bytes")          # no marker ever lands
+    assert list_commits(store) == []
+    with pytest.raises(StoreCommitError) as ei:
+        read_commit(cli, "7")
+    assert ei.value.torn and ei.value.prefix == "7"
+    assert verify_commit(store, "7") == ["no commit marker (torn upload)"]
+
+
+def test_commit_roundtrip_and_marker_last(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    cli = _client(store)
+    objs = {"a.bin": b"alpha" * 9, "b/nested.bin": b"beta" * 5}
+    marker = put_commit(cli, "12", objs, meta={"step": 12})
+    assert set(marker["objects"]) == set(objs)
+    assert list_commits(store) == ["12"]
+    assert read_commit(cli, "12") == objs
+    assert verify_commit(store, "12") == []
+    assert read_commit_marker(store, "12")["meta"] == {"step": 12}
+
+
+def test_marker_without_verified_payload_quarantined(tmp_path):
+    store = LocalObjectStore(str(tmp_path))
+    cli = _client(store)
+    put_commit(cli, "12", {"a.bin": b"sound bytes here"})
+    # bit-rot one payload UNDER the marker (store-level, no re-commit)
+    store.put("12/a.bin", b"Sound bytes here")
+    with pytest.raises(StoreCommitError) as ei:
+        read_commit(cli, "12")
+    assert not ei.value.torn        # marked but damaged: the quarantine case
+    assert "a.bin" in str(ei.value)
+    problems = verify_commit(store, "12")
+    assert any("sha256 mismatch" in p for p in problems)
+
+
+def test_lost_marker_leaves_commit_invisible(tmp_path):
+    """The commit-marker-lost write fault: payloads land, the marker
+    PUT is swallowed forever — retries exhaust, the commit stays
+    invisible, and the failure is typed + counted."""
+    store = ChaosObjectStore(LocalObjectStore(str(tmp_path)),
+                             seed=CHAOS_SEED,
+                             lose_keys={commit_marker_key("9")})
+    cli = _client(store)
+    with pytest.raises(OSError):
+        put_commit(cli, "9", {"w.bin": b"payload that made it"})
+    assert counters.get("store_put_failures") == 1
+    assert list_commits(store.inner) == []
+    assert store.inner.get("9/w.bin") == b"payload that made it"
+    assert store.injected.get("put_lost", 0) >= 1
+
+
+def test_stale_listing_hides_then_reveals_commit(tmp_path):
+    """gs:// listings are eventually consistent: a fresh commit may be
+    absent from the first LIST and must appear on a later one."""
+    store = ChaosObjectStore(LocalObjectStore(str(tmp_path)),
+                             seed=CHAOS_SEED, stale_list_reads=1)
+    put_commit(_client(store), "3", {"x.bin": b"bytes"})
+    assert list_commits(store) == []          # stale read: not yet visible
+    assert store.injected.get("stale_list") == 1
+    assert list_commits(store) == ["3"]       # convergence
+
+
+# -- breaker degradation ------------------------------------------------------
+
+def test_dead_store_opens_breaker_without_stalling(tmp_path):
+    clock = [0.0]
+    store = ChaosObjectStore(LocalObjectStore(str(tmp_path)), dead=True)
+    cli = _client(store, failure_budget=2, breaker_cooldown_s=5.0)
+    cli.breaker._clock = lambda: clock[0]    # deterministic half-open
+    for _ in range(2):
+        assert cli.should_attempt()
+        with pytest.raises(OSError):
+            cli.put("k", b"x")
+        cli.record_outcome(False)
+    assert counters.get("store_breaker_open") == 1
+    assert not cli.should_attempt()           # OPEN: skip cheaply
+    clock[0] = 6.0
+    assert cli.should_attempt()               # half-open probe granted
+    store.dead = False
+    cli.put("k", b"x")
+    assert not cli.record_outcome(True)       # readmitted, no open edge
+    assert cli.should_attempt()
+
+
+# -- owner election -----------------------------------------------------------
+
+def test_elect_upload_owners_round_robin():
+    from torchacc_tpu.checkpoint.tiered import elect_upload_owners
+    m = np.array([[True, True, False, True],
+                  [True, False, True, True],
+                  [True, True, True, False]])
+    owners = elect_upload_owners(m)
+    assert len(owners) == 4
+    for r, o in enumerate(owners):
+        assert m[o, r]                        # owners only ever hold
+    # round-robin spreads the upload bytes across holders
+    assert len(set(owners)) > 1
+    none = np.array([[True, False], [True, False]])
+    assert elect_upload_owners(none)[1] == -1
+
+
+# -- tiered tier-2 integration ------------------------------------------------
+
+def _model():
+    import jax.numpy as jnp
+
+    from torchacc_tpu.models import get_preset
+    return get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                      num_layers=1, num_heads=2, num_kv_heads=2,
+                      intermediate_size=64, dtype=jnp.float32)
+
+
+def _trainer(mirror):
+    import optax
+
+    import torchacc_tpu as ta
+    from torchacc_tpu.train import accelerate
+    cfg = ta.Config(resilience=ta.ResilienceConfig(
+        tiered_checkpointing=True, tiered_mirror_dir=mirror))
+    tr, _ = accelerate(_model(), None, cfg, optimizer=optax.adam(1e-3))
+    return tr
+
+
+def _batches(n):
+    rng = np.random.default_rng(CHAOS_SEED)
+    return [{"input_ids": rng.integers(0, 64, size=(8, 16)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def _leaves(tree):
+    import jax
+    return [np.asarray(x) for x in jax.device_get(jax.tree.leaves(tree))]
+
+
+def test_mirror_survives_write_faults_and_restores_bitwise(tmp_path):
+    """Tier-2 uploads ride the verifying client: under transient /
+    partial / lost write faults every committed step still lands
+    bitwise-restorable on the mirror."""
+    import torchacc_tpu.checkpoint.tiered as tiered
+    chaos = []
+
+    def factory(d):
+        chaos.append(ChaosObjectStore(
+            LocalObjectStore(d), seed=CHAOS_SEED, put_transient_rate=0.35,
+            put_partial_rate=0.25, put_lost_rate=0.15))
+        return chaos[-1]
+
+    tiered.MIRROR_STORE_FACTORY = factory
+    d, mirror = str(tmp_path / "ckpt"), str(tmp_path / "mirror")
+    t = _trainer(mirror)
+    t.fit(_batches(4), max_steps=4, log_every=0, checkpoint_dir=d,
+          checkpoint_every=2)
+    assert counters.get("mirror_writes") == 2
+    want = _leaves(t.state)
+    tiered.MIRROR_STORE_FACTORY = None
+    assert tiered.TieredCheckpointManager._mirror_valid_steps(mirror) \
+        == [2, 4]
+    assert verify_commit(LocalObjectStore(mirror), "4") == []
+    shutil.rmtree(d)                # local history gone: tier 2 serves
+    mgr = tiered.TieredCheckpointManager(d, mirror_dir=mirror)
+    try:
+        state, step = mgr.restore_latest_valid(t.abstract_state())
+    finally:
+        mgr.shutdown()
+    assert step == 4
+    for x, y in zip(want, _leaves(state)):
+        np.testing.assert_array_equal(x, y)
+    assert counters.get("mirror_restores") == 1
+
+
+def test_torn_mirror_upload_never_offered_for_restore(tmp_path):
+    """Strip the newest mirror step's _COMMIT marker (the torn-upload
+    signature): restore_latest_valid must fall to the older committed
+    mirror step, never the torn one."""
+    from torchacc_tpu.checkpoint.io import MANIFEST
+    from torchacc_tpu.checkpoint.tiered import TieredCheckpointManager
+    d, mirror = str(tmp_path / "ckpt"), str(tmp_path / "mirror")
+    t = _trainer(mirror)
+    t.fit(_batches(4), max_steps=4, log_every=0, checkpoint_dir=d,
+          checkpoint_every=2)
+    ref_mgr = TieredCheckpointManager(str(tmp_path / "scratch"),
+                                      mirror_dir=mirror)
+    try:
+        abstract = t.abstract_state()
+        store = LocalObjectStore(mirror)
+        store.delete(commit_marker_key("4"))
+        store.delete(f"4/{MANIFEST}")
+        assert os.path.isdir(os.path.join(mirror, "4"))   # payloads remain
+        assert TieredCheckpointManager._mirror_valid_steps(mirror) == [2]
+        shutil.rmtree(d)
+        state, step = ref_mgr.restore_latest_valid(abstract)
+    finally:
+        ref_mgr.shutdown()
+    assert step == 2
+
+
+def test_damaged_mirror_commit_read_repairs_to_tier1(tmp_path):
+    """A marker blessing damaged payloads quarantines typed and the
+    restore falls back to the older-but-sound tier-1 step, counted
+    ``mirror_read_repairs``."""
+    from torchacc_tpu.checkpoint.tiered import TieredCheckpointManager
+    d, mirror = str(tmp_path / "ckpt"), str(tmp_path / "mirror")
+    t = _trainer(mirror)
+    t.fit(_batches(4), max_steps=4, log_every=0, checkpoint_dir=d,
+          checkpoint_every=2)
+    # tier 1 keeps only step 2; the mirror's newer step 4 is bit-rotted
+    # UNDER its marker
+    shutil.rmtree(os.path.join(d, "4"))
+    store = LocalObjectStore(mirror)
+    key = next(k for k in store.list("4/")
+               if not k.endswith((COMMIT_MARKER, "_MANIFEST"))
+               and k.startswith("4/default/d/"))
+    buf = bytearray(store.get(key))
+    buf[len(buf) // 2] ^= 0x10
+    store.put(key, bytes(buf))
+    counters.reset()
+    mgr = TieredCheckpointManager(d, mirror_dir=mirror)
+    try:
+        state, step = mgr.restore_latest_valid(t.abstract_state())
+    finally:
+        mgr.shutdown()
+    assert step == 2
+    assert counters.get("mirror_read_repairs") == 1
+    assert counters.get("mirror_restores") == 0
+
+
+def test_dead_mirror_degrades_to_tier1_only(tmp_path):
+    """A dead mirror destination must cost the trickle a breaker
+    verdict, not a stall: failures open the breaker
+    (``store_breaker_open``), later saves skip cheaply
+    (``mirror_skips``), and every step stays durable on tier 1."""
+    import torchacc_tpu.checkpoint.tiered as tiered
+    tiered.MIRROR_STORE_FACTORY = lambda d: ChaosObjectStore(
+        LocalObjectStore(d), dead=True)
+    d, mirror = str(tmp_path / "ckpt"), str(tmp_path / "mirror")
+    t = _trainer(mirror)
+    t.fit(_batches(6), max_steps=6, log_every=0, checkpoint_dir=d,
+          checkpoint_every=1)
+    assert counters.get("mirror_writes") == 0
+    assert counters.get("mirror_write_failures") >= 3
+    assert counters.get("store_breaker_open") == 1
+    assert counters.get("mirror_skips") >= 1          # post-open skips
+    assert counters.get("tiered_write_failures") == 0  # tier 1 untouched
+    from torchacc_tpu.checkpoint import CheckpointManager
+    # tier 1 committed every step (retention keeps the newest window)
+    assert CheckpointManager(d).valid_steps() == [4, 5, 6]
+
+
+# -- kill -9 mid-trickle (the acceptance scenario) ----------------------------
+
+_TIERED_KILL_WORKER = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+base, mode = sys.argv[1:3]
+seed = int(os.environ.get("CHAOS_SEED", "0"))
+import hashlib
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import torchacc_tpu as ta
+import torchacc_tpu.checkpoint.tiered as tiered
+from torchacc_tpu.models import get_preset
+from torchacc_tpu.store import ChaosObjectStore, LocalObjectStore
+from torchacc_tpu.train import accelerate
+
+
+class KillStore(ChaosObjectStore):
+    def put(self, name, data):
+        if mode == "kill" and name.startswith("6/"):
+            if sum(1 for k in self._put_attempts
+                   if k.startswith("6/")) >= 2:
+                os.kill(os.getpid(), 9)   # mid-upload: marker never lands
+        ChaosObjectStore.put(self, name, data)
+
+
+if mode == "kill":
+    tiered.MIRROR_STORE_FACTORY = lambda d: KillStore(
+        LocalObjectStore(d), seed=seed, put_transient_rate=0.3,
+        put_partial_rate=0.2, put_lost_rate=0.1)
+
+model = get_preset("llama-tiny", vocab_size=64, hidden_size=32,
+                   num_layers=1, num_heads=2, num_kv_heads=2,
+                   intermediate_size=64, dtype=jnp.float32)
+cfg = ta.Config(resilience=ta.ResilienceConfig(
+    tiered_checkpointing=True,
+    tiered_mirror_dir=os.path.join(base, "mirror")))
+tr, _ = accelerate(model, None, cfg, optimizer=optax.adam(1e-3))
+rng = np.random.default_rng(seed)
+bs = [{"input_ids": rng.integers(0, 64, size=(8, 16)).astype(np.int32)}
+      for _ in range(6)]
+tr.fit(bs, max_steps=6, log_every=0,
+       checkpoint_dir=os.path.join(base, "ckpt"), checkpoint_every=2)
+digs = [hashlib.sha256(np.asarray(x).tobytes()).hexdigest()
+        for x in jax.device_get(jax.tree.leaves(tr.state))]
+with open(os.path.join(base, "ref.json"), "w") as f:
+    json.dump(digs, f)
+print("ok", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_kill9_mid_mirror_upload_restart_restores_newest_tier(tmp_path):
+    """kill -9 in the middle of step 6's tier-2 upload, under write
+    faults: the torn mirror prefix is invisible (no marker), a fresh
+    process restores step 6 from tier 1 bitwise, and with tier 1 burned
+    the mirror serves its newest COMMITTED step."""
+    from torchacc_tpu.checkpoint.tiered import TieredCheckpointManager
+    env = dict(os.environ, CHAOS_SEED=str(CHAOS_SEED),
+               JAX_PLATFORMS="cpu")
+    ref_base, kill_base = str(tmp_path / "ref"), str(tmp_path / "kill")
+    os.makedirs(ref_base), os.makedirs(kill_base)
+    p = subprocess.run(
+        [sys.executable, "-c", _TIERED_KILL_WORKER, ref_base, "ref"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, timeout=600)
+    assert p.returncode == 0, p.stdout[-3000:]
+    ref_digs = json.load(open(os.path.join(ref_base, "ref.json")))
+
+    p = subprocess.run(
+        [sys.executable, "-c", _TIERED_KILL_WORKER, kill_base, "kill"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, timeout=600)
+    assert p.returncode == -9, p.stdout[-3000:]   # died by SIGKILL
+
+    d = os.path.join(kill_base, "ckpt")
+    mirror = os.path.join(kill_base, "mirror")
+    # the interrupted upload is torn: payload objects, no marker
+    assert os.path.isdir(os.path.join(mirror, "6"))
+    assert not os.path.exists(os.path.join(mirror, "6", COMMIT_MARKER))
+    assert TieredCheckpointManager._mirror_valid_steps(mirror) == [2, 4]
+
+    def digs(tree):
+        import hashlib
+        return [hashlib.sha256(x.tobytes()).hexdigest()
+                for x in _leaves(tree)]
+
+    t = _trainer(None)              # same model: the abstract target
+    abstract = t.abstract_state()
+    mgr = TieredCheckpointManager(d, mirror_dir=mirror)
+    try:
+        state, step = mgr.restore_latest_valid(abstract)
+    finally:
+        mgr.shutdown()
+    assert step == 6 and digs(state) == ref_digs   # newest tier, bitwise
+    shutil.rmtree(d)                # tier 1 burned: committed mirror only
+    counters.reset()
+    mgr = TieredCheckpointManager(d, mirror_dir=mirror)
+    try:
+        state, step = mgr.restore_latest_valid(abstract)
+    finally:
+        mgr.shutdown()
+    assert step == 4                # torn step 6 never offered
+    assert counters.get("mirror_restores") == 1
+
+
+# -- journal archive uploads --------------------------------------------------
+
+def _append_pair(j, rid):
+    j.accepted(rid=rid, trace_id=f"t{rid}", prompt_ids=[1, 2, 3],
+               max_new_tokens=4, temperature=0.0, top_k=0, top_p=1.0,
+               eos_id=None, seed=0, priority=0, deadline_unix=None)
+    j.completed(rid=rid, tokens=[5, 6], finish_reason="stop")
+
+
+def test_journal_archives_upload_on_rotation(tmp_path):
+    from torchacc_tpu.serve.journal import (
+        RequestJournal,
+        read_archived_terminals,
+        read_journal,
+        replay_state,
+    )
+    store = LocalObjectStore(str(tmp_path / "store"))
+    j = RequestJournal(str(tmp_path / "journal"), rotate_bytes=600,
+                       archive_store=store)
+    for rid in range(12):
+        _append_pair(j, rid)
+    j.close()
+    assert j.rotations >= 2 and j.archive_uploads == j.rotations
+    assert counters.get("journal_archive_uploads") == j.rotations
+    # one sound two-phase commit per rotation, monotone sequence —
+    # NOT the recycled local segment name (which would overwrite)
+    commits = list_commits(store, "journal-archive")
+    assert commits == [f"journal-archive/{i + 1:05d}"
+                       for i in range(j.rotations)]
+    for p in commits:
+        assert verify_commit(store, p) == []
+    # archived terminals are a subset of (and consistent with) the
+    # authoritative local union
+    _, completed, _ = replay_state(read_journal(str(tmp_path / "journal")))
+    archived = read_archived_terminals(store)
+    assert archived and {r["rid"] for r in archived} <= set(completed)
+
+
+def test_journal_dead_archive_store_never_fails_rotation(tmp_path):
+    from torchacc_tpu.serve.journal import RequestJournal, read_journal
+    j = RequestJournal(
+        str(tmp_path / "journal"), rotate_bytes=600,
+        archive_store=ChaosObjectStore(LocalObjectStore(
+            str(tmp_path / "store")), dead=True))
+    for rid in range(12):
+        _append_pair(j, rid)       # never raises
+    j.close()
+    assert j.rotations >= 2 and j.archive_uploads == 0
+    assert counters.get("journal_archive_upload_failures") >= 1
+    # local durability is untouched by the dead store
+    recs = read_journal(str(tmp_path / "journal"))
+    assert {r["rid"] for r in recs} == set(range(12))
+
+
+_JOURNAL_KILL_WORKER = """
+import json, os, sys
+base, mode = sys.argv[1:3]
+from torchacc_tpu.serve.journal import RequestJournal
+from torchacc_tpu.store import LocalObjectStore
+
+
+class KillStore(LocalObjectStore):
+    def put(self, name, data):
+        if mode == "kill" and name.startswith("journal-archive/00002/"):
+            os.kill(os.getpid(), 9)   # after rotation, before upload
+        LocalObjectStore.put(self, name, data)
+
+
+j = RequestJournal(os.path.join(base, "journal"), rotate_bytes=600,
+                   archive_store=KillStore(os.path.join(base, "store")))
+progress = os.path.join(base, "progress.json")
+for rid in range(60):
+    j.accepted(rid=rid, trace_id=f"t{rid}", prompt_ids=[1, 2, 3],
+               max_new_tokens=4, temperature=0.0, top_k=0, top_p=1.0,
+               eos_id=None, seed=0, priority=0, deadline_unix=None)
+    j.completed(rid=rid, tokens=[5, 6], finish_reason="stop")
+    with open(progress, "w") as f:
+        json.dump(rid + 1, f)
+        f.flush()
+        os.fsync(f.fileno())
+print("done", flush=True)
+"""
+
+
+def test_kill9_between_rotation_and_upload_union_replays_100pct(tmp_path):
+    """SIGKILL lands after the second rotation completed locally but
+    before its archive upload: the local segment/archive union still
+    replays every record, and the store shows only commit-marked
+    (whole) segments."""
+    from torchacc_tpu.serve.journal import (
+        read_archived_terminals,
+        read_journal,
+        replay_state,
+    )
+    base = str(tmp_path)
+    p = subprocess.run(
+        [sys.executable, "-c", _JOURNAL_KILL_WORKER, base, "kill"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), timeout=300)
+    assert p.returncode == -9, p.stdout[-3000:]
+    done = json.load(open(os.path.join(base, "progress.json")))
+    assert done >= 1               # at least one full pair acknowledged
+    pending, completed, shed = replay_state(
+        read_journal(os.path.join(base, "journal")))
+    # union replay 100%: every acknowledged pair survives the kill
+    assert set(range(done)) <= set(completed)
+    assert not shed
+    store = LocalObjectStore(os.path.join(base, "store"))
+    commits = list_commits(store, "journal-archive")
+    assert commits == ["journal-archive/00001"]           # second killed
+    archived = {r["rid"] for r in read_archived_terminals(store)}
+    assert archived and archived <= set(completed)
+
+
+# -- operator surface ---------------------------------------------------------
+
+def test_inspect_mirror_flags_torn_and_corrupt(tmp_path, capsys):
+    """``inspect --mirror`` renders the commit-marked truth: committed
+    steps verify clean, marker-less payloads print TORN, checksum
+    mismatches print CORRUPT."""
+    from torchacc_tpu.checkpoint.cli import _print_tiers
+    mirror = str(tmp_path / "mirror")
+    store = LocalObjectStore(mirror)
+    cli = _client(store)
+    put_commit(cli, "2", {"w.bin": b"sound"})
+    put_commit(cli, "4", {"w.bin": b"sound"})
+    store.put("4/w.bin", b"nosnd")             # bit-rot under the marker
+    store.put("6/w.bin", b"torn payload")      # no marker at all
+    _print_tiers(str(tmp_path / "ckpt"), [2, 4], mirror)
+    out = capsys.readouterr().out
+    assert "step 2: tier1=committed tier2=committed" in out
+    assert "step 4: tier1=committed tier2=CORRUPT" in out
+    assert "step 6: tier1=missing tier2=TORN (no commit marker)" in out

@@ -109,19 +109,41 @@ def _print_tiers(d: str, steps, mirror: str) -> None:
     """Per-tier state of a tiered checkpoint dir (docs/resilience.md
     "Tiered checkpointing"): which steps are durable locally (tier 1)
     vs mirrored (tier 2), plus the writer's advisory trickle progress
-    (``_TIERED`` — submitted / verdict watermark / RAM snapshots)."""
-    from torchacc_tpu.checkpoint.tiered import (
-        TieredCheckpointManager,
-        read_tiered_status,
+    (``_TIERED`` — submitted / verdict watermark / RAM snapshots).
+
+    Tier 2 is the object-store mirror: a step counts as committed only
+    under its two-phase ``_COMMIT`` marker, and every committed step is
+    verified payload-by-payload (``verify_commit``) so torn uploads
+    (payload bytes, no marker) and checksum-mismatched objects are
+    flagged explicitly instead of masquerading as restorable."""
+    from torchacc_tpu.checkpoint.tiered import read_tiered_status
+    from torchacc_tpu.store import (
+        LocalObjectStore,
+        commit_marker_key,
+        list_commits,
+        verify_commit,
     )
 
-    # the ONE notion of "commit-marked step" the restore path uses
-    mirrored = set(TieredCheckpointManager._fs_valid_steps(mirror))
+    t2_state: dict = {}
+    if mirror and os.path.isdir(mirror):
+        store = LocalObjectStore(mirror)
+        # the ONE notion of "commit-marked step" the restore path uses
+        marked = {int(p) for p in list_commits(store) if p.isdigit()}
+        for step in marked:
+            problems = verify_commit(store, str(step))
+            t2_state[step] = ("committed" if not problems
+                              else "CORRUPT (" + "; ".join(problems) + ")")
+        # payload bytes without a marker: a torn upload the restore
+        # path will never offer — name it so the operator knows why
+        for name in os.listdir(mirror):
+            if (name.isdigit() and int(name) not in marked
+                    and os.path.isdir(os.path.join(mirror, name))
+                    and not store.exists(commit_marker_key(name))):
+                t2_state[int(name)] = "TORN (no commit marker)"
     print("tiers:")
-    for step in sorted(set(steps) | mirrored):
+    for step in sorted(set(steps) | set(t2_state)):
         t1 = "committed" if step in set(steps) else "missing"
-        t2 = ("committed" if step in mirrored else "missing") \
-            if mirror else "-"
+        t2 = t2_state.get(step, "missing") if mirror else "-"
         print(f"  step {step}: tier1={t1} tier2={t2}")
     status = read_tiered_status(d)
     if status is not None:

@@ -22,10 +22,17 @@ module splits "snapshot" from "durable" so neither lands on step time:
   blocking ones.  Verdict-before-durability is preserved *without*
   draining the ring on the hot path: an aborted step's gate simply
   never opens and its snapshot is discarded, never committed.
-- **tier 2 — mirror.**  Committed tier-1 step dirs are copied to an
-  optional mirror directory (object-store mount, second filesystem):
-  payload first, commit marker last, so a torn mirror copy is as
-  invisible as a torn save.
+- **tier 2 — object-store mirror.**  Committed tier-1 step dirs are
+  uploaded to an optional mirror backend through the ONE shared
+  verifying client (``torchacc_tpu/store/``): checksummed payload PUTs
+  first, then the two-phase ``_COMMIT`` sha256 marker, then
+  ``_MANIFEST`` — so a torn upload is as invisible as a torn save, and
+  a marker whose payloads fail verification is quarantined at restore
+  (``mirror_read_repairs``) instead of restored.  Multi-host (fs
+  barrier), payload uploads are owner-elected across the pod
+  (:func:`elect_upload_owners`) so egress spreads over every host's
+  NIC; the destination's circuit breaker skips uploads cheaply while
+  the store is down and probes recovery on its half-open schedule.
 
 Restore picks the **newest valid tier, pod-wide**: verdicted tier-0
 snapshots (max over hosts) beat durable steps (min over hosts, the
@@ -46,9 +53,9 @@ import dataclasses
 import json
 import os
 import queue
-import shutil
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -65,12 +72,28 @@ from torchacc_tpu.errors import (
     CheckpointCorruptionError,
     CheckpointError,
     CheckpointNotFoundError,
+    StoreCommitError,
 )
 from torchacc_tpu.obs import tracing
 from torchacc_tpu.resilience import coordination as coord
 from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.store.base import LocalObjectStore, ObjectStore
+from torchacc_tpu.store.client import (
+    COMMIT_MARKER,
+    ObjectStoreClient,
+    commit_marker_key,
+    read_commit_marker,
+    sha256_hex,
+)
 from torchacc_tpu.utils.logger import logger
 from torchacc_tpu.utils.metrics import counters
+
+#: Test/ops seam: when set, tiered managers build their tier-2 mirror
+#: backend through this ``mirror_dir -> ObjectStore`` factory instead
+#: of the default ``LocalObjectStore`` — the chaos gates wrap the real
+#: backend in a ``ChaosObjectStore`` here without threading a store
+#: object through the trainer's config surface.
+MIRROR_STORE_FACTORY = None
 
 #: Advisory trickle-progress file in the tier-1 directory (primary-
 #: written, atomic): the ``inspect`` CLI shows per-tier state from it.
@@ -165,6 +188,26 @@ def assign_shard_owners(holder_matrix) -> List[int]:
     return owners
 
 
+def elect_upload_owners(holder_matrix) -> List[int]:
+    """Tier-2 upload election, pure and jax-free: same contract as
+    :func:`assign_shard_owners` (every host computes the same
+    assignment from the same allgathered ``(world, regions)`` holder
+    matrix; ``-1`` marks an uncovered region) but owners round-robin
+    across the holding hosts instead of always picking the smallest —
+    a restore donor wants ONE authoritative source per region, an
+    upload wants the egress bandwidth spread across the pod."""
+    base = assign_shard_owners(holder_matrix)   # validation + uncovered
+    m = np.asarray(holder_matrix, dtype=bool)
+    owners: List[int] = []
+    for r, b in enumerate(base):
+        if b < 0:
+            owners.append(-1)
+            continue
+        holders = np.flatnonzero(m[:, r])
+        owners.append(int(holders[r % holders.size]))
+    return owners
+
+
 @dataclasses.dataclass
 class _Entry:
     """One submitted save riding the trickle."""
@@ -207,6 +250,7 @@ class TieredCheckpointManager:
     def __init__(self, directory: str, *, max_to_keep: int = 3,
                  save_interval_steps: int = 1,
                  mirror_dir: Optional[str] = None,
+                 mirror_store: Optional[ObjectStore] = None,
                  tier0_keep: int = 2,
                  retry_policy=None,
                  coord_timeout_s: Optional[float] = None,
@@ -215,6 +259,12 @@ class TieredCheckpointManager:
         self._every = max(int(save_interval_steps), 1)
         self._mirror_dir = (os.path.abspath(mirror_dir)
                             if mirror_dir else None)
+        # tier-2 object-store plumbing: an explicit backend wins, then
+        # the module-level factory seam, then the local-directory
+        # default.  The ONE retrying/verifying client (store/client.py)
+        # is built lazily — restore-only processes never pay for it.
+        self._mirror_store_obj = mirror_store
+        self._mirror_cli: Optional[ObjectStoreClient] = None
         self._tier0_keep = max(int(tier0_keep), 1)
         self._coord_timeout = coord_timeout_s
         # ONE home for the commit-marker/digest/manifest protocol: the
@@ -236,6 +286,7 @@ class TieredCheckpointManager:
         # joining mid-history has no shared device-collective past).
         t1_barrier = ("fs" if coord.process_count() > 1
                       and supports_custom_barrier() else "device")
+        self._t1_barrier = t1_barrier
         self._inner_kwargs = dict(
             max_to_keep=max_to_keep,
             save_interval_steps=save_interval_steps,
@@ -439,21 +490,34 @@ class TieredCheckpointManager:
             # global array, which a per-host shard dict is not
             self._write_tier1(e, host if full_host else e.snap)
             e.snap = None
-        # tier 2: mirror the committed step dir, marker last — pure
-        # file I/O, safe on this thread in every topology.  Isolated
-        # failure domain: a dead mirror disk must neither mark the
-        # (locally durable!) step failed nor pollute the
-        # tiered_write_failures counter supervisors watch.
-        if self._mirror_dir is not None and coord.process_index() == 0:
+        # tier 2: upload the committed step dir to the mirror object
+        # store through the ONE shared client (store/client.py) —
+        # verified PUTs, payload first, _COMMIT marker + _MANIFEST
+        # last.  Isolated failure domain: a dead mirror must neither
+        # mark the (locally durable!) step failed nor pollute the
+        # tiered_write_failures counter supervisors watch — and an
+        # OPEN destination breaker skips the upload for pennies
+        # instead of paying a full copy attempt per save (the probe
+        # rides the breaker's half-open schedule).
+        if self._mirror_dir is not None and self._mirror_participant():
+            client = self._mirror_client()
             try:
                 failpoint("tiered.tier2", step=e.step)
                 with tracing.span("ckpt/mirror", step=e.step):
-                    self._mirror_step(e.step)
-                with self._cond:
-                    e.mirrored = True
-                counters.inc("mirror_writes")
-                self._write_status()
+                    status = self._mirror_step(e.step)
+                if status == "breaker-skip":
+                    counters.inc("mirror_skips")
+                    logger.debug(
+                        f"tiered checkpoint: tier-2 mirror of step "
+                        f"{e.step} skipped (breaker open)")
+                else:
+                    with self._cond:
+                        e.mirrored = True
+                    counters.inc("mirror_writes")
+                    client.record_outcome(True)
+                    self._write_status()
             except Exception as err:  # noqa: BLE001
+                client.record_outcome(False)
                 counters.inc("mirror_write_failures")
                 logger.warning(
                     f"tiered checkpoint: tier-2 mirror of step "
@@ -523,37 +587,185 @@ class TieredCheckpointManager:
                     f"tiered checkpoint: tier-1 write of step {e.step} "
                     f"failed ({err!r}); the step is not durable")
 
-    def _mirror_step(self, step: int) -> None:
-        """Copy the committed step dir into the mirror: payload into a
-        temp dir, atomic rename, THEN the commit marker — a crash
-        anywhere leaves either nothing or an unmarked (invisible) copy,
-        never a marked torn one."""
-        src = os.path.join(self._dir, str(step))
-        dst = os.path.join(self._mirror_dir, str(step))
-        if os.path.exists(os.path.join(dst, MANIFEST)):
-            # already mirrored — but only if it is the SAME save: a
-            # fresh run (resume=None) on a used dir re-reaches old
-            # labels with different bits, and tier 1 replaced its copy
-            # (delete_step) while a skip here would leave the mirror
-            # serving the discarded timeline.  The manifest carries the
-            # write time, so byte-equality identifies the same save.
-            try:
-                with open(os.path.join(src, MANIFEST), "rb") as a, \
-                        open(os.path.join(dst, MANIFEST), "rb") as b:
-                    if a.read() == b.read():
-                        return
-            except OSError:
-                pass  # unreadable marker: re-mirror below
-        os.makedirs(self._mirror_dir, exist_ok=True)
-        tmp = dst + ".tmp"
-        shutil.rmtree(tmp, ignore_errors=True)
-        shutil.copytree(src, tmp,
-                        ignore=shutil.ignore_patterns(MANIFEST))
-        shutil.rmtree(dst, ignore_errors=True)
-        os.replace(tmp, dst)
-        mtmp = os.path.join(dst, MANIFEST + ".tmp")
-        shutil.copy2(os.path.join(src, MANIFEST), mtmp)
-        os.replace(mtmp, os.path.join(dst, MANIFEST))
+    # -- tier-2 object-store plumbing ----------------------------------------
+    def _mirror_store(self) -> ObjectStore:
+        if self._mirror_store_obj is None:
+            if MIRROR_STORE_FACTORY is not None:
+                self._mirror_store_obj = MIRROR_STORE_FACTORY(
+                    self._mirror_dir)
+            else:
+                self._mirror_store_obj = LocalObjectStore(self._mirror_dir)
+        return self._mirror_store_obj
+
+    def _mirror_client(self) -> ObjectStoreClient:
+        """THE tier-2 PUT/GET path: the shared verifying client over
+        the mirror backend, one breaker for the destination."""
+        if self._mirror_cli is None:
+            self._mirror_cli = ObjectStoreClient(
+                self._mirror_store(),
+                destination=f"mirror:{self._mirror_dir}")
+        return self._mirror_cli
+
+    def _mirror_multihost(self) -> bool:
+        """Owner-elected pod uploads need writer threads that run in
+        lockstep pod-wide — exactly the ``t1_barrier == "fs"``
+        condition that legalised the async tier-1 path (class
+        docstring).  On the device-barrier fallback the primary
+        uploads alone, as before."""
+        return coord.process_count() > 1 and self._t1_barrier == "fs"
+
+    def _mirror_participant(self) -> bool:
+        return coord.process_index() == 0 or self._mirror_multihost()
+
+    @staticmethod
+    def _step_files(src: str) -> List[str]:
+        """Payload objects of a committed step dir: every file except
+        the commit-marking ``_MANIFEST`` (which goes LAST), as sorted
+        ``/``-separated store keys relative to the step dir."""
+        out: List[str] = []
+        for dirpath, _dirnames, filenames in os.walk(src):
+            rel = os.path.relpath(dirpath, src)
+            for fn in filenames:
+                if rel == "." and fn in (MANIFEST, COMMIT_MARKER):
+                    continue
+                if fn.startswith("."):
+                    continue                 # in-flight temp files
+                out.append(fn if rel == "."
+                           else "/".join(rel.split(os.sep) + [fn]))
+        return sorted(out)
+
+    def _mirror_same_save(self, prefix: str, man_bytes: bytes) -> bool:
+        """Already mirrored — but only if it is the SAME save: a fresh
+        run (resume=None) on a used dir re-reaches old labels with
+        different bits, and tier 1 replaced its copy (delete_step)
+        while a skip here would leave the mirror serving the discarded
+        timeline.  The tier-1 manifest carries the write time, so its
+        sha256 in the commit marker identifies the same save."""
+        marker = read_commit_marker(self._mirror_store(), prefix)
+        if marker is None:
+            return False
+        entry = marker.get("objects", {}).get(MANIFEST)
+        return (entry is not None
+                and entry.get("sha256") == sha256_hex(man_bytes))
+
+    def _mirror_clear_stale(self, prefix: str) -> None:
+        """Demote a to-be-replaced commit to invisible: delete the old
+        ``_COMMIT`` marker (and the ``_MANIFEST`` object it blessed)
+        before any payload byte changes."""
+        store = self._mirror_store()
+        if store.exists(commit_marker_key(prefix)):
+            store.delete(commit_marker_key(prefix))
+            store.delete(f"{prefix}/{MANIFEST}")
+
+    def _mirror_step(self, step: int) -> str:
+        """Upload the committed step dir under the two-phase protocol:
+        verified payload PUTs first, then the ``_COMMIT`` sha256
+        marker, then ``_MANIFEST`` — a crash or fault anywhere leaves
+        a marker-less (invisible) prefix, never a marked torn one.
+        Multi-host (fs barrier), payload uploads are owner-elected
+        across the pod (:func:`elect_upload_owners`); the primary
+        writes the marker only after every owner reported success.
+        Returns ``"uploaded"`` / ``"same"`` / ``"breaker-skip"``."""
+        client = self._mirror_client()
+        prefix = str(step)
+        src = os.path.join(self._dir, prefix)
+        files = self._step_files(src)
+        with open(os.path.join(src, MANIFEST), "rb") as f:
+            man_bytes = f.read()
+        attempt = client.should_attempt()
+        same = bool(attempt and self._mirror_same_save(prefix, man_bytes))
+        if not self._mirror_multihost():
+            if not attempt:
+                return "breaker-skip"
+            if same:
+                return "same"
+            self._mirror_clear_stale(prefix)
+            self._upload_step(client, prefix, src, files, man_bytes,
+                              owned=files)
+            return "uploaded"
+        # pod path.  The skip decisions must be consensus (a host that
+        # skips while a peer uploads would wedge the rendezvous), and
+        # the file list must be identical pod-wide before its flags can
+        # index one holder matrix.
+        t = self._coord_timeout
+        sig = zlib.crc32("\n".join(files).encode()) & 0x7FFFFFFF
+        agreed = (coord.min_over_hosts(
+            sig, timeout_s=t, name=f"tiered-mirror-sig-{step}")
+            == coord.max_over_hosts(
+                sig, timeout_s=t, name=f"tiered-mirror-sig2-{step}"))
+        holds = ([os.path.isfile(os.path.join(src, *k.split("/")))
+                  for k in files] if agreed else [])
+        m = coord.allgather_flags(
+            [attempt, same] + holds, timeout_s=t,
+            name=f"tiered-mirror-plan-{step}")
+        if not bool(m[:, 0].all()):
+            return "breaker-skip"            # degrade together
+        if bool(m[:, 1].all()):
+            return "same"
+        me = coord.process_index()
+        # a replaced commit passes through an invisible state BEFORE
+        # any host overwrites payloads: the old marker must never
+        # bless new payload bytes, so clear-then-barrier-then-upload
+        if me == 0:
+            self._mirror_clear_stale(prefix)
+        coord.allgather_flags([True], timeout_s=t,
+                              name=f"tiered-mirror-clear-{step}")
+        if agreed:
+            owners = elect_upload_owners(m[:, 2:])
+            if any(o < 0 for o in owners):
+                raise CheckpointError(
+                    f"tiered checkpoint: step {step} has mirror payload "
+                    "objects no host can read — cannot upload")
+            owned = [k for k, o in zip(files, owners) if o == me]
+        else:
+            # hosts see different file sets (non-shared tier-1 fs or a
+            # replace race): the primary uploads what it sees, alone
+            owned = files if me == 0 else []
+        ok = True
+        try:
+            self._upload_step(client, prefix, src, files, man_bytes,
+                              owned=owned)
+        except Exception as err:  # noqa: BLE001 - fail the rendezvous
+            logger.warning(
+                f"tiered checkpoint: tier-2 payload upload of step "
+                f"{step} failed on host {me} ({err!r})")
+            ok = False
+        if not bool(coord.allgather_flags(
+                [ok], timeout_s=t,
+                name=f"tiered-mirror-ok-{step}").all()):
+            raise CheckpointError(
+                f"tiered checkpoint: tier-2 upload of step {step} "
+                "failed on a peer host; no commit marker written")
+        return "uploaded"
+
+    def _upload_step(self, client: ObjectStoreClient, prefix: str,
+                     src: str, files: List[str], man_bytes: bytes,
+                     *, owned: List[str]) -> None:
+        """Phase 1 for ``owned`` payload keys (verified PUTs), then —
+        primary only — phase 2: the ``_COMMIT`` marker naming EVERY
+        object (sha256 computed from the tier-1 source files, which
+        the primary reads locally) and ``_MANIFEST`` last."""
+        store = client.store
+        primary = coord.process_index() == 0
+        for key in owned:
+            with open(os.path.join(src, *key.split("/")), "rb") as f:
+                client.put(f"{prefix}/{key}", f.read())
+        if not primary:
+            return
+        entries: Dict[str, Dict[str, Any]] = {}
+        for key in files:
+            path = os.path.join(src, *key.split("/"))
+            with open(path, "rb") as f:
+                data = f.read()
+            entries[key] = {"bytes": len(data),
+                            "sha256": sha256_hex(data)}
+        entries[MANIFEST] = {"bytes": len(man_bytes),
+                             "sha256": sha256_hex(man_bytes)}
+        marker = {"version": 1, "objects": entries,
+                  "meta": {"step": int(prefix)}}
+        client.put(commit_marker_key(prefix),
+                   json.dumps(marker, sort_keys=True).encode("utf-8"))
+        client.put(f"{prefix}/{MANIFEST}", man_bytes)
 
     def _trim_tier0(self) -> None:
         """Free all but the newest ``tier0_keep`` verdicted host
@@ -705,6 +917,85 @@ class TieredCheckpointManager:
                 os.path.join(directory, n, MANIFEST)))
 
     @staticmethod
+    def _mirror_valid_steps(directory: Optional[str]) -> List[int]:
+        """Commit-marked MIRROR steps straight off the filesystem (the
+        default ``LocalObjectStore`` layout): the tier-2 unit of
+        visibility is the two-phase ``_COMMIT`` marker, so a step is
+        offered only with BOTH its marker and its ``_MANIFEST`` — a
+        torn upload has neither and is invisible here by protocol."""
+        if not directory:
+            return []
+        try:
+            names = os.listdir(directory)
+        except OSError:
+            return []
+        return sorted(
+            int(n) for n in names
+            if n.isdigit()
+            and os.path.exists(os.path.join(directory, n, COMMIT_MARKER))
+            and os.path.exists(os.path.join(directory, n, MANIFEST)))
+
+    def _newest_validated_mirror(self, abstract_state: Any) -> int:
+        """Newest commit-marked mirror step whose (marker-blessed)
+        tier-1 manifest digest matches the target state — the tier-2
+        analogue of :meth:`_newest_validated_fs`, read through the
+        shared store so torn uploads (no marker) are never offered."""
+        if self._mirror_dir is None:
+            return -1
+        from torchacc_tpu.store.client import list_commits
+        store = self._mirror_store()
+        client = self._mirror_client()
+        try:
+            prefixes = client.retrying(
+                lambda: list_commits(store),
+                description=f"mirror:{self._mirror_dir}: list commits")
+        except Exception:  # noqa: BLE001 - unreachable mirror = no tier 2
+            return -1
+        want = tree_digest(abstract_state)
+        best = -1
+        for p in prefixes:
+            if not p.isdigit():
+                continue
+            marker = read_commit_marker(store, p)
+            if marker is None:
+                continue
+            entry = marker.get("objects", {}).get(MANIFEST)
+            if entry is None:
+                continue
+            try:
+                man = json.loads(client.get(
+                    f"{p}/{MANIFEST}",
+                    sha256=entry.get("sha256")).decode("utf-8"))
+            except Exception:  # noqa: BLE001 - damaged manifest: skip,
+                continue       # the verify pass quarantines loudly
+            got = (man or {}).get("tree", {})
+            if (got.get("leaves") == want["leaves"]
+                    and got.get("digest") == want["digest"]):
+                best = max(best, int(p))
+        return best
+
+    def _verify_mirror_commit(self, step: int) -> None:
+        """Checksum-verify EVERY object of a mirror commit against its
+        marker before orbax reads any of it — marker-without-verified-
+        payload is quarantined (typed), never restored."""
+        store = self._mirror_store()
+        client = self._mirror_client()
+        prefix = str(step)
+        marker = read_commit_marker(store, prefix)
+        if marker is None:
+            raise StoreCommitError(
+                f"mirror step {step}: no commit marker (torn or absent "
+                "upload)", prefix=prefix, torn=True)
+        for name, entry in sorted(marker.get("objects", {}).items()):
+            try:
+                client.get(f"{prefix}/{name}", sha256=entry.get("sha256"))
+            except Exception as e:  # noqa: BLE001 - typed for callers
+                raise StoreCommitError(
+                    f"mirror step {step}: object {name!r} failed "
+                    f"checksum verification ({e!r})",
+                    prefix=prefix) from e
+
+    @staticmethod
     def _newest_validated_fs(directory: Optional[str],
                              abstract_state: Any) -> int:
         """Newest marked step whose manifest digest matches the target
@@ -745,7 +1036,7 @@ class TieredCheckpointManager:
             self._newest_validated_fs(self._dir, abstract_state),
             timeout_s=t, name="tiered-t1-step")
         t2 = coord.min_over_hosts(
-            self._newest_validated_fs(self._mirror_dir, abstract_state),
+            self._newest_validated_mirror(abstract_state),
             timeout_s=t, name="tiered-t2-step") \
             if self._mirror_dir is not None else -1
         if best_ram >= 0 and best_ram >= max(t1, t2):
@@ -771,15 +1062,22 @@ class TieredCheckpointManager:
                     f"failed ({e!r}); falling back to durable tiers")
         if t2 > t1:
             try:
+                # every object checksum-verified against the commit
+                # marker BEFORE orbax reads a byte: a marker blessing
+                # damaged payloads is quarantined here, typed
+                self._verify_mirror_commit(t2)
                 with self._io_lock:
                     state = self._mirror_mgr().restore(abstract_state,
                                                        step=t2)
                 counters.inc("mirror_restores")
                 self._rewind(t2)
                 return state, t2
-            except (CheckpointError,) as e:
+            except (CheckpointError, StoreCommitError) as e:
                 if coord.process_count() > 1:
                     raise
+                # read repair: the newer mirror copy is damaged, the
+                # older-but-sound tier-1/peer-RAM copy serves instead
+                counters.inc("mirror_read_repairs")
                 logger.warning(
                     f"tiered checkpoint: mirror restore of step {t2} "
                     f"failed ({e!r}); falling back to tier 1")
@@ -793,8 +1091,13 @@ class TieredCheckpointManager:
                 if m is None or coord.process_count() > 1:
                     raise
                 # local history burned but the mirror survives: the
-                # long-horizon tier is exactly for this
-                state, step = m.restore_latest_valid(abstract_state)
+                # long-horizon tier is exactly for this.  Same rules
+                # as above — commit-marked, checksum-verified only.
+                best = self._newest_validated_mirror(abstract_state)
+                if best < 0:
+                    raise
+                self._verify_mirror_commit(best)
+                state, step = m.restore(abstract_state, step=best), best
                 counters.inc("mirror_restores")
         self._rewind(step)
         return state, step
@@ -994,13 +1297,13 @@ class TieredCheckpointManager:
                                                  step=step)
             except CheckpointError:
                 m = self._mirror_mgr()
-                if m is None or step is None \
-                        or not os.path.exists(os.path.join(
-                            self._mirror_dir, str(step), MANIFEST)):
+                if m is None or step is None or read_commit_marker(
+                        self._mirror_store(), str(step)) is None:
                     raise
                 logger.warning(
                     f"tiered checkpoint: step {step} unreadable in tier "
                     "1; restoring the mirror copy")
+                self._verify_mirror_commit(step)
                 out = m.restore(abstract_state, step=step)
                 counters.inc("mirror_restores")
                 return out
@@ -1056,10 +1359,9 @@ class TieredCheckpointManager:
         if self._mirror_dir is None:
             return None
         try:
-            with open(os.path.join(self._mirror_dir, str(step),
-                                   fname)) as f:
-                return json.load(f)
-        except (OSError, ValueError):
+            raw = self._mirror_store().get(f"{step}/{fname}")
+            return json.loads(raw.decode("utf-8"))
+        except (OSError, ValueError, UnicodeDecodeError):
             return None
 
     def tier_status(self) -> Dict[str, Any]:
@@ -1070,11 +1372,15 @@ class TieredCheckpointManager:
             wm = self._watermark
         durable = self._fs_valid_steps(self._dir)
         mirrored: List[int] = []
-        if self._mirror_dir is not None and os.path.isdir(self._mirror_dir):
-            mirrored = sorted(
-                int(n) for n in os.listdir(self._mirror_dir)
-                if n.isdigit() and os.path.exists(
-                    os.path.join(self._mirror_dir, n, MANIFEST)))
+        if self._mirror_dir is not None:
+            from torchacc_tpu.store.client import list_commits
+            store = self._mirror_store()
+            try:
+                mirrored = sorted(
+                    int(p) for p in list_commits(store)
+                    if p.isdigit() and store.exists(f"{p}/{MANIFEST}"))
+            except OSError:
+                mirrored = []
         return {"ram": ram, "durable": durable, "mirrored": mirrored,
                 "verdicts_through": wm}
 

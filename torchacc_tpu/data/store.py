@@ -24,15 +24,23 @@ entirely.  This module is the storage half of that pipeline
   ``(seed, shard name, attempt)`` so the same seed yields the same
   fault schedule regardless of fetch order — the property the bitwise
   chaos gates stand on.
-- :class:`StoreClient` — ALL store GETs go through this one path: the
-  shared retry/backoff core (``utils/retry.py``, the same policy object
-  the HTTP client and checkpoint I/O use), checksum verification
-  against the manifest, decode, per-source :class:`CircuitBreaker`
-  bookkeeping, and the ``store_gets`` / ``shard_fetch_retries``
-  counters.  A GET that stays bad across the retry budget raises typed
+- :class:`StoreClient` — ALL store GETs go through the ONE shared
+  retry/verify path (``store/client.py``'s :class:`ObjectStoreClient`
+  — the same client checkpoint tier-2 mirrors and journal archives
+  write through).  This class is the thin data-plane face over it:
+  manifest bookkeeping, decode (→ tokenize for text shards), and the
+  per-source breaker surface ``stream.py`` drives.  A GET that stays
+  bad across the retry budget raises typed
   :class:`~torchacc_tpu.errors.ShardCorruptionError` /
   ``DataLoaderError`` — the caller (``stream.py``) quarantines the
   shard and moves on.
+
+Since PR 19 the backend interface, the chaos fault model, and the
+retry/checksum client live in ``torchacc_tpu/store/``; this module
+keeps the shard codec, the manifest layout, and the data-plane names
+(``ShardStore`` / ``LocalShardStore`` / ``ChaosStore`` /
+``StoreClient``) as thin subclasses so existing imports and tests are
+untouched.
 """
 
 from __future__ import annotations
@@ -41,28 +49,28 @@ import hashlib
 import json
 import os
 import time
-import zlib
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
 from torchacc_tpu.errors import DataLoaderError, ShardCorruptionError
-from torchacc_tpu.resilience.chaos import failpoint
-from torchacc_tpu.utils.logger import logger
-from torchacc_tpu.utils.retry import CircuitBreaker, RetryPolicy, retry_call
+from torchacc_tpu.store.base import (
+    LocalObjectStore,
+    ObjectStore,
+    ThrottleError,
+)
+from torchacc_tpu.store.chaos import ChaosObjectStore
+from torchacc_tpu.store.client import ObjectStoreClient
+from torchacc_tpu.utils.retry import RetryPolicy
+
+__all__ = [
+    "MANIFEST_NAME", "ThrottleError", "encode_shard", "decode_shard",
+    "ShardStore", "LocalShardStore", "write_store", "ChaosStore",
+    "StoreClient",
+]
 
 _MAGIC = b"TASH1\n"
 MANIFEST_NAME = "manifest.json"
-
-
-class ThrottleError(OSError):
-    """An HTTP-429-shaped rejection: the backend is alive but pacing
-    us.  ``retry_after_s`` is honoured by the shared retry core (the
-    backoff sleep is at least that long)."""
-
-    def __init__(self, message: str, retry_after_s: float = 0.05):
-        super().__init__(message)
-        self.retry_after_s = float(retry_after_s)
 
 
 # -- shard codec ---------------------------------------------------------------
@@ -131,25 +139,24 @@ def decode_shard(data: bytes) -> tuple:
 
 # -- stores --------------------------------------------------------------------
 
-class ShardStore:
-    """The GET surface every backend implements: one manifest, byte
-    blobs by name.  Implementations raise ``OSError`` (or subclasses
-    like :class:`ThrottleError`) for transport failures — the
+class ShardStore(ObjectStore):
+    """The data-plane backend surface: the shared five-verb
+    :class:`~torchacc_tpu.store.base.ObjectStore` plus one manifest.
+    Implementations raise ``OSError`` (or subclasses like
+    :class:`ThrottleError`) for transport failures — the
     :class:`StoreClient` owns retries; stores stay retry-free."""
 
     def manifest(self) -> Dict[str, Any]:
         raise NotImplementedError
 
-    def get(self, name: str) -> bytes:
-        raise NotImplementedError
 
-
-class LocalShardStore(ShardStore):
+class LocalShardStore(LocalObjectStore, ShardStore):
     """Directory-backed store: shards are files under ``root``,
-    ``manifest.json`` beside them (what :func:`write_store` lays out)."""
-
-    def __init__(self, root: str):
-        self.root = str(root)
+    ``manifest.json`` beside them (what :func:`write_store` lays
+    out).  The five store verbs come from
+    :class:`~torchacc_tpu.store.base.LocalObjectStore`; shard GETs
+    additionally reject path-shaped names with the data plane's typed
+    error."""
 
     def manifest(self) -> Dict[str, Any]:
         with open(os.path.join(self.root, MANIFEST_NAME)) as f:
@@ -158,8 +165,7 @@ class LocalShardStore(ShardStore):
     def get(self, name: str) -> bytes:
         if os.sep in name or name.startswith("."):
             raise DataLoaderError(f"illegal shard name {name!r}")
-        with open(os.path.join(self.root, name), "rb") as f:
-            return f.read()
+        return LocalObjectStore.get(self, name)
 
 
 def write_store(root: str, docs: Sequence[Any], *, source: str,
@@ -192,29 +198,15 @@ def write_store(root: str, docs: Sequence[Any], *, source: str,
 
 # -- fault injection -----------------------------------------------------------
 
-class ChaosStore(ShardStore):
-    """gs://-shaped fault model around any :class:`ShardStore`.
-
-    Per-shard fault plans are derived once from ``(seed, shard name)``
-    and consumed per GET *attempt*, so the schedule is deterministic
-    under any fetch order and any retry policy:
-
-    - ``transient_rate``: the shard's first 1–2 GETs raise ``OSError``
-      (a 5xx / connection reset), then succeed;
-    - ``throttle_rate``: the first GET raises :class:`ThrottleError`
-      (429 + retry-after), then succeeds;
-    - ``torn_rate``: the first GET returns a SHORT read (truncated
-      bytes — checksum catches it), then succeeds;
-    - ``latency_s`` / ``latency_rate``: the GET sleeps first (the
-      ``data_wait`` SLO regression hook);
-    - ``corrupt_rate`` / ``corrupt_shards``: the payload is bit-flipped
-      on EVERY read — permanent damage, the quarantine path;
-    - ``dead``: every GET raises — a source that fell off the network
-      (the breaker-shed path).
-
-    A shard draws at most one of transient/throttle/torn (priority in
-    that order) so fault budgets stay predictable per shard.
-    """
+class ChaosStore(ChaosObjectStore, ShardStore):
+    """The data-plane face of the shared
+    :class:`~torchacc_tpu.store.chaos.ChaosObjectStore`: the identical
+    (seed, shard name, attempt) fault plans — transient / throttle /
+    torn / latency / corrupt / dead, plus the PR-19 write-side faults —
+    with the manifest verb a :class:`ShardStore` adds.  Kept as its
+    own name because the data chaos gates (and their seeds) predate
+    the shared plane; ``corrupt_shards`` aliases the generic
+    ``corrupt_keys``."""
 
     def __init__(self, inner: ShardStore, *, seed: int = 0,
                  transient_rate: float = 0.0, throttle_rate: float = 0.0,
@@ -222,90 +214,36 @@ class ChaosStore(ShardStore):
                  corrupt_shards: Iterable[str] = (),
                  latency_s: float = 0.0, latency_rate: float = 0.0,
                  dead: bool = False,
-                 sleep: Callable[[float], None] = time.sleep):
-        self.inner = inner
-        self.seed = int(seed)
-        self.transient_rate = float(transient_rate)
-        self.throttle_rate = float(throttle_rate)
-        self.torn_rate = float(torn_rate)
-        self.corrupt_rate = float(corrupt_rate)
-        self.corrupt_shards = set(corrupt_shards)
-        self.latency_s = float(latency_s)
-        self.latency_rate = float(latency_rate)
-        self.dead = bool(dead)
-        self._sleep = sleep
-        self._attempts: Dict[str, int] = {}
-        self.injected: Dict[str, int] = {}   # fault kind -> count
-        self.slept_s = 0.0                   # total injected latency
+                 sleep: Callable[[float], None] = time.sleep,
+                 **write_faults: Any):
+        ChaosObjectStore.__init__(
+            self, inner, seed=seed, transient_rate=transient_rate,
+            throttle_rate=throttle_rate, torn_rate=torn_rate,
+            corrupt_rate=corrupt_rate, corrupt_keys=corrupt_shards,
+            latency_s=latency_s, latency_rate=latency_rate,
+            dead=dead, sleep=sleep, **write_faults)
+
+    @property
+    def corrupt_shards(self) -> set:
+        return self.corrupt_keys
 
     def manifest(self) -> Dict[str, Any]:
         if self.dead:
             raise OSError("chaos: store is dead (manifest)")
         return self.inner.manifest()
 
-    def _plan(self, name: str) -> Dict[str, Any]:
-        import random as _random
-        rng = _random.Random(
-            zlib.crc32(f"{self.seed}|{name}".encode()))
-        r = rng.random()
-        fault, n = None, 0
-        if r < self.transient_rate:
-            fault, n = "transient", 1 + int(rng.random() * 2)
-        elif r < self.transient_rate + self.throttle_rate:
-            fault, n = "throttle", 1
-        elif r < self.transient_rate + self.throttle_rate + self.torn_rate:
-            fault, n = "torn", 1
-        return {
-            "fault": fault, "n": n,
-            "corrupt": (name in self.corrupt_shards
-                        or rng.random() < self.corrupt_rate),
-            "latency": rng.random() < self.latency_rate,
-        }
-
-    def _count(self, kind: str) -> None:
-        self.injected[kind] = self.injected.get(kind, 0) + 1
-
-    def get(self, name: str) -> bytes:
-        if self.dead:
-            self._count("dead")
-            raise OSError(f"chaos: store is dead (GET {name})")
-        plan = self._plan(name)
-        attempt = self._attempts.get(name, 0)
-        self._attempts[name] = attempt + 1
-        if plan["latency"] and attempt == 0:
-            self._count("latency")
-            logger.warning(f"chaos: {self.latency_s:.2f}s latency spike "
-                           f"on GET {name}")
-            self._sleep(self.latency_s)
-            self.slept_s += self.latency_s
-        if plan["fault"] is not None and attempt < plan["n"]:
-            self._count(plan["fault"])
-            if plan["fault"] == "transient":
-                raise OSError(f"chaos: transient store error on GET "
-                              f"{name} (attempt {attempt})")
-            if plan["fault"] == "throttle":
-                raise ThrottleError(
-                    f"chaos: 429 on GET {name} (attempt {attempt})",
-                    retry_after_s=0.01)
-            data = self.inner.get(name)
-            return data[:max(len(data) // 2, 1)]     # torn read
-        data = self.inner.get(name)
-        if plan["corrupt"]:
-            self._count("corrupt")
-            buf = bytearray(data)
-            buf[len(buf) // 2] ^= 0x40               # one flipped bit
-            return bytes(buf)
-        return data
-
 
 # -- the one GET path ----------------------------------------------------------
 
 class StoreClient:
-    """Retrying, checksum-verifying, breaker-tracking shard reader for
-    ONE source.  Every GET: ``store.get`` → sha256 vs manifest → decode
-    (→ tokenize for text shards), all inside the shared retry core; a
-    checksum/decode failure is retried (torn reads are transient), and
-    the LAST failure propagates typed for ``stream.py`` to quarantine.
+    """The data-plane face over the ONE shared retry/verify client
+    (:class:`~torchacc_tpu.store.client.ObjectStoreClient`): manifest
+    bookkeeping, shard decode (→ tokenize for text shards), and the
+    per-source breaker surface ``stream.py`` drives.  Every GET —
+    ``store.get`` → sha256 vs manifest → decode — runs inside the
+    shared retry core; a checksum/decode failure is retried (torn
+    reads are transient), and the LAST failure propagates typed for
+    ``stream.py`` to quarantine.
 
     ``on_wait(seconds)`` fires before every backoff sleep — the
     in-retry heartbeat seam (``AsyncLoader`` reads :attr:`in_retry` so
@@ -320,30 +258,34 @@ class StoreClient:
                  on_wait: Optional[Callable[[float], None]] = None):
         self.store = store
         self.source = str(source)
-        self.policy = policy if policy is not None else RetryPolicy(
-            max_retries=3, base_delay_s=0.05, max_delay_s=1.0,
-            retry_on=(OSError, ShardCorruptionError))
-        self.breaker = CircuitBreaker(failure_threshold=max(
-            int(failure_budget), 1), cooldown_s=breaker_cooldown_s)
         self.tokenize = tokenize
-        self._sleep = sleep
-        self._on_wait = on_wait
-        self._retrying = 0           # threads currently inside a backoff
+        self._client = ObjectStoreClient(
+            store, destination=f"source {source!r}", policy=policy,
+            failure_budget=failure_budget,
+            breaker_cooldown_s=breaker_cooldown_s, sleep=sleep,
+            on_wait=on_wait, get_retry_counter="shard_fetch_retries")
         self._entries: Optional[Dict[str, Dict[str, Any]]] = None
 
     @property
+    def policy(self) -> RetryPolicy:
+        return self._client.policy
+
+    @property
+    def breaker(self):
+        return self._client.breaker
+
+    @property
     def in_retry(self) -> bool:
-        return self._retrying > 0
+        return self._client.in_retry
 
     def manifest_entries(self) -> Dict[str, Dict[str, Any]]:
         """name -> manifest entry, fetched once through the retry
         core (a dead store fails HERE, typed)."""
         if self._entries is None:
             try:
-                man = retry_call(self.store.manifest, policy=self.policy,
-                                 description=f"{self.source}: manifest",
-                                 counter="shard_fetch_retries",
-                                 sleep=self._retry_sleep)
+                man = self._client.retrying(
+                    self.store.manifest,
+                    description=f"{self.source}: manifest")
             except Exception as e:
                 raise DataLoaderError(
                     f"source {self.source!r}: manifest unreadable "
@@ -351,21 +293,11 @@ class StoreClient:
             self._entries = {s["name"]: s for s in man.get("shards", [])}
         return self._entries
 
-    def _retry_sleep(self, seconds: float) -> None:
-        self._retrying += 1
-        try:
-            if self._on_wait is not None:
-                self._on_wait(seconds)
-            self._sleep(seconds)
-        finally:
-            self._retrying -= 1
-
     def get_docs(self, name: str) -> List[Any]:
         """Fetch + verify + decode one shard into its document list.
         Raises :class:`ShardCorruptionError` (persistent corruption) or
         ``OSError`` (transport, retries exhausted); the caller owns the
         quarantine verdict and the breaker's failure edge."""
-        from torchacc_tpu.utils.metrics import counters
         entry = self.manifest_entries().get(name)
         if entry is None:
             raise DataLoaderError(
@@ -373,18 +305,14 @@ class StoreClient:
                 "manifest")
         want_sha = entry.get("sha256")
 
-        def once() -> List[Any]:
-            failpoint("store.get", source=self.source, shard=name)
-            counters.inc("store_gets")
-            data = self.store.get(name)
-            if want_sha is not None:
-                got = hashlib.sha256(data).hexdigest()
-                if got != want_sha:
-                    raise ShardCorruptionError(
-                        f"{self.source}/{name}: sha256 {got[:12]} != "
-                        f"manifest {want_sha[:12]} (torn read or "
-                        "corruption)", source=self.source, shard=name,
-                        reason="checksum mismatch")
+        def mismatch(got: str) -> ShardCorruptionError:
+            return ShardCorruptionError(
+                f"{self.source}/{name}: sha256 {got[:12]} != "
+                f"manifest {want_sha[:12]} (torn read or "
+                "corruption)", source=self.source, shard=name,
+                reason="checksum mismatch")
+
+        def decode(data: bytes) -> List[Any]:
             kind, docs = decode_shard(data)
             if kind == "text":
                 if self.tokenize is None:
@@ -394,15 +322,12 @@ class StoreClient:
                 docs = [self.tokenize(d) for d in docs]
             return [np.asarray(d, np.int32).reshape(-1) for d in docs]
 
-        return retry_call(
-            once, policy=self.policy,
+        return self._client.get(
+            name, sha256=want_sha, decode=decode,
             description=f"{self.source}/{name}: shard fetch",
-            counter="shard_fetch_retries", sleep=self._retry_sleep)
+            mismatch_exc=mismatch)
 
     def record_outcome(self, ok: bool) -> bool:
         """Feed the per-source breaker; returns True on the OPEN edge
         (the stream sheds the source exactly once)."""
-        if ok:
-            self.breaker.record_success()
-            return False
-        return self.breaker.record_failure()
+        return self._client.record_outcome(ok)

@@ -128,6 +128,37 @@ class DataSourceError(DataLoaderError):
         self.consecutive = consecutive
 
 
+class StoreError(TorchAccTPUError):
+    """The shared object-store plane (``torchacc_tpu/store/``) failed.
+
+    Base for the write-side and commit-protocol errors; the read side
+    keeps raising :class:`ShardCorruptionError` / ``OSError`` so the
+    streaming data plane's quarantine taxonomy is unchanged."""
+
+
+class StoreWriteError(StoreError, OSError):
+    """A PUT did not stick: the verify-after-put read-back disagreed
+    with the bytes written (a torn/partial upload, or an object store
+    that acknowledged a write it lost).  ``OSError`` so the shared
+    retry policy treats it as transient — a re-upload usually heals
+    it; retries exhausted means the destination is failing writes."""
+
+
+class StoreCommitError(StoreError):
+    """A two-phase commit under ``prefix`` is unusable: the commit
+    marker is missing (a torn upload — never valid, by protocol), the
+    marker is unparseable, or a payload object disagrees with the
+    marker's sha256 manifest (marker-without-verified-payload — the
+    quarantine case).  Carries the prefix and whether the damage was
+    a missing marker (``torn=True``) or failed verification."""
+
+    def __init__(self, message: str, *, prefix: Optional[str] = None,
+                 torn: bool = False):
+        super().__init__(message)
+        self.prefix = prefix
+        self.torn = torn
+
+
 class CoordinationError(TorchAccTPUError):
     """A cross-host coordination primitive failed or timed out.
 

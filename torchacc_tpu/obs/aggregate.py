@@ -79,6 +79,23 @@ DATA_PLANE_COUNTERS = (
     "resume_replayed_batches",
 )
 
+#: shared object-store plane health (torchacc_tpu/store/): the write
+#: side of the durable-artifact path — checkpoint tier-2 mirrors, data
+#: shards, journal archives.  Surfaced per-host (torchacc_store_*) and
+#: as fleet totals so a dying object store is visible from the
+#: daemon's single pane of glass before restores start failing.
+STORE_COUNTERS = (
+    "store_puts",
+    "store_put_retries",
+    "store_put_failures",
+    "store_put_bytes",
+    "mirror_read_repairs",
+    "mirror_skips",
+    "store_breaker_open",
+    "journal_archive_uploads",
+    "journal_archive_upload_failures",
+)
+
 #: the histogram the drift detector baselines on
 _STEP_HIST = "step_time_ms"
 
@@ -562,6 +579,17 @@ class FleetAggregator:
                 if name in per_host[h]:
                     lines.append(
                         f'{m}{{host="{h}"}} {per_host[h][name]!r}')
+        # per-host object-store plane: one bad uplink looks like a
+        # fleet-wide put_failures bump until the host label splits it
+        for name in STORE_COUNTERS:
+            if not any(name in c for c in per_host.values()):
+                continue
+            m = f"torchacc_store_{name}"
+            lines.append(f"# TYPE {m} counter")
+            for h in sorted(per_host):
+                if name in per_host[h]:
+                    lines.append(
+                        f'{m}{{host="{h}"}} {per_host[h][name]!r}')
         # merged histograms
         for name in sorted(hists):
             lines.extend(hists[name].prometheus_lines(
@@ -636,6 +664,19 @@ class FleetAggregator:
                              if n in DATA_PLANE_COUNTERS}
                     for h in per_host_counters
                     if any(n in DATA_PLANE_COUNTERS
+                           for n in per_host_counters[h])},
+            },
+            # object-store plane rollup: fleet totals + per-host split
+            # for the shared PUT/GET client (checkpoint tier-2 mirror,
+            # data shards, journal archives)
+            "store": {
+                "totals": {n: counters[n] for n in STORE_COUNTERS
+                           if n in counters},
+                "per_host": {
+                    str(h): {n: v for n, v in per_host_counters[h].items()
+                             if n in STORE_COUNTERS}
+                    for h in per_host_counters
+                    if any(n in STORE_COUNTERS
                            for n in per_host_counters[h])},
             },
         }

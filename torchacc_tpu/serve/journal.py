@@ -128,11 +128,22 @@ class RequestJournal:
     (or briefly both) on disk — never neither — so accounting across a
     rotation boundary stays 100%.  Readers take the union via
     :func:`journal_files`.
+
+    **Archive upload** (``archive_store``): after a rotation completes
+    locally, the rotation's terminal records also upload to an object
+    store as one two-phase commit through the shared store client
+    (``torchacc_tpu/store/``) — the off-host copy of the dedupe
+    history.  The upload strictly FOLLOWS local durability and its
+    failure is isolated (breaker-gated, counted, never raised), so a
+    kill -9 between rotation and upload loses nothing: the local union
+    replay stays 100% and the store merely misses one segment's copy.
     """
 
     def __init__(self, journal_dir: str, *, fsync: bool = True,
                  rotate_bytes: Optional[int] = None,
-                 rotate_age_s: Optional[float] = None):
+                 rotate_age_s: Optional[float] = None,
+                 archive_store: Any = None,
+                 archive_prefix: str = "journal-archive"):
         self.dir = journal_dir
         self.path = os.path.join(journal_dir, JOURNAL_NAME)
         self.fsync = bool(fsync)
@@ -141,6 +152,21 @@ class RequestJournal:
         self.rotate_age_s = (None if not rotate_age_s
                              else max(float(rotate_age_s), 0.001))
         self.rotations = 0
+        # optional off-host archive tier: each rotation's terminal
+        # records upload as one two-phase commit through the shared
+        # object-store client (``torchacc_tpu/store/``).  Strictly a
+        # follower of the local compaction — an upload failure (or a
+        # kill -9 between rotation and upload) never loses a record,
+        # because the local archive/segment union stays authoritative.
+        self.archive_prefix = str(archive_prefix).strip("/")
+        self.archive_uploads = 0
+        self._archive_seq: Optional[int] = None  # probed from the store
+        self._archive_client = None
+        if archive_store is not None:
+            from torchacc_tpu.store.client import ObjectStoreClient
+            self._archive_client = ObjectStoreClient(
+                archive_store,
+                destination=f"journal-archive:{journal_dir}")
         os.makedirs(journal_dir, exist_ok=True)
         self._f = open(self.path, "ab")
         try:
@@ -276,6 +302,61 @@ class RequestJournal:
             f"{os.path.basename(seg)} — {len(completed) + len(shed)} "
             f"terminal record(s) archived, {len(pending)} pending "
             "admission(s) carried forward")
+        self._upload_archive(seg, list(completed.values())
+                             + list(shed.values()))
+
+    def _upload_archive(self, seg_path: str,
+                        terminals: List[Dict[str, Any]]) -> None:
+        """Upload one rotation's terminal records as a two-phase
+        commit (``<archive_prefix>/<seq>/terminals.jsonl`` +
+        ``_COMMIT``).  Isolated failure domain: the local rotation
+        already succeeded, so a failing store costs only the off-host
+        copy — never the rotation, never a record.  An OPEN destination
+        breaker skips the upload cheaply; recovery is probed on the
+        half-open schedule.
+
+        The commit prefix is a monotone sequence probed from the store
+        on first upload — NOT the local segment name, which recycles
+        (segments are unlinked after compaction, so every rotation
+        produces ``journal-00001.jsonl``); reusing it would overwrite
+        the previous rotation's archive instead of accumulating.  A
+        failed upload keeps its sequence number (no marker landed, so
+        the retry next rotation replaces nothing)."""
+        client = self._archive_client
+        if client is None or not terminals:
+            return
+        from torchacc_tpu.utils.metrics import counters
+        if not client.should_attempt():
+            counters.inc("journal_archive_skips")
+            return
+        from torchacc_tpu.store.client import list_commits, put_commit
+        payload = b"".join(
+            (json.dumps(rec, allow_nan=False,
+                        separators=(",", ":")) + "\n").encode()
+            for rec in terminals)
+        try:
+            if self._archive_seq is None:
+                existing = [int(p.rsplit("/", 1)[-1])
+                            for p in list_commits(client.store,
+                                                  self.archive_prefix)
+                            if p.rsplit("/", 1)[-1].isdigit()]
+                self._archive_seq = max(existing, default=0) + 1
+            name = f"{self._archive_seq:05d}"
+            put_commit(client, f"{self.archive_prefix}/{name}",
+                       {"terminals.jsonl": payload},
+                       meta={"segment": os.path.basename(seg_path),
+                             "records": len(terminals)})
+        except Exception as e:  # noqa: BLE001 - never fail a rotation
+            client.record_outcome(False)
+            counters.inc("journal_archive_upload_failures")
+            logger.warning(
+                f"request journal {self.path}: archive upload failed "
+                f"({e!r}); the local archive remains authoritative")
+            return
+        client.record_outcome(True)
+        self._archive_seq += 1
+        self.archive_uploads += 1
+        counters.inc("journal_archive_uploads")
 
     def accepted(self, *, rid: int, trace_id: str, prompt_ids,
                  max_new_tokens: int, temperature: float, top_k: int,
@@ -383,3 +464,31 @@ def replay_state(records: List[Dict[str, Any]]
     pending = {rid: rec for rid, rec in accepted.items()
                if rid not in completed and rid not in shed}
     return pending, completed, shed
+
+
+def read_archived_terminals(store: Any, *,
+                            prefix: str = "journal-archive"
+                            ) -> List[Dict[str, Any]]:
+    """Terminal records from an off-host archive store (what
+    :class:`RequestJournal` uploaded on rotation), commit-marked
+    uploads only — a torn upload is invisible here by the two-phase
+    protocol.  Disaster-recovery/audit reader; live recovery keeps
+    using the local :func:`journal_files` union, which is always a
+    superset."""
+    from torchacc_tpu.store.client import list_commits
+    records: List[Dict[str, Any]] = []
+    for p in list_commits(store, prefix):
+        try:
+            raw = store.get(f"{p}/terminals.jsonl")
+        except OSError:
+            continue
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("kind") in KINDS:
+                records.append(rec)
+    return records

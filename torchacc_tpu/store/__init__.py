@@ -1,0 +1,46 @@
+"""Shared fault-tolerant object-store plane.
+
+One ``ObjectStore`` interface, one retrying/checksumming
+``ObjectStoreClient`` for BOTH directions, and one two-phase commit
+protocol — used by checkpoint tier-2 mirrors (``checkpoint/tiered.py``),
+streaming data shards (``data/store.py``), and serve journal archives
+(``serve/journal.py``).  See ``store/base.py`` and ``store/client.py``.
+"""
+
+from torchacc_tpu.store.base import (
+    GCSObjectStore,
+    LocalObjectStore,
+    ObjectStore,
+    ThrottleError,
+    open_store,
+)
+from torchacc_tpu.store.chaos import ChaosObjectStore
+from torchacc_tpu.store.client import (
+    COMMIT_MARKER,
+    ObjectStoreClient,
+    commit_marker_key,
+    list_commits,
+    put_commit,
+    read_commit,
+    read_commit_marker,
+    sha256_hex,
+    verify_commit,
+)
+
+__all__ = [
+    "COMMIT_MARKER",
+    "ChaosObjectStore",
+    "GCSObjectStore",
+    "LocalObjectStore",
+    "ObjectStore",
+    "ObjectStoreClient",
+    "ThrottleError",
+    "commit_marker_key",
+    "list_commits",
+    "open_store",
+    "put_commit",
+    "read_commit",
+    "read_commit_marker",
+    "sha256_hex",
+    "verify_commit",
+]

@@ -1,0 +1,191 @@
+"""Object-store backends: the PUT/GET surface every durable artifact
+rides on.
+
+One interface (:class:`ObjectStore`), deliberately tiny — five verbs, a
+flat ``/``-separated key namespace, bytes in and bytes out.  Transport
+failures are ``OSError`` (or subclasses like :class:`ThrottleError`);
+backends stay retry-free because the ONE retrying/verifying client
+(``store/client.py``) owns backoff, checksums, and breakers for every
+consumer: checkpoint tier-2 mirrors, streaming data shards, and serve
+journal archives.
+
+- :class:`LocalObjectStore` — directory-backed reference backend.  Key
+  segments map to subdirectories; PUTs are atomic (tmp + ``os.replace``)
+  so a crashed writer leaves either the old object or the new one,
+  never a torn file.  This is what backs tier-2 mirrors and the chaos
+  gates on a single machine.
+- :class:`GCSObjectStore` — the typed gs:// stub, constructible so
+  configs naming a bucket parse and fail with guidance at first I/O
+  (the ``GKEProvisioner`` idiom).  Real GCS semantics (resumable
+  uploads, generation preconditions) land behind this exact surface.
+
+Stdlib-only, no jax, no numpy — the serve journal imports this on
+hosts that never initialise a device backend.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+from torchacc_tpu.errors import StoreError
+
+
+class ThrottleError(OSError):
+    """An HTTP-429-shaped rejection: the backend is alive but pacing
+    us.  ``retry_after_s`` is honoured by the shared retry core (the
+    backoff sleep is at least that long)."""
+
+    def __init__(self, message: str, retry_after_s: float = 0.05):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class ObjectStore:
+    """The five-verb surface every backend implements.
+
+    Keys are ``/``-separated paths (``"18/_COMMIT"``,
+    ``"journal-archive/00003/terminals.jsonl"``); backends may treat
+    the separator as a real hierarchy (local directories) or a flat
+    prefix (GCS).  Implementations raise ``OSError`` for transport
+    failures and must make :meth:`put` atomic per object — a reader
+    never observes a half-written object (torn *multi-object* states
+    are the commit protocol's job, in ``store/client.py``)."""
+
+    def put(self, name: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, name: str) -> bytes:
+        raise NotImplementedError
+
+    def list(self, prefix: str = "") -> List[str]:
+        """All keys starting with ``prefix``, sorted."""
+        raise NotImplementedError
+
+    def delete(self, name: str) -> None:
+        """Remove an object; missing objects are a no-op (deletes are
+        used for repair/replace paths, which must be idempotent)."""
+        raise NotImplementedError
+
+    def exists(self, name: str) -> bool:
+        raise NotImplementedError
+
+
+def _check_key(name: str) -> List[str]:
+    """Validate a store key and split it into path segments.  Rejects
+    absolute paths, ``..``, empty segments, and hidden segments — a
+    key can never escape the store root or shadow control files."""
+    if not name or name.startswith("/") or name.endswith("/"):
+        raise StoreError(f"illegal store key {name!r}")
+    parts = name.split("/")
+    for p in parts:
+        # "."-prefixed segments are reserved for backend temp files
+        if not p or p == ".." or p.startswith("."):
+            raise StoreError(f"illegal store key {name!r}")
+    return parts
+
+
+class LocalObjectStore(ObjectStore):
+    """Directory-backed store: objects are files under ``root``, key
+    segments are subdirectories.  PUT writes a dot-prefixed temp file
+    beside the target and ``os.replace``-publishes it, so every object
+    is individually atomic and crash-safe."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(str(root))
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.root, *_check_key(name))
+
+    def put(self, name: str, data: bytes) -> None:
+        path = self._path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = os.path.join(os.path.dirname(path),
+                           f".{os.path.basename(path)}.tmp{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(bytes(data))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get(self, name: str) -> bytes:
+        with open(self._path(name), "rb") as f:
+            return f.read()
+
+    def list(self, prefix: str = "") -> List[str]:
+        out: List[str] = []
+        if not os.path.isdir(self.root):
+            return out
+        for dirpath, _dirnames, filenames in os.walk(self.root):
+            rel = os.path.relpath(dirpath, self.root)
+            for fn in filenames:
+                if fn.startswith("."):
+                    continue  # in-flight temp files are not objects
+                key = fn if rel == "." else "/".join(
+                    rel.split(os.sep) + [fn])
+                if key.startswith(prefix):
+                    out.append(key)
+        return sorted(out)
+
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            pass
+
+    def exists(self, name: str) -> bool:
+        return os.path.isfile(self._path(name))
+
+
+class GCSObjectStore(ObjectStore):
+    """Typed gs:// stub — constructible so a config naming a bucket
+    parses, validates, and shows up in ``describe()``-style tooling;
+    every I/O verb raises ``NotImplementedError`` with guidance (the
+    ``GKEProvisioner`` idiom).  The real backend is tensorstore/GCS
+    JSON-API PUTs with generation preconditions behind this exact
+    five-verb surface; nothing upstream (client, commit protocol,
+    consumers) changes when it lands."""
+
+    def __init__(self, url: str):
+        if not str(url).startswith("gs://"):
+            raise StoreError(
+                f"GCSObjectStore expects a gs://bucket[/prefix] url, "
+                f"got {url!r}")
+        rest = str(url)[len("gs://"):].strip("/")
+        if not rest:
+            raise StoreError("GCSObjectStore: empty bucket name")
+        self.bucket, _, self.prefix = rest.partition("/")
+        self.url = f"gs://{self.bucket}" + (
+            f"/{self.prefix}" if self.prefix else "")
+
+    def _unimplemented(self, verb: str) -> NotImplementedError:
+        return NotImplementedError(
+            f"GCSObjectStore.{verb} ({self.url}): real GCS transport is "
+            "not wired in this environment. Point the consumer at a "
+            "LocalObjectStore root (e.g. a gcsfuse mount) or implement "
+            "this backend over tensorstore/google-cloud-storage — the "
+            "five-verb ObjectStore surface is the only contract.")
+
+    def put(self, name: str, data: bytes) -> None:
+        raise self._unimplemented("put")
+
+    def get(self, name: str) -> bytes:
+        raise self._unimplemented("get")
+
+    def list(self, prefix: str = "") -> List[str]:
+        raise self._unimplemented("list")
+
+    def delete(self, name: str) -> None:
+        raise self._unimplemented("delete")
+
+    def exists(self, name: str) -> bool:
+        raise self._unimplemented("exists")
+
+
+def open_store(spec: str) -> ObjectStore:
+    """Backend from a destination spec: ``gs://bucket/prefix`` builds
+    the (stub) GCS backend, anything else is a local directory root —
+    the one place the scheme decision lives."""
+    if str(spec).startswith("gs://"):
+        return GCSObjectStore(spec)
+    return LocalObjectStore(spec)

@@ -1,0 +1,217 @@
+"""Deterministic gs://-shaped fault injection for the object-store
+plane — both directions.
+
+PR 18's ``ChaosStore`` proved the READ half: per-key fault plans
+derived once from ``(seed, key)`` and consumed per *attempt*, so the
+schedule is deterministic under any fetch order and any retry policy.
+:class:`ChaosObjectStore` keeps that read model byte-identical (same
+crc32 seed derivation, same priority, same counters) and extends it to
+the WRITE side, where a real object store fails differently:
+
+- ``put_transient_rate`` — the key's first 1–2 PUTs raise ``OSError``
+  (a 5xx mid-upload / connection reset) before any byte lands;
+- ``put_partial_rate`` — the first PUT writes a TRUNCATED object to
+  the backend and then raises (a multipart upload that died mid-
+  flight: the backend holds torn bytes until a retry overwrites them
+  — verify-after-put and the commit-marker sha256s are what make this
+  survivable);
+- ``put_lost_rate`` — the first PUT is acknowledged but never stored
+  (the commit-marker-lost case: without read-back verification the
+  writer believes the marker exists);
+- ``lose_keys`` — PUTs of these exact keys are ALWAYS swallowed —
+  permanent write loss, the path that must leave a commit invisible
+  rather than torn;
+- ``stale_list_reads`` — the first N ``list()`` calls omit every
+  object uploaded through this wrapper (gs:// listings are eventually
+  consistent; commit discovery must tolerate them);
+- ``dead`` — every verb raises: the destination fell off the network
+  (the breaker-degradation path).
+
+Write plans draw from an independent rng stream
+(``crc32(f"{seed}|put|{key}")``) so enabling write faults never
+perturbs the read schedule a seed was chosen for.  Faults are counted
+in :attr:`injected` (kind → count) for test assertions.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set
+
+from torchacc_tpu.store.base import ObjectStore, ThrottleError
+from torchacc_tpu.utils.logger import logger
+
+
+class ChaosObjectStore(ObjectStore):
+    """Fault-injecting wrapper around any :class:`ObjectStore`; see
+    the module docstring for the fault model.  A key draws at most one
+    read fault (transient > throttle > torn) and at most one write
+    fault (put-transient > partial > lost), so fault budgets stay
+    predictable per key."""
+
+    def __init__(self, inner: ObjectStore, *, seed: int = 0,
+                 transient_rate: float = 0.0, throttle_rate: float = 0.0,
+                 torn_rate: float = 0.0, corrupt_rate: float = 0.0,
+                 corrupt_keys: Iterable[str] = (),
+                 latency_s: float = 0.0, latency_rate: float = 0.0,
+                 put_transient_rate: float = 0.0,
+                 put_partial_rate: float = 0.0,
+                 put_lost_rate: float = 0.0,
+                 lose_keys: Iterable[str] = (),
+                 stale_list_reads: int = 0,
+                 dead: bool = False,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self.seed = int(seed)
+        self.transient_rate = float(transient_rate)
+        self.throttle_rate = float(throttle_rate)
+        self.torn_rate = float(torn_rate)
+        self.corrupt_rate = float(corrupt_rate)
+        self.corrupt_keys = set(corrupt_keys)
+        self.latency_s = float(latency_s)
+        self.latency_rate = float(latency_rate)
+        self.put_transient_rate = float(put_transient_rate)
+        self.put_partial_rate = float(put_partial_rate)
+        self.put_lost_rate = float(put_lost_rate)
+        self.lose_keys = set(lose_keys)
+        self.stale_list_reads = int(stale_list_reads)
+        self.dead = bool(dead)
+        self._sleep = sleep
+        self._attempts: Dict[str, int] = {}      # per-key GET attempts
+        self._put_attempts: Dict[str, int] = {}  # per-key PUT attempts
+        self._list_calls = 0
+        self._recent_puts: Set[str] = set()      # uploaded via this wrapper
+        self.injected: Dict[str, int] = {}       # fault kind -> count
+        self.slept_s = 0.0                       # total injected latency
+
+    def _count(self, kind: str) -> None:
+        self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    # -- plans (pure functions of (seed, key)) -------------------------------
+    def _plan(self, name: str) -> Dict[str, Any]:
+        """READ fault plan — identical derivation to the PR-18 data
+        ``ChaosStore`` so a seed chosen for the data gates keeps its
+        schedule here."""
+        import random as _random
+        rng = _random.Random(
+            zlib.crc32(f"{self.seed}|{name}".encode()))
+        r = rng.random()
+        fault, n = None, 0
+        if r < self.transient_rate:
+            fault, n = "transient", 1 + int(rng.random() * 2)
+        elif r < self.transient_rate + self.throttle_rate:
+            fault, n = "throttle", 1
+        elif r < self.transient_rate + self.throttle_rate + self.torn_rate:
+            fault, n = "torn", 1
+        return {
+            "fault": fault, "n": n,
+            "corrupt": (name in self.corrupt_keys
+                        or rng.random() < self.corrupt_rate),
+            "latency": rng.random() < self.latency_rate,
+        }
+
+    def _put_plan(self, name: str) -> Dict[str, Any]:
+        """WRITE fault plan, from an independent rng stream so write
+        faults never perturb the read schedule."""
+        import random as _random
+        rng = _random.Random(
+            zlib.crc32(f"{self.seed}|put|{name}".encode()))
+        r = rng.random()
+        fault, n = None, 0
+        if r < self.put_transient_rate:
+            fault, n = "put_transient", 1 + int(rng.random() * 2)
+        elif r < self.put_transient_rate + self.put_partial_rate:
+            fault, n = "put_partial", 1
+        elif r < (self.put_transient_rate + self.put_partial_rate
+                  + self.put_lost_rate):
+            fault, n = "put_lost", 1
+        return {"fault": fault, "n": n}
+
+    # -- verbs ---------------------------------------------------------------
+    def get(self, name: str) -> bytes:
+        if self.dead:
+            self._count("dead")
+            raise OSError(f"chaos: store is dead (GET {name})")
+        plan = self._plan(name)
+        attempt = self._attempts.get(name, 0)
+        self._attempts[name] = attempt + 1
+        if plan["latency"] and attempt == 0:
+            self._count("latency")
+            logger.warning(f"chaos: {self.latency_s:.2f}s latency spike "
+                           f"on GET {name}")
+            self._sleep(self.latency_s)
+            self.slept_s += self.latency_s
+        if plan["fault"] is not None and attempt < plan["n"]:
+            self._count(plan["fault"])
+            if plan["fault"] == "transient":
+                raise OSError(f"chaos: transient store error on GET "
+                              f"{name} (attempt {attempt})")
+            if plan["fault"] == "throttle":
+                raise ThrottleError(
+                    f"chaos: 429 on GET {name} (attempt {attempt})",
+                    retry_after_s=0.01)
+            data = self.inner.get(name)
+            return data[:max(len(data) // 2, 1)]     # torn read
+        data = self.inner.get(name)
+        if plan["corrupt"]:
+            self._count("corrupt")
+            buf = bytearray(data)
+            buf[len(buf) // 2] ^= 0x40               # one flipped bit
+            return bytes(buf)
+        return data
+
+    def put(self, name: str, data: bytes) -> None:
+        if self.dead:
+            self._count("dead")
+            raise OSError(f"chaos: store is dead (PUT {name})")
+        if name in self.lose_keys:
+            self._count("put_lost")
+            self._recent_puts.add(name)
+            return                                   # swallowed forever
+        plan = self._put_plan(name)
+        attempt = self._put_attempts.get(name, 0)
+        self._put_attempts[name] = attempt + 1
+        if plan["fault"] is not None and attempt < plan["n"]:
+            self._count(plan["fault"])
+            if plan["fault"] == "put_transient":
+                raise OSError(f"chaos: transient store error on PUT "
+                              f"{name} (attempt {attempt})")
+            if plan["fault"] == "put_partial":
+                # the multipart upload died mid-flight: the backend
+                # keeps the torn bytes until a retry overwrites them
+                self.inner.put(name, bytes(data)[:max(len(data) // 2, 1)])
+                self._recent_puts.add(name)
+                raise OSError(f"chaos: connection lost mid-PUT {name} "
+                              f"(attempt {attempt}; torn object left "
+                              "behind)")
+            # put_lost: acknowledged, never stored
+            self._recent_puts.add(name)
+            return
+        self.inner.put(name, data)
+        self._recent_puts.add(name)
+
+    def list(self, prefix: str = "") -> List[str]:
+        if self.dead:
+            self._count("dead")
+            raise OSError(f"chaos: store is dead (LIST {prefix!r})")
+        out = self.inner.list(prefix)
+        self._list_calls += 1
+        if self._list_calls <= self.stale_list_reads:
+            stale = [k for k in out if k not in self._recent_puts]
+            if len(stale) != len(out):
+                self._count("stale_list")
+            return stale
+        return out
+
+    def delete(self, name: str) -> None:
+        if self.dead:
+            self._count("dead")
+            raise OSError(f"chaos: store is dead (DELETE {name})")
+        self.inner.delete(name)
+
+    def exists(self, name: str) -> bool:
+        if self.dead:
+            self._count("dead")
+            raise OSError(f"chaos: store is dead (EXISTS {name})")
+        return self.inner.exists(name)

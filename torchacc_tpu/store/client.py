@@ -1,0 +1,351 @@
+"""The ONE checksummed, retrying, breaker-tracked PUT/GET path — and
+the two-phase atomic-commit protocol on top of it.
+
+PR 18 built the read half of this (``data/store.py``'s shard client);
+every durable WRITE still bypassed it.  This module is the shared
+client both directions go through, for every consumer (data shards,
+checkpoint tier-2 mirrors, journal archives):
+
+- **GET** — ``store.get`` → sha256 vs the expected digest → decode,
+  all INSIDE the retried callable, so a torn read is retried as the
+  transient it usually is and only persistent corruption propagates
+  (typed :class:`~torchacc_tpu.errors.ShardCorruptionError`).
+- **PUT** — write, then read back and sha256-verify INSIDE the retried
+  callable (an object store that acknowledges a write it lost — or
+  tore — fails verification and is re-uploaded;
+  :class:`~torchacc_tpu.errors.StoreWriteError` is an ``OSError`` so
+  the shared policy retries it).
+- **Breaker** — one :class:`~torchacc_tpu.utils.retry.CircuitBreaker`
+  per destination.  Callers gate expensive work on
+  :meth:`ObjectStoreClient.should_attempt` (an OPEN breaker skips the
+  upload cheaply; the half-open schedule grants the probe) and feed
+  outcomes back via :meth:`ObjectStoreClient.record_outcome` (the OPEN
+  edge increments ``store_breaker_open`` exactly once).
+
+**Two-phase commit** (:func:`put_commit` / :func:`read_commit` /
+:func:`verify_commit` / :func:`list_commits`): payload objects first —
+each individually verified — then one ``_COMMIT`` marker naming every
+object with its byte size and sha256.  Readers treat the marker as the
+unit of visibility: no marker → the prefix does not exist (a torn
+upload is invisible by protocol, the tier-1 ``_MANIFEST`` rule applied
+to object stores); marker whose payloads fail verification → typed
+:class:`~torchacc_tpu.errors.StoreCommitError`, the quarantine case.
+
+Counters: ``store_gets`` / ``store_puts`` / ``store_put_bytes`` per
+attempt-free operation, ``store_put_retries`` per retried PUT attempt
+(GET retry counters are caller-named — the data plane keeps its
+``shard_fetch_retries``), ``store_put_failures`` per PUT that
+exhausted its budget, ``store_breaker_open`` per open edge.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from torchacc_tpu.errors import (
+    ShardCorruptionError,
+    StoreCommitError,
+    StoreWriteError,
+)
+from torchacc_tpu.resilience.chaos import failpoint
+from torchacc_tpu.store.base import ObjectStore
+from torchacc_tpu.utils.logger import logger
+from torchacc_tpu.utils.metrics import counters
+from torchacc_tpu.utils.retry import CircuitBreaker, RetryPolicy, retry_call
+
+#: The commit-marker object name under a commit prefix.  Underscore-
+#: prefixed like the tier-1 ``_MANIFEST`` so it sorts apart from
+#: payloads and can never collide with a validated store key's first
+#: character class used by backends' temp files.
+COMMIT_MARKER = "_COMMIT"
+
+#: One default policy instance shared by every client (frozen).
+DEFAULT_POLICY = RetryPolicy(
+    max_retries=3, base_delay_s=0.05, max_delay_s=1.0,
+    retry_on=(OSError, ShardCorruptionError))
+
+
+def sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def commit_marker_key(prefix: str) -> str:
+    return f"{prefix.rstrip('/')}/{COMMIT_MARKER}"
+
+
+class ObjectStoreClient:
+    """Retrying, checksum-verifying, breaker-tracking client for ONE
+    destination (a source bucket, a mirror root, an archive prefix).
+
+    ``on_wait(seconds)`` fires before every backoff sleep — the
+    in-retry heartbeat seam (:attr:`in_retry` tells watchdogs "slow
+    but alive").  ``sleep`` / ``policy`` are injectable so chaos tests
+    run in microseconds.  Transfer accounting (:attr:`put_bytes`,
+    :attr:`put_ms`, :attr:`get_bytes`) feeds bench/fleet reporting."""
+
+    def __init__(self, store: ObjectStore, *, destination: str = "store",
+                 policy: Optional[RetryPolicy] = None,
+                 failure_budget: int = 3,
+                 breaker_cooldown_s: float = 30.0,
+                 verify_puts: bool = True,
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_wait: Optional[Callable[[float], None]] = None,
+                 get_retry_counter: str = "store_get_retries"):
+        self.store = store
+        self.destination = str(destination)
+        self.policy = policy if policy is not None else DEFAULT_POLICY
+        self.breaker = CircuitBreaker(
+            failure_threshold=max(int(failure_budget), 1),
+            cooldown_s=breaker_cooldown_s)
+        self.verify_puts = bool(verify_puts)
+        self._sleep = sleep
+        self._on_wait = on_wait
+        self._get_counter = get_retry_counter
+        self._retrying = 0           # threads currently inside a backoff
+        self.put_bytes = 0
+        self.get_bytes = 0
+        self.put_ms = 0.0
+        self.puts = 0
+
+    # -- retry plumbing ------------------------------------------------------
+    @property
+    def in_retry(self) -> bool:
+        return self._retrying > 0
+
+    def _retry_sleep(self, seconds: float) -> None:
+        self._retrying += 1
+        try:
+            if self._on_wait is not None:
+                self._on_wait(seconds)
+            self._sleep(seconds)
+        finally:
+            self._retrying -= 1
+
+    def retrying(self, fn: Callable[[], Any], *, description: str,
+                 counter: Optional[str] = None) -> Any:
+        """Run an arbitrary store operation (manifest fetch, list)
+        through this destination's retry core."""
+        return retry_call(fn, policy=self.policy, description=description,
+                          counter=counter if counter is not None
+                          else self._get_counter,
+                          sleep=self._retry_sleep)
+
+    # -- the one GET ---------------------------------------------------------
+    def get(self, name: str, *, sha256: Optional[str] = None,
+            decode: Optional[Callable[[bytes], Any]] = None,
+            description: Optional[str] = None,
+            counter: Optional[str] = None,
+            mismatch_exc: Optional[Callable[[str], Exception]] = None
+            ) -> Any:
+        """Fetch one object; verify against ``sha256`` and ``decode``
+        INSIDE the retried callable (torn reads and transient decode
+        failures retry; the LAST failure propagates typed).
+        ``mismatch_exc(got_sha)`` lets callers keep their own typed
+        corruption error (the data plane's per-shard
+        :class:`ShardCorruptionError` carries source/shard names)."""
+
+        def once() -> Any:
+            failpoint("store.get", destination=self.destination, key=name)
+            counters.inc("store_gets")
+            data = self.store.get(name)
+            if sha256 is not None:
+                got = sha256_hex(data)
+                if got != sha256:
+                    if mismatch_exc is not None:
+                        raise mismatch_exc(got)
+                    raise ShardCorruptionError(
+                        f"{self.destination}: GET {name} sha256 "
+                        f"{got[:12]} != expected {sha256[:12]} (torn "
+                        "read or corruption)", shard=name,
+                        reason="checksum mismatch")
+            self.get_bytes += len(data)
+            return decode(data) if decode is not None else data
+
+        return retry_call(
+            once, policy=self.policy,
+            description=description or f"{self.destination}: GET {name}",
+            counter=counter if counter is not None else self._get_counter,
+            sleep=self._retry_sleep)
+
+    # -- the one PUT ---------------------------------------------------------
+    def put(self, name: str, data: bytes,
+            *, verify: Optional[bool] = None) -> str:
+        """Upload one object and (by default) read it back and verify
+        its sha256 INSIDE the retried callable — an acknowledged-but-
+        lost or partial upload fails verification and is re-uploaded.
+        Returns the payload sha256 (callers build commit markers from
+        it).  Retries exhausted → ``store_put_failures`` and the last
+        error propagates (``OSError``-shaped)."""
+        data = bytes(data)
+        want = sha256_hex(data)
+        do_verify = self.verify_puts if verify is None else bool(verify)
+
+        def once() -> None:
+            failpoint("store.put", destination=self.destination, key=name)
+            self.store.put(name, data)
+            if do_verify:
+                back = self.store.get(name)
+                if sha256_hex(back) != want:
+                    raise StoreWriteError(
+                        f"{self.destination}: PUT {name} read back "
+                        f"{len(back)} bytes with sha256 "
+                        f"{sha256_hex(back)[:12]} != written {want[:12]} "
+                        "(partial or lost upload)")
+
+        t0 = time.perf_counter()
+        try:
+            retry_call(
+                once, policy=self.policy,
+                description=f"{self.destination}: PUT {name}",
+                counter="store_put_retries", sleep=self._retry_sleep)
+        except Exception:
+            counters.inc("store_put_failures")
+            raise
+        finally:
+            self.put_ms += (time.perf_counter() - t0) * 1e3
+        counters.inc("store_puts")
+        counters.inc("store_put_bytes", len(data))
+        self.puts += 1
+        self.put_bytes += len(data)
+        return want
+
+    # -- breaker -------------------------------------------------------------
+    def should_attempt(self) -> bool:
+        """Cheap admission gate for expensive operations: a CLOSED
+        breaker admits, an OPEN one skips until the cooldown grants
+        the half-open probe (that probe attempt IS the recovery
+        schedule)."""
+        return self.breaker.routable or self.breaker.should_probe()
+
+    def record_outcome(self, ok: bool) -> bool:
+        """Feed the destination breaker; returns True on the OPEN edge
+        (callers shed/degrade exactly once).  The open edge is counted
+        (``store_breaker_open``) so a dying store shows on /metrics."""
+        if ok:
+            if self.breaker.record_success():
+                logger.info(
+                    f"store: destination {self.destination!r} readmitted "
+                    "(breaker closed)")
+            return False
+        opened = self.breaker.record_failure()
+        if opened:
+            counters.inc("store_breaker_open")
+            logger.warning(
+                f"store: destination {self.destination!r} breaker OPEN "
+                f"after {self.breaker.failures} consecutive failures; "
+                f"probing again in {self.breaker.cooldown_s:.0f}s")
+        return opened
+
+
+# -- two-phase commit protocol -------------------------------------------------
+
+def put_commit(client: ObjectStoreClient, prefix: str,
+               objects: Dict[str, bytes], *,
+               meta: Optional[Dict[str, Any]] = None,
+               order: Optional[List[str]] = None) -> Dict[str, Any]:
+    """Atomically publish ``objects`` under ``prefix``: every payload
+    is a verified PUT, THEN the ``_COMMIT`` marker naming each object
+    with its byte size and sha256 goes last.  A crash or fault at any
+    point leaves a marker-less (invisible) prefix, never a marked torn
+    one.  Returns the marker dict.
+
+    A pre-existing marker is deleted FIRST (a replaced commit — e.g. a
+    rewound timeline re-reaching a step label — must pass through an
+    invisible state, not a window where the old marker blesses new
+    payload bytes)."""
+    marker_key = commit_marker_key(prefix)
+    if client.store.exists(marker_key):
+        client.store.delete(marker_key)
+    names = list(order) if order is not None else sorted(objects)
+    entries: Dict[str, Dict[str, Any]] = {}
+    for n in names:
+        data = objects[n]
+        sha = client.put(f"{prefix.rstrip('/')}/{n}", data)
+        entries[n] = {"bytes": len(data), "sha256": sha}
+    marker = {"version": 1, "objects": entries, "meta": meta or {}}
+    client.put(marker_key,
+               json.dumps(marker, sort_keys=True).encode("utf-8"))
+    return marker
+
+
+def read_commit_marker(store: ObjectStore, prefix: str
+                       ) -> Optional[Dict[str, Any]]:
+    """The parsed ``_COMMIT`` marker under ``prefix``, or None when
+    absent/unparseable (either way: not a committed prefix)."""
+    try:
+        raw = store.get(commit_marker_key(prefix))
+        marker = json.loads(raw.decode("utf-8"))
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(marker, dict) \
+            or not isinstance(marker.get("objects"), dict):
+        return None
+    return marker
+
+
+def read_commit(client: ObjectStoreClient, prefix: str
+                ) -> Dict[str, bytes]:
+    """Fetch a committed prefix: marker first (no marker → typed
+    ``torn`` :class:`StoreCommitError`), then every payload through
+    the verifying GET.  A payload that stays wrong across the retry
+    budget surfaces as :class:`StoreCommitError` naming the object —
+    the caller quarantines the commit and falls back."""
+    marker = read_commit_marker(client.store, prefix)
+    if marker is None:
+        raise StoreCommitError(
+            f"{client.destination}: no commit marker under {prefix!r} "
+            "(torn or absent upload)", prefix=prefix, torn=True)
+    out: Dict[str, bytes] = {}
+    for name, entry in sorted(marker["objects"].items()):
+        key = f"{prefix.rstrip('/')}/{name}"
+        try:
+            out[name] = client.get(key, sha256=entry.get("sha256"))
+        except (OSError, ShardCorruptionError) as e:
+            raise StoreCommitError(
+                f"{client.destination}: commit {prefix!r} object "
+                f"{name!r} failed verification ({e!r})",
+                prefix=prefix) from e
+    return out
+
+
+def list_commits(store: ObjectStore, prefix: str = "") -> List[str]:
+    """Commit-marked prefixes under ``prefix`` (the unit of visibility:
+    a prefix without its marker is NOT listed — torn uploads are
+    invisible here by protocol)."""
+    suffix = f"/{COMMIT_MARKER}"
+    return sorted(k[:-len(suffix)] for k in store.list(prefix)
+                  if k.endswith(suffix))
+
+
+def verify_commit(store: ObjectStore, prefix: str) -> List[str]:
+    """Inspector-grade full verification of one committed prefix:
+    returns a list of problems (empty = sound).  Reads every payload
+    once, no retries — this is the ``inspect --mirror`` audit, not a
+    recovery path."""
+    problems: List[str] = []
+    marker = read_commit_marker(store, prefix)
+    if marker is None:
+        if store.exists(commit_marker_key(prefix)):
+            problems.append("commit marker unparseable")
+        else:
+            problems.append("no commit marker (torn upload)")
+        return problems
+    for name, entry in sorted(marker["objects"].items()):
+        key = f"{prefix.rstrip('/')}/{name}"
+        try:
+            data = store.get(key)
+        except OSError as e:
+            problems.append(f"{name}: unreadable ({e!r})")
+            continue
+        want = entry.get("sha256")
+        if want is not None and sha256_hex(data) != want:
+            problems.append(f"{name}: sha256 mismatch")
+        if entry.get("bytes") is not None \
+                and len(data) != int(entry["bytes"]):
+            problems.append(
+                f"{name}: {len(data)} bytes, marker says "
+                f"{entry['bytes']}")
+    return problems

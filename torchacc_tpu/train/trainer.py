@@ -1200,7 +1200,7 @@ class Trainer:
         fs = TieredCheckpointManager._fs_valid_steps(
             self._last_checkpoint_dir)
         tiers["tier1"] = max(fs) if fs else None
-        mirror = TieredCheckpointManager._fs_valid_steps(
+        mirror = TieredCheckpointManager._mirror_valid_steps(
             self.config.resilience.tiered_mirror_dir)
         tiers["tier2"] = max(mirror) if mirror else None
         return tiers
